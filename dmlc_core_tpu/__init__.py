@@ -1,0 +1,30 @@
+"""dmlc_core_tpu — a TPU-native framework with the capabilities of dmlc-core.
+
+A brand-new JAX/XLA/Pallas-first design (not a port) providing:
+
+* ``utils``    — logging/CHECK, declarative Parameter system, Registry,
+                 Config parser, binary serializer, ThreadedIter prefetcher
+                 (capability parity with reference ``include/dmlc/``).
+* ``io``       — URI-addressed Stream/FileSystem layer, RecordIO codec,
+                 partition-correct InputSplit engine with threaded/cached/
+                 shuffled wrappers (reference ``src/io/``).
+* ``data``     — format parsers (libsvm/csv/libfm/recordio) producing sparse
+                 CSR ``RowBlock`` batches, streaming + in-memory + disk-cached
+                 iterators (reference ``src/data/``).
+* ``pipeline`` — host→HBM staging: fixed-shape batch packing and a
+                 double-buffered device feed (TPU-native replacement for the
+                 reference's CPU consumer loop).
+* ``ops``      — Pallas TPU kernels (CSR×dense matmul, segment reductions).
+* ``parallel`` — device-mesh collectives with a rabit-compatible
+                 Allreduce/Broadcast API, rendezvous tracker, and the
+                 ``dmlc-submit`` style multi-cluster launcher
+                 (reference ``tracker/``).
+* ``models``   — streaming sparse models (logistic regression, factorization
+                 machines) that train end-to-end from the ingest pipeline.
+
+Reference: Luo-Liang/dmlc-core (C++11), surveyed in /root/repo/SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+from . import utils  # noqa: F401

@@ -1,0 +1,26 @@
+"""Core utilities layer (capability parity with reference ``include/dmlc/``, SURVEY §2.1)."""
+
+from .logging import (  # noqa: F401
+    DMLCError, ParamError,
+    check, check_eq, check_ne, check_lt, check_le, check_gt, check_ge,
+    check_notnull, log_info, log_warning, log_error, log_fatal,
+    set_log_sink, get_logger, PeriodicLogger,
+)
+from .registry import Registry, RegistryEntry  # noqa: F401
+from .parameter import Parameter, field, FieldEntry, get_env  # noqa: F401
+from .config import Config  # noqa: F401
+from .threaded_iter import ThreadedIter  # noqa: F401
+from .timer import get_time, Timer  # noqa: F401
+from . import serializer  # noqa: F401
+
+
+def split(s: str, delim: str) -> list:
+    """Split helper mirroring ``dmlc::Split`` (`common.h:20-37`): istream
+    getline semantics — a trailing delimiter does NOT produce an empty last
+    segment, and empty input yields []."""
+    if s == "":
+        return []
+    parts = s.split(delim)
+    if parts and parts[-1] == "":
+        parts.pop()
+    return parts

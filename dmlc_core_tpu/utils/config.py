@@ -1,0 +1,145 @@
+"""``key=value`` config-file parser — capability parity with reference
+``include/dmlc/config.h`` + ``src/config.cc``.
+
+Reference semantics (`config.h:40-160`, tokenizer `src/config.cc:30-170`):
+
+* ``key = value`` pairs, whitespace-insensitive around ``=``;
+* ``#`` starts a comment to end-of-line;
+* values may be double-quoted strings with escapes (``\\n``, ``\\t``, ``\\\"``,
+  ``\\\\``) — quotes are stripped on read and re-added by ``ToProtoString``;
+* *multi-value mode*: when enabled, repeated keys accumulate instead of
+  overwriting (`config.h:46-52`); order of insertion is preserved either way;
+* ``ToProtoString`` re-emits the config as ``key=value\\n`` lines
+  (`config.h:102`).
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Any, Dict, Iterator, List, TextIO, Tuple, Union
+
+from .logging import DMLCError
+
+__all__ = ["Config"]
+
+_ESCAPES = {"n": "\n", "t": "\t", '"': '"', "\\": "\\", "r": "\r"}
+_REV_ESCAPES = {"\n": "\\n", "\t": "\\t", '"': '\\"', "\\": "\\\\", "\r": "\\r"}
+
+
+def _tokenize(text: str) -> Iterator[Tuple[str, bool]]:
+    """Yield (token, was_quoted) skipping comments (reference Tokenizer `src/config.cc:30`)."""
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c == '"':
+            i += 1
+            buf: List[str] = []
+            closed = False
+            while i < n:
+                c = text[i]
+                if c == "\\" and i + 1 < n:
+                    buf.append(_ESCAPES.get(text[i + 1], text[i + 1]))
+                    i += 2
+                    continue
+                if c == '"':
+                    closed = True
+                    i += 1
+                    break
+                buf.append(c)
+                i += 1
+            if not closed:
+                raise DMLCError("Config: unterminated quoted string")
+            yield "".join(buf), True
+        elif c == "=":
+            i += 1
+            yield "=", False
+        elif c.isspace():
+            i += 1
+        else:
+            j = i
+            while j < n and not text[j].isspace() and text[j] not in ('=', '#', '"'):
+                j += 1
+            yield text[i:j], False
+            i = j
+
+
+class Config:
+    """Ordered key→value config (reference ``Config`` `config.h:40`)."""
+
+    def __init__(self, source: Union[str, TextIO, None] = None,
+                 multi_value: bool = False):
+        self.multi_value = multi_value
+        # insertion-ordered list of (key, value_str); _index maps key -> positions
+        self._items: List[Tuple[str, str]] = []
+        self._index: Dict[str, List[int]] = {}
+        if source is not None:
+            self.load(source)
+
+    # -- parsing --
+    def load(self, source: Union[str, TextIO]) -> None:
+        text = source if isinstance(source, str) else source.read()
+        toks = list(_tokenize(text))
+        i = 0
+        while i < len(toks):
+            key, key_q = toks[i]
+            if key == "=" and not key_q:
+                raise DMLCError("Config: unexpected '='")
+            if i + 1 >= len(toks) or toks[i + 1][0] != "=" or toks[i + 1][1]:
+                raise DMLCError(f"Config: expected '=' after key {key!r}")
+            if i + 2 >= len(toks):
+                raise DMLCError(f"Config: missing value for key {key!r}")
+            val, _ = toks[i + 2]
+            self.set_param(key, val)
+            i += 3
+
+    # -- mutation (reference SetParam `config.h:81`) --
+    def set_param(self, key: str, value: Any) -> None:
+        sval = _to_str(value)
+        if not self.multi_value and key in self._index:
+            self._items[self._index[key][-1]] = (key, sval)
+            return
+        self._index.setdefault(key, []).append(len(self._items))
+        self._items.append((key, sval))
+
+    # -- access (reference GetParam `config.h:89`) --
+    def get_param(self, key: str) -> str:
+        if key not in self._index:
+            raise KeyError(f"config key {key!r} not found")
+        return self._items[self._index[key][-1]][1]
+
+    def get_all(self, key: str) -> List[str]:
+        return [self._items[i][1] for i in self._index.get(key, [])]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def __getitem__(self, key: str) -> str:
+        return self.get_param(key)
+
+    def __iter__(self) -> Iterator[Tuple[str, str]]:
+        """Iterate (key, value) in insertion order (reference iterator `config.h:120`)."""
+        return iter(self._items)
+
+    def items(self) -> List[Tuple[str, str]]:
+        return list(self._items)
+
+    def to_dict(self) -> Dict[str, str]:
+        return {k: v for k, v in self._items}
+
+    # -- output (reference ToProtoString `config.h:102`) --
+    def to_proto_string(self) -> str:
+        out = _io.StringIO()
+        for k, v in self._items:
+            if any(ch in v for ch in ' \t\n\r"#=') or v == "":
+                v = '"' + "".join(_REV_ESCAPES.get(c, c) for c in v) + '"'
+            out.write(f"{k} = {v}\n")
+        return out.getvalue()
+
+
+def _to_str(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)

@@ -1,0 +1,161 @@
+"""Logging and check macros — capability parity with reference ``include/dmlc/logging.h``.
+
+The reference provides glog-compatible ``CHECK*``/``LOG(severity)`` macros with
+throw-on-fatal (`logging.h:104-155,255`, ``DMLC_LOG_FATAL_THROW`` `base.h:20`),
+a customizable sink (``DMLC_LOG_CUSTOMIZE`` `logging.h:142`), and a date logger
+(`logging.h:178`).  The TPU-native equivalent is a thin layer over Python
+``logging`` with:
+
+* ``check(cond, msg)`` / ``check_eq`` / ``check_ne`` / ... raising
+  :class:`DMLCError` (analog of ``dmlc::Error`` `logging.h:26`),
+* ``LOG`` helpers with INFO/WARNING/ERROR/FATAL severities where FATAL raises,
+* a pluggable sink via :func:`set_log_sink` (analog of ``DMLC_LOG_CUSTOMIZE``).
+"""
+
+from __future__ import annotations
+
+import logging as _pylogging
+import os
+import sys
+import time
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "DMLCError",
+    "ParamError",
+    "check",
+    "check_eq",
+    "check_ne",
+    "check_lt",
+    "check_le",
+    "check_gt",
+    "check_ge",
+    "check_notnull",
+    "log_info",
+    "log_warning",
+    "log_error",
+    "log_fatal",
+    "set_log_sink",
+    "get_logger",
+]
+
+
+class DMLCError(RuntimeError):
+    """Base error type (reference ``dmlc::Error``, `logging.h:26`)."""
+
+
+class ParamError(DMLCError, ValueError):
+    """Raised when parameter initialization fails (reference `parameter.h:62`)."""
+
+
+_logger = _pylogging.getLogger("dmlc_core_tpu")
+if not _logger.handlers:
+    _h = _pylogging.StreamHandler(sys.stderr)
+    _h.setFormatter(_pylogging.Formatter("[%(asctime)s] %(levelname)s %(message)s", "%H:%M:%S"))
+    _logger.addHandler(_h)
+    _level = os.environ.get("DMLC_LOG_LEVEL", "INFO").upper()
+    _logger.setLevel(_level if _level in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL") else "INFO")
+
+# Pluggable sink: fn(severity: str, message: str) -> None.  When set, replaces
+# the default python-logging emission (reference DMLC_LOG_CUSTOMIZE, logging.h:142-146).
+_custom_sink: Optional[Callable[[str, str], None]] = None
+
+
+def get_logger() -> _pylogging.Logger:
+    return _logger
+
+
+def set_log_sink(sink: Optional[Callable[[str, str], None]]) -> None:
+    """Install a custom log sink, or None to restore the default."""
+    global _custom_sink
+    _custom_sink = sink
+
+
+def _emit(severity: str, msg: str) -> None:
+    if _custom_sink is not None:
+        _custom_sink(severity, msg)
+        return
+    level = getattr(_pylogging, severity, _pylogging.INFO)
+    _logger.log(level, msg)
+
+
+def log_info(msg: str, *args: Any) -> None:
+    _emit("INFO", msg % args if args else msg)
+
+
+def log_warning(msg: str, *args: Any) -> None:
+    _emit("WARNING", msg % args if args else msg)
+
+
+def log_error(msg: str, *args: Any) -> None:
+    _emit("ERROR", msg % args if args else msg)
+
+
+def log_fatal(msg: str, *args: Any) -> None:
+    """FATAL logs raise (reference throw-on-fatal ``LogMessageFatal`` `logging.h:255`)."""
+    text = msg % args if args else msg
+    _emit("ERROR", text)
+    raise DMLCError(text)
+
+
+def check(cond: Any, msg: str = "") -> None:
+    """Reference ``CHECK(x)`` `logging.h:104`: raise DMLCError when cond is falsy."""
+    if not cond:
+        raise DMLCError(f"Check failed: {msg}" if msg else "Check failed")
+
+
+def _check_bin(op_name: str, ok: bool, x: Any, y: Any, msg: str) -> None:
+    if not ok:
+        detail = f"Check failed: {x!r} {op_name} {y!r}"
+        if msg:
+            detail += f": {msg}"
+        raise DMLCError(detail)
+
+
+def check_eq(x: Any, y: Any, msg: str = "") -> None:
+    _check_bin("==", x == y, x, y, msg)
+
+
+def check_ne(x: Any, y: Any, msg: str = "") -> None:
+    _check_bin("!=", x != y, x, y, msg)
+
+
+def check_lt(x: Any, y: Any, msg: str = "") -> None:
+    _check_bin("<", x < y, x, y, msg)
+
+
+def check_le(x: Any, y: Any, msg: str = "") -> None:
+    _check_bin("<=", x <= y, x, y, msg)
+
+
+def check_gt(x: Any, y: Any, msg: str = "") -> None:
+    _check_bin(">", x > y, x, y, msg)
+
+
+def check_ge(x: Any, y: Any, msg: str = "") -> None:
+    _check_bin(">=", x >= y, x, y, msg)
+
+
+def check_notnull(x: Any, msg: str = "") -> Any:
+    """Reference ``CHECK_NOTNULL`` `logging.h:119`."""
+    if x is None:
+        raise DMLCError(f"Check notnull failed: {msg}" if msg else "Check notnull failed")
+    return x
+
+
+class PeriodicLogger:
+    """Rate-limited progress logger for throughput reporting.
+
+    Mirrors the reference's every-10MB / every-N-seconds ingest progress logs
+    (`basic_row_iter.h:68-76`, `disk_row_iter.h:117-126`).
+    """
+
+    def __init__(self, period_sec: float = 2.0):
+        self.period_sec = period_sec
+        self._last = time.monotonic()
+
+    def maybe(self, msg_fn: Callable[[], str]) -> None:
+        now = time.monotonic()
+        if now - self._last >= self.period_sec:
+            self._last = now
+            log_info(msg_fn())

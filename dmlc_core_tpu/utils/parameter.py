@@ -1,0 +1,305 @@
+"""Declarative typed hyper-parameter system — capability parity with reference
+``include/dmlc/parameter.h``.
+
+The reference provides ``Parameter<PType>`` structs with declared fields
+carrying defaults, ranges, enums, aliases, docstring generation, env-var reads
+and JSON save/load (`parameter.h:122-238`, ``DMLC_DECLARE_FIELD``
+`parameter.h:268`, ``DMLC_DECLARE_ALIAS`` :275, ``FieldEntryNumeric::set_range``
+:660, ``FieldEntry<int>::add_enum`` :761, ``GetEnv`` :46).  Bad values raise
+``ParamError`` (`parameter.h:62`).
+
+TPU-native expression: a metaclass-driven ``Parameter`` base class with
+``field()`` descriptors::
+
+    class CSVParserParam(Parameter):
+        format = field(str, default="csv")
+        label_column = field(int, default=-1, help="column id of the label")
+
+    p = CSVParserParam()
+    unknown = p.init({"label_column": 0, "x": 1}, allow_unknown=True)
+
+Capabilities: defaults, required fields, [lo, hi] ranges, enum domains
+(string-or-value), aliases, ``init``/``init_allow_unknown``, ``to_dict``
+(``__DICT__`` :176), ``save_json``/``load_json`` (:185-197), ``fields()``
+(``__FIELDS__`` :202), ``doc_string()`` (``PrintDocString`` :483), and
+``update_dict`` env-var style overlays.  ``get_env`` mirrors ``GetEnv``.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import math
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Type, Union
+
+from .logging import ParamError
+
+__all__ = ["Parameter", "field", "FieldEntry", "get_env"]
+
+_NOTHING = object()
+
+
+def _parse_bool(s: Any) -> bool:
+    """Boolean parse accepting true/false/1/0 (reference ``FieldEntry<bool>`` `parameter.h:795-820`)."""
+    if isinstance(s, bool):
+        return s
+    if isinstance(s, (int, float)):
+        return bool(s)
+    t = str(s).strip().lower()
+    if t in ("true", "1", "yes", "t"):
+        return True
+    if t in ("false", "0", "no", "f"):
+        return False
+    raise ValueError(f"invalid bool value {s!r}")
+
+
+class FieldEntry:
+    """One declared parameter field (reference ``FieldEntry<T>`` `parameter.h:596+`)."""
+
+    def __init__(self, dtype: Type[Any], default: Any = _NOTHING, *,
+                 help: str = "", range: Optional[Tuple[Any, Any]] = None,
+                 enum: Optional[Iterable[Any]] = None,
+                 aliases: Iterable[str] = (),
+                 lower_bound: Any = None, upper_bound: Any = None,
+                 optional: bool = False,
+                 validate: Optional[Callable[[Any], bool]] = None):
+        self.dtype = dtype
+        self.default = default
+        self.help = help
+        self.lower = lower_bound
+        self.upper = upper_bound
+        if range is not None:
+            self.lower, self.upper = range
+        self.enum = list(enum) if enum is not None else None
+        self.aliases = list(aliases)
+        self.optional = optional
+        self.validate = validate
+        self.name: str = "<unbound>"
+
+    def __set_name__(self, owner: type, name: str) -> None:
+        self.name = name
+
+    # descriptor protocol: instances store values in __dict__ under the field name
+    def __get__(self, obj: Any, objtype: type = None) -> Any:
+        if obj is None:
+            return self
+        if self.name in obj.__dict__:
+            return obj.__dict__[self.name]
+        if self.default is _NOTHING:
+            raise AttributeError(f"required parameter '{self.name}' not set")
+        if isinstance(self.default, (list, dict, set, bytearray)):
+            # materialize a per-instance copy so mutable defaults never alias
+            # across instances
+            value = copy.copy(self.default)
+            obj.__dict__[self.name] = value
+            return value
+        return self.default
+
+    def __set__(self, obj: Any, value: Any) -> None:
+        obj.__dict__[self.name] = self.check_and_convert(value)
+
+    # -- value handling --
+    def convert(self, value: Any) -> Any:
+        if value is None:
+            if self.optional:
+                return None
+            raise ValueError(f"parameter '{self.name}' cannot be None")
+        if self.dtype is bool:
+            return _parse_bool(value)
+        if self.dtype in (int,):
+            # reject silent float truncation like "2.5" -> 2 but allow "3"/"3.0"
+            if isinstance(value, str):
+                f = float(value)
+            elif isinstance(value, float):
+                f = value
+            else:
+                return int(value)
+            i = int(f)
+            if f != i:
+                raise ValueError(f"value {value!r} for int parameter '{self.name}' is not integral")
+            return i
+        if self.dtype is float:
+            f = float(value)
+            if math.isnan(f):
+                raise ValueError(f"value {value!r} for parameter '{self.name}' is NaN")
+            return f
+        if self.dtype is str:
+            return str(value)
+        if isinstance(value, self.dtype):
+            return value
+        return self.dtype(value)
+
+    def check_and_convert(self, value: Any) -> Any:
+        try:
+            v = self.convert(value)
+        except (TypeError, ValueError, OverflowError) as e:
+            raise ParamError(
+                f"Invalid value {value!r} for parameter '{self.name}' "
+                f"(expect {self.dtype.__name__}): {e}") from None
+        if v is None:
+            return v
+        if self.enum is not None and v not in self.enum:
+            raise ParamError(
+                f"Invalid value {v!r} for parameter '{self.name}': "
+                f"expected one of {self.enum}")
+        # range semantics mirror reference set_range/set_lower_bound: inclusive
+        # bounds, violation raises ParamError (`parameter.h:646-700`).
+        if self.lower is not None and v < self.lower:
+            raise ParamError(
+                f"value {v!r} for parameter '{self.name}' is below lower bound {self.lower!r}")
+        if self.upper is not None and v > self.upper:
+            raise ParamError(
+                f"value {v!r} for parameter '{self.name}' exceeds upper bound {self.upper!r}")
+        if self.validate is not None and not self.validate(v):
+            raise ParamError(f"value {v!r} for parameter '{self.name}' failed validation")
+        return v
+
+    @property
+    def required(self) -> bool:
+        return self.default is _NOTHING
+
+    def doc(self) -> str:
+        parts = [f"{self.name} : {self.dtype.__name__}"]
+        if self.required:
+            parts.append("(required)")
+        else:
+            parts.append(f"(default={self.default!r})")
+        if self.enum is not None:
+            parts.append(f"choices={self.enum}")
+        if self.lower is not None or self.upper is not None:
+            parts.append(f"range=[{self.lower}, {self.upper}]")
+        head = " ".join(parts)
+        return head + ("\n    " + self.help if self.help else "")
+
+
+def field(dtype: Type[Any], default: Any = _NOTHING, **kwargs: Any) -> FieldEntry:
+    """Declare a parameter field (reference ``DMLC_DECLARE_FIELD`` `parameter.h:268`)."""
+    return FieldEntry(dtype, default, **kwargs)
+
+
+class _ParamMeta(type):
+    def __new__(mcls, name: str, bases: Tuple[type, ...], ns: Dict[str, Any]):
+        cls = super().__new__(mcls, name, bases, ns)
+        entries: Dict[str, FieldEntry] = {}
+        alias_map: Dict[str, str] = {}
+        for base in reversed(cls.__mro__):
+            for k, v in vars(base).items():
+                if isinstance(v, FieldEntry):
+                    entries[k] = v
+        for k, e in entries.items():
+            for a in e.aliases:
+                alias_map[a] = k
+        cls.__param_fields__ = entries
+        cls.__param_aliases__ = alias_map
+        return cls
+
+
+class Parameter(metaclass=_ParamMeta):
+    """Base class for declarative parameter structs (reference ``Parameter<PType>`` `parameter.h:122`).
+
+    Instances are mutable config structs and therefore intentionally
+    **unhashable** (``__eq__`` without ``__hash__``); compare with ``==`` or
+    key dicts by ``save_json()``.
+    """
+
+    __param_fields__: Dict[str, FieldEntry] = {}
+    __param_aliases__: Dict[str, str] = {}
+
+    def __init__(self, **kwargs: Any):
+        if kwargs:
+            self.init(kwargs)
+
+    # -- init protocol (reference Init `parameter.h:136`, InitAllowUnknown :154) --
+    def init(self, kwargs: Dict[str, Any], allow_unknown: bool = False) -> Dict[str, Any]:
+        """Set fields from ``kwargs``; returns dict of unknown args.
+
+        Raises :class:`ParamError` on unknown keys (unless ``allow_unknown``),
+        bad values, out-of-range values, or missing required fields.
+        """
+        fields = self.__param_fields__
+        aliases = self.__param_aliases__
+        unknown: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            key = aliases.get(k, k)
+            entry = fields.get(key)
+            if entry is None:
+                if allow_unknown:
+                    unknown[k] = v
+                    continue
+                raise ParamError(
+                    f"unknown parameter '{k}' for {type(self).__name__}; "
+                    f"candidates: {sorted(fields)}")
+            entry.__set__(self, v)
+        missing = [k for k, e in fields.items()
+                   if e.required and k not in self.__dict__]
+        if missing:
+            raise ParamError(
+                f"required parameters {missing} of {type(self).__name__} not set")
+        return unknown
+
+    def init_allow_unknown(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        return self.init(kwargs, allow_unknown=True)
+
+    def update_dict(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Update known keys only, return the rest (reference ``UpdateDict`` `parameter.h:166`)."""
+        return self.init(kwargs, allow_unknown=True)
+
+    # -- reflection (reference __DICT__ :176, __FIELDS__ :202, __DOC__ :213) --
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__param_fields__
+                if not self.__param_fields__[k].required or k in self.__dict__}
+
+    @classmethod
+    def fields(cls) -> List[FieldEntry]:
+        return list(cls.__param_fields__.values())
+
+    @classmethod
+    def doc_string(cls) -> str:
+        lines = [f"Parameters of {cls.__name__}", "-" * 30]
+        for e in cls.__param_fields__.values():
+            lines.append(e.doc())
+        return "\n".join(lines)
+
+    # -- JSON round trip (reference Save/Load `parameter.h:185-197`) --
+    def save_json(self) -> str:
+        return json.dumps({k: v for k, v in self.to_dict().items()}, sort_keys=True)
+
+    def load_json(self, s: str) -> None:
+        self.init(json.loads(s), allow_unknown=False)
+
+    def save(self, stream: Any) -> None:
+        """Serialize as JSON text to a Stream (duck-typed ``.write``)."""
+        data = self.save_json().encode("utf-8")
+        from .serializer import write_uint64, write_bytes
+        write_uint64(stream, len(data))
+        write_bytes(stream, data)
+
+    def load(self, stream: Any) -> None:
+        from .serializer import read_uint64, read_bytes
+        n = read_uint64(stream)
+        self.load_json(read_bytes(stream, n).decode("utf-8"))
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.to_dict().items())
+        return f"{type(self).__name__}({inner})"
+
+    def __eq__(self, other: Any) -> bool:
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+def get_env(key: str, default: Any) -> Any:
+    """Typed env read (reference ``GetEnv`` `parameter.h:46,1034+`).
+
+    The returned value is converted to ``type(default)`` (bools accept
+    true/false/1/0).
+    """
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    t = type(default)
+    if t is bool:
+        return _parse_bool(raw)
+    if default is None:
+        return raw
+    return t(raw)

@@ -1,0 +1,130 @@
+"""Global name→factory registries — capability parity with reference ``include/dmlc/registry.h``.
+
+The reference ``Registry<EntryType>`` (`registry.h:27`) provides per-entry-type
+global singletons with ``Find`` (:48), ``__REGISTER__`` (:78), ``AddAlias``
+(:62) and list enumeration, plus registration macros
+(``DMLC_REGISTRY_REGISTER`` `registry.h:246`).  Entries carry name, description,
+arguments and a factory body (``FunctionRegEntryBase`` `registry.h:147`).
+
+TPU-native expression: one :class:`Registry` class; each subsystem obtains its
+singleton with ``Registry.get("ParserFactory")``.  Registration is a decorator::
+
+    parser_registry = Registry.get("ParserFactory")
+
+    @parser_registry.register("libsvm", description="sparse libsvm text")
+    def create_libsvm_parser(uri, part, nparts, extra):
+        ...
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .logging import DMLCError, check
+
+__all__ = ["Registry", "RegistryEntry"]
+
+
+class RegistryEntry:
+    """Analog of ``FunctionRegEntryBase`` (`registry.h:147`)."""
+
+    def __init__(self, name: str, body: Callable[..., Any],
+                 description: str = "", arguments: Optional[List[Dict[str, str]]] = None):
+        self.name = name
+        self.body = body
+        self.description = description
+        self.arguments = arguments or []
+        self.return_type = ""
+
+    def describe(self, description: str) -> "RegistryEntry":
+        self.description = description
+        return self
+
+    def add_argument(self, name: str, type_: str, description: str) -> "RegistryEntry":
+        self.arguments.append({"name": name, "type": type_, "description": description})
+        return self
+
+    def set_return_type(self, t: str) -> "RegistryEntry":
+        self.return_type = t
+        return self
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.body(*args, **kwargs)
+
+
+class Registry:
+    """Name→entry registry with aliasing (reference ``Registry<E>`` `registry.h:27-100`)."""
+
+    _registries: Dict[str, "Registry"] = {}
+    _global_lock = threading.Lock()
+
+    def __init__(self, type_name: str):
+        self.type_name = type_name
+        self._entries: Dict[str, RegistryEntry] = {}
+        self._lock = threading.Lock()
+
+    # -- singleton access (reference per-type `Registry::Get()` `registry.h:230`) --
+    @classmethod
+    def get(cls, type_name: str) -> "Registry":
+        with cls._global_lock:
+            reg = cls._registries.get(type_name)
+            if reg is None:
+                reg = cls._registries[type_name] = Registry(type_name)
+            return reg
+
+    @classmethod
+    def list_registries(cls) -> List[str]:
+        with cls._global_lock:
+            return sorted(cls._registries)
+
+    # -- registration --
+    def register(self, name: str, description: str = "",
+                 allow_override: bool = False) -> Callable[[Callable[..., Any]], Callable[..., Any]]:
+        """Decorator registering ``fn`` under ``name`` (reference ``__REGISTER__`` `registry.h:78`)."""
+
+        def deco(fn: Callable[..., Any]) -> Callable[..., Any]:
+            self.register_entry(RegistryEntry(name, fn, description), allow_override)
+            return fn
+
+        return deco
+
+    def register_entry(self, entry: RegistryEntry, allow_override: bool = False) -> RegistryEntry:
+        with self._lock:
+            if entry.name in self._entries and not allow_override:
+                raise DMLCError(
+                    f"{self.type_name} '{entry.name}' is already registered")
+            self._entries[entry.name] = entry
+            return entry
+
+    def add_alias(self, key_name: str, alias: str) -> None:
+        """Register ``alias`` → same entry (reference ``AddAlias`` `registry.h:62-70`)."""
+        with self._lock:
+            check(key_name in self._entries, f"cannot alias missing entry '{key_name}'")
+            if alias in self._entries:
+                raise DMLCError(f"{self.type_name} alias '{alias}' already registered")
+            self._entries[alias] = self._entries[key_name]
+
+    # -- lookup --
+    def find(self, name: str) -> Optional[RegistryEntry]:
+        """Reference ``Find`` `registry.h:48-54`: None when absent."""
+        with self._lock:
+            return self._entries.get(name)
+
+    def __getitem__(self, name: str) -> RegistryEntry:
+        entry = self.find(name)
+        if entry is None:
+            raise KeyError(
+                f"unknown {self.type_name} '{name}'; registered: {self.list_names()}")
+        return entry
+
+    def __contains__(self, name: str) -> bool:
+        return self.find(name) is not None
+
+    def list_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._entries.pop(name, None)

@@ -1,0 +1,41 @@
+"""Wall-clock timing utilities (reference ``include/dmlc/timer.h``).
+
+``get_time()`` mirrors ``dmlc::GetTime()`` (`timer.h:27`): seconds as float,
+monotonic where available.  ``Timer`` adds a simple scope/stopwatch helper used
+by throughput instrumentation (reference prints MB/s inline,
+`basic_row_iter.h:68-76`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["get_time", "Timer"]
+
+
+def get_time() -> float:
+    """Seconds from a monotonic clock (reference ``GetTime`` `timer.h:27`)."""
+    return time.monotonic()
+
+
+class Timer:
+    """Stopwatch: ``with Timer() as t: ...; t.elapsed``."""
+
+    def __init__(self) -> None:
+        self.start: Optional[float] = None
+        self.elapsed: float = 0.0
+
+    def __enter__(self) -> "Timer":
+        self.start = get_time()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.elapsed = get_time() - self.start  # type: ignore[operator]
+
+    def restart(self) -> None:
+        self.start = get_time()
+        self.elapsed = 0.0
+
+    def lap(self) -> float:
+        return get_time() - (self.start if self.start is not None else get_time())

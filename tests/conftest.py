@@ -1,0 +1,16 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX is imported.
+
+Mirrors the reference's test strategy (SURVEY §4): everything runs single-host
+CPU; distributed behavior is validated on simulated devices
+(``xla_force_host_platform_device_count``) the way the reference validates
+partitioning single-process and the tracker with ``--cluster local``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

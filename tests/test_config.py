@@ -1,0 +1,52 @@
+"""Config parser tests (reference: ``test/unittest/unittest_config.cc``)."""
+
+import pytest
+
+from dmlc_core_tpu.utils import Config, DMLCError
+
+
+def test_basic_parse():
+    cfg = Config("lr = 0.1\nbatch=32  # trailing comment\n# full comment\nname = net1\n")
+    assert cfg["lr"] == "0.1"
+    assert cfg["batch"] == "32"
+    assert cfg["name"] == "net1"
+    assert "missing" not in cfg
+    with pytest.raises(KeyError):
+        cfg.get_param("missing")
+
+
+def test_quoted_strings_and_escapes():
+    cfg = Config('msg = "hello world"\npath = "a\\tb\\nc"\nq = "say \\"hi\\""\n')
+    assert cfg["msg"] == "hello world"
+    assert cfg["path"] == "a\tb\nc"
+    assert cfg["q"] == 'say "hi"'
+
+
+def test_multi_value_mode():
+    text = "eval = a\neval = b\n"
+    single = Config(text)
+    assert single.get_all("eval") == ["b"]  # overwrite
+    multi = Config(text, multi_value=True)
+    assert multi.get_all("eval") == ["a", "b"]
+    assert multi["eval"] == "b"  # latest
+
+
+def test_order_preserved_and_proto_string():
+    cfg = Config(multi_value=True)
+    cfg.set_param("b", 2)
+    cfg.set_param("a", "x y")
+    cfg.set_param("flag", True)
+    proto = cfg.to_proto_string()
+    assert proto.splitlines() == ['b = 2', 'a = "x y"', 'flag = true']
+    # round trip
+    cfg2 = Config(proto, multi_value=True)
+    assert cfg2.items() == cfg.items()
+
+
+def test_errors():
+    with pytest.raises(DMLCError):
+        Config("key value\n")  # missing '='
+    with pytest.raises(DMLCError):
+        Config('a = "unterminated\n')
+    with pytest.raises(DMLCError):
+        Config("a =\n")  # missing value

@@ -1,0 +1,124 @@
+"""Parameter system tests (reference behavior: ``test/unittest/unittest_param.cc``)."""
+
+import os
+
+import pytest
+
+from dmlc_core_tpu.utils import Parameter, ParamError, field, get_env
+from dmlc_core_tpu.utils.serializer import  read_uint64  # noqa: F401
+import io as _io
+
+
+class LearningParam(Parameter):
+    num_hidden = field(int, default=100, range=(1, 10000), help="hidden units")
+    learning_rate = field(float, default=0.01, lower_bound=0.0)
+    activation = field(str, default="relu", enum=["relu", "tanh", "sigmoid"])
+    use_bias = field(bool, default=True)
+    name = field(str, aliases=["moniker"], default="net")
+
+
+class RequiredParam(Parameter):
+    size = field(int)
+    scale = field(float, default=1.0)
+
+
+def test_defaults():
+    p = LearningParam()
+    assert p.num_hidden == 100
+    assert p.learning_rate == 0.01
+    assert p.activation == "relu"
+    assert p.use_bias is True
+
+
+def test_init_and_types():
+    p = LearningParam()
+    p.init({"num_hidden": "256", "learning_rate": "0.5", "use_bias": "false"})
+    assert p.num_hidden == 256
+    assert p.learning_rate == 0.5
+    assert p.use_bias is False
+
+
+def test_range_violation_raises():
+    # mirrors unittest_param.cc:13-21 (out-of-range init throws ParamError)
+    p = LearningParam()
+    with pytest.raises(ParamError):
+        p.init({"num_hidden": 0})
+    with pytest.raises(ParamError):
+        p.init({"num_hidden": 100000})
+    with pytest.raises(ParamError):
+        p.init({"learning_rate": -1.0})
+
+
+def test_float_underflow_like_badvalue():
+    p = LearningParam()
+    with pytest.raises(ParamError):
+        p.init({"learning_rate": "not_a_number"})
+    with pytest.raises(ParamError):
+        p.init({"num_hidden": "2.5"})  # non-integral
+
+
+def test_enum():
+    p = LearningParam()
+    p.init({"activation": "tanh"})
+    assert p.activation == "tanh"
+    with pytest.raises(ParamError):
+        p.init({"activation": "gelu"})
+
+
+def test_alias():
+    p = LearningParam()
+    p.init({"moniker": "alpha"})
+    assert p.name == "alpha"
+
+
+def test_unknown_rejected_and_allowed():
+    p = LearningParam()
+    with pytest.raises(ParamError):
+        p.init({"numhidden": 10})
+    unknown = p.init({"numhidden": 10, "num_hidden": 7}, allow_unknown=True)
+    assert unknown == {"numhidden": 10}
+    assert p.num_hidden == 7
+
+
+def test_required():
+    p = RequiredParam()
+    with pytest.raises(ParamError):
+        p.init({})
+    p.init({"size": 5})
+    assert p.size == 5 and p.scale == 1.0
+
+
+def test_dict_and_json_roundtrip():
+    p = LearningParam()
+    p.init({"num_hidden": 42, "activation": "sigmoid"})
+    d = p.to_dict()
+    assert d["num_hidden"] == 42
+    s = p.save_json()
+    q = LearningParam()
+    q.load_json(s)
+    assert q == p
+
+
+def test_stream_save_load():
+    p = LearningParam()
+    p.init({"num_hidden": 9})
+    buf = _io.BytesIO()
+    p.save(buf)
+    buf.seek(0)
+    q = LearningParam()
+    q.load(buf)
+    assert q.num_hidden == 9
+
+
+def test_docstring():
+    doc = LearningParam.doc_string()
+    assert "num_hidden" in doc and "range=[1, 10000]" in doc
+    assert "choices=['relu', 'tanh', 'sigmoid']" in doc
+
+
+def test_get_env(monkeypatch):
+    monkeypatch.setenv("DMLC_TEST_NUM", "17")
+    assert get_env("DMLC_TEST_NUM", 3) == 17
+    assert get_env("DMLC_TEST_MISSING", 3) == 3
+    monkeypatch.setenv("DMLC_TEST_FLAG", "true")
+    assert get_env("DMLC_TEST_FLAG", False) is True

@@ -1,0 +1,54 @@
+"""Registry tests (reference: ``test/unittest/unittest_registry.cc``)."""
+
+import pytest
+
+from dmlc_core_tpu.utils import DMLCError, Registry
+
+
+def test_register_and_find():
+    reg = Registry.get("TestTree")
+
+    @reg.register("binary", description="binary tree")
+    def make_binary():
+        return "binary-tree"
+
+    entry = reg.find("binary")
+    assert entry is not None
+    assert entry() == "binary-tree"
+    assert entry.description == "binary tree"
+    assert reg.find("missing") is None
+    with pytest.raises(KeyError):
+        reg["missing"]
+    reg.remove("binary")
+
+
+def test_alias_and_duplicate():
+    reg = Registry.get("TestAlias")
+
+    @reg.register("adam")
+    def make_adam():
+        return 1
+
+    reg.add_alias("adam", "adamw-ish")
+    assert reg["adamw-ish"]() == 1
+    with pytest.raises(DMLCError):
+        @reg.register("adam")
+        def make_adam2():
+            return 2
+    reg.remove("adam")
+    reg.remove("adamw-ish")
+
+
+def test_singleton_per_name():
+    assert Registry.get("A1") is Registry.get("A1")
+    assert Registry.get("A1") is not Registry.get("A2")
+
+
+def test_entry_metadata():
+    reg = Registry.get("TestMeta")
+    e = reg.register_entry(
+        __import__("dmlc_core_tpu.utils.registry", fromlist=["RegistryEntry"])
+        .RegistryEntry("thing", lambda: 3))
+    e.describe("a thing").add_argument("x", "int", "the x").set_return_type("int")
+    assert e.arguments[0]["name"] == "x"
+    reg.remove("thing")

@@ -1,0 +1,94 @@
+"""Serializer round-trip tests via in-memory streams
+(reference: ``test/unittest/unittest_serializer.cc:12-25``)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.utils import serializer as ser
+from dmlc_core_tpu.utils import DMLCError
+
+
+def roundtrip(obj):
+    buf = io.BytesIO()
+    ser.save(buf, obj)
+    buf.seek(0)
+    out = ser.load(buf)
+    assert buf.read() == b""  # fully consumed
+    return out
+
+
+@pytest.mark.parametrize("obj", [
+    None, True, False, 0, -1, 2**40, 3.25, float("inf"),
+    "", "héllo", b"\x00\xff\x01", [1, 2, 3], (4, "x"), {1, 2, 3},
+    {"a": 1, "b": [1.5, None]}, [[{"k": (1, 2)}], {"s": {3}}],
+])
+def test_scalar_container_roundtrip(obj):
+    assert roundtrip(obj) == obj
+
+
+def test_numpy_roundtrip():
+    for arr in [np.arange(12, dtype=np.float32).reshape(3, 4),
+                np.array([], dtype=np.uint64),
+                np.random.default_rng(0).random((5, 7)),
+                np.array([[1, 2], [3, 4]], dtype=np.int8)]:
+        out = roundtrip(arr)
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_nested_mixed():
+    obj = {"arrays": [np.ones(3, np.float32)], "meta": {"n": 3, "ok": True}}
+    out = roundtrip({"arrays": obj["arrays"], "meta": obj["meta"]})
+    np.testing.assert_array_equal(out["arrays"][0], obj["arrays"][0])
+    assert out["meta"] == obj["meta"]
+
+
+class Point:
+    """Serializable class (reference Serializable io.h:112)."""
+
+    def __init__(self, x=0, y=0):
+        self.x, self.y = x, y
+
+    def save(self, s):
+        ser.write_int64(s, self.x)
+        ser.write_int64(s, self.y)
+
+    def load(self, s):
+        self.x = ser.read_int64(s)
+        self.y = ser.read_int64(s)
+
+
+def test_saveload_class():
+    buf = io.BytesIO()
+    ser.save(buf, Point(3, -4))
+    buf.seek(0)
+    p = ser.load(buf, Point())
+    assert (p.x, p.y) == (3, -4)
+    buf.seek(0)
+    with pytest.raises(DMLCError):
+        ser.load(buf)  # needs target instance
+
+
+def test_truncated_stream_raises():
+    buf = io.BytesIO()
+    ser.save(buf, [1, 2, 3])
+    data = buf.getvalue()[:-3]
+    with pytest.raises(DMLCError):
+        ser.load(io.BytesIO(data))
+
+
+def test_scalar_helpers():
+    buf = io.BytesIO()
+    ser.write_uint32(buf, 7)
+    ser.write_uint64(buf, 2**63)
+    ser.write_int64(buf, -5)
+    ser.write_float64(buf, 1.5)
+    ser.write_string(buf, "abc")
+    buf.seek(0)
+    assert ser.read_uint32(buf) == 7
+    assert ser.read_uint64(buf) == 2**63
+    assert ser.read_int64(buf) == -5
+    assert ser.read_float64(buf) == 1.5
+    assert ser.read_string(buf) == "abc"
