@@ -1,0 +1,92 @@
+"""I/O layer: URI-addressed streams, filesystems, recordio codec, and the
+partition-correct InputSplit engine (reference ``src/io/``, SURVEY §2.2-2.3).
+
+Factory entry point :func:`create_input_split` mirrors reference
+``InputSplit::Create`` (`io.h:241-281`, impl `src/io.cc:70-119`):
+
+* ``type``: ``"text"``/``"line"`` (line records), ``"recordio"``,
+  ``"indexed_recordio"``, ``"stdin"``;
+* by default the split is wrapped in a background chunk-prefetch thread
+  (reference wraps ThreadedInputSplit when C++11, `io.cc:108-111`);
+* URI sugar: ``path?k=v#cachefile`` — a fragment selects an on-disk chunk
+  cache (reference `io.cc:109-113`), with per-partition suffixing;
+* ``shuffle=True`` over-partitions and visits sub-parts in random per-epoch
+  order (reference ``InputSplitShuffle::Create`` `input_split_shuffle.h:137`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import DMLCError, check
+from .uri import URI, URISpec
+from .filesys import (FileInfo, FileSystem, LocalFileSystem, FS_REGISTRY,
+                      get_filesystem, open_stream, open_seek_stream_for_read,
+                      list_directory_recursive)
+from .recordio import (KMAGIC, RecordIOWriter, RecordIOReader,
+                       RecordIOChunkReader, encode_lrec, decode_lrec)
+from .input_split import (InputSplit, InputSplitBase, LineSplitter,
+                          RecordIOSplitter, expand_uris)
+from .wrappers import ThreadedInputSplit, CachedInputSplit, ShuffleInputSplit
+from .indexed_recordio_split import IndexedRecordIOSplit, write_recordio_index
+from .single_file_split import SingleFileSplit
+
+__all__ = [
+    "URI", "URISpec", "FileInfo", "FileSystem", "LocalFileSystem",
+    "FS_REGISTRY", "get_filesystem", "open_stream",
+    "open_seek_stream_for_read", "list_directory_recursive",
+    "KMAGIC", "RecordIOWriter", "RecordIOReader", "RecordIOChunkReader",
+    "encode_lrec", "decode_lrec",
+    "InputSplit", "InputSplitBase", "LineSplitter", "RecordIOSplitter",
+    "ThreadedInputSplit", "CachedInputSplit", "ShuffleInputSplit",
+    "IndexedRecordIOSplit", "SingleFileSplit", "write_recordio_index",
+    "create_input_split", "expand_uris",
+]
+
+
+def create_input_split(uri: str, part_index: int = 0, num_parts: int = 1,
+                       split_type: str = "text", *, threaded: bool = True,
+                       shuffle: bool = False, num_shuffle_parts: int = 16,
+                       shuffle_seed: int = 0, index_uri: Optional[str] = None,
+                       batch_size: int = 256) -> InputSplit:
+    """Create a partitioned record stream (reference ``InputSplit::Create`` `io.h:241`)."""
+    spec = URISpec(uri, part_index, num_parts)
+    check(num_parts > 0 and 0 <= part_index < num_parts,
+          f"bad partition spec {part_index}/{num_parts}")
+
+    if split_type == "stdin" or spec.uri in ("stdin://", "-"):
+        return SingleFileSplit(spec.uri)
+
+    if split_type == "indexed_recordio":
+        idx = index_uri or spec.args.get("index")
+        if idx is None:
+            raise DMLCError("indexed_recordio requires index_uri or ?index= arg")
+        return IndexedRecordIOSplit(spec.uri, idx, part_index, num_parts,
+                                    shuffle=shuffle, seed=shuffle_seed,
+                                    batch_size=batch_size)
+
+    def make_base(pi: int, np_: int) -> InputSplitBase:
+        if split_type in ("text", "line"):
+            return LineSplitter(spec.uri, pi, np_)
+        if split_type == "recordio":
+            return RecordIOSplitter(spec.uri, pi, np_)
+        raise DMLCError(f"unknown InputSplit type {split_type!r}")
+
+    if shuffle:
+        base = make_base(part_index * num_shuffle_parts,
+                         num_parts * num_shuffle_parts)
+        split: InputSplit = ShuffleInputSplit(
+            base, part_index, num_parts,
+            num_shuffle_parts=num_shuffle_parts, seed=shuffle_seed)
+    else:
+        split = make_base(part_index, num_parts)
+
+    if spec.cache_file is not None:
+        if shuffle:
+            raise DMLCError("#cachefile cannot be combined with shuffle "
+                            "(the cache wrapper does not repartition; "
+                            "reference cached_input_split.h:87)")
+        return CachedInputSplit(split, spec.cache_file)
+    if threaded:
+        return ThreadedInputSplit(split)
+    return split

@@ -1,0 +1,400 @@
+"""Partition-correct record splitting — capability parity with reference
+``src/io/input_split_base.{h,cc}``, ``line_split.{h,cc}``,
+``recordio_split.{h,cc}``.
+
+Core invariant (reference ``ResetPartition`` `input_split_base.cc:30-64`):
+given N partitions over the concatenated byte space of all matched files, the
+provisional byte ranges ``[k*step, (k+1)*step)`` are *realigned* so both ends
+land on record-begin boundaries, using the same boundary-seek function for
+begin and end.  Hence partition k's range is
+``[seek(k*step), seek((k+1)*step))`` — the union over k covers every record
+exactly once, with no record split or duplicated (off-by-one here is silent
+data loss; property-tested in tests/test_input_split.py).
+
+Boundary rules:
+
+* a file start is always a record begin (records never span files);
+* line records: the next record begins after the next ``\\n``
+  (`line_split.cc:9-26`); a record beginning exactly at the probe offset
+  belongs to the *previous* partition (consistent on both ends);
+* recordio records: the next record begins at the next 4-aligned magic word
+  whose frame cflag ∈ {0, 1} (`recordio_split.cc:9-42`) — a frame starting
+  exactly at the probe offset starts *this* partition (again consistent).
+
+Chunk reads return blobs containing only whole records, found by scanning the
+tail for the last record begin and carrying the remainder as overflow
+(`input_split_base.cc:211-239`); since both partition ends are record
+boundaries, the partition byte range itself contains exactly whole records.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import DMLCError, check
+from .filesys import (FileInfo, FileSystem, get_filesystem,
+                      list_directory_recursive)
+from .recordio import KMAGIC, _MAGIC_BYTES, decode_lrec
+from .uri import URI
+
+__all__ = ["InputSplit", "InputSplitBase", "LineSplitter", "RecordIOSplitter",
+           "expand_uris"]
+
+_NEWLINE = (0x0A, 0x0D)  # \n \r
+
+
+def expand_uris(uri: str, fs_hint: Optional[FileSystem] = None) -> List[FileInfo]:
+    """Expand ``;``-separated paths, ``*``/``?`` wildcards and directories
+    (recursively) into a flat file list
+    (reference ``ConvertToURIs``/``InitInputFileInfo`` `input_split_base.cc:96-175`).
+    Zero-size files are skipped (they hold no records)."""
+    out: List[FileInfo] = []
+    for piece in uri.split(";"):
+        if not piece:
+            continue
+        u = URI(piece)
+        fs = fs_hint or get_filesystem(u)
+        if ("*" in piece or "?" in piece) and hasattr(fs, "glob"):
+            paths = fs.glob(u.name if u.protocol else piece)
+            if not paths:
+                raise DMLCError(f"InputSplit: pattern {piece!r} matched no files")
+            for p in paths:
+                info = fs.get_path_info(URI(p))
+                if info.type == "dir":
+                    out.extend(list_directory_recursive(fs, URI(p)))
+                else:
+                    out.append(info)
+        else:
+            info = fs.get_path_info(u)
+            if info.type == "dir":
+                out.extend(list_directory_recursive(fs, u))
+            else:
+                out.append(info)
+    files = [f for f in out if f.size > 0]
+    if not files:
+        raise DMLCError(f"InputSplit: no non-empty files matched {uri!r}")
+    return files
+
+
+class InputSplit:
+    """Abstract record-stream interface (reference ``InputSplit`` `io.h:135-281`).
+
+    ``extract_records`` is part of the contract: it is the record grammar that
+    lets wrappers (threaded/cached) iterate single records out of the whole-
+    record chunks any split produces.  Wrappers delegate it to their base.
+    """
+
+    def next_record(self) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def next_chunk(self) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def extract_records(self, chunk: bytes, pos: int) -> Tuple[Optional[bytes], int]:
+        """Extract one record starting at pos; return (record, new_pos) or
+        (None, pos) at chunk end."""
+        raise NotImplementedError
+
+    # -- shared chunk→record iteration state used by base + wrappers --
+    def _reset_record_iter(self) -> None:
+        self._ri_chunk: Optional[bytes] = None
+        self._ri_pos = 0
+
+    def _next_record_via(self, next_chunk_fn, extractor) -> Optional[bytes]:
+        if not hasattr(self, "_ri_pos"):
+            self._reset_record_iter()
+        while True:
+            if self._ri_chunk is not None:
+                rec, new_pos = extractor(self._ri_chunk, self._ri_pos)
+                if rec is not None:
+                    self._ri_pos = new_pos
+                    return rec
+            chunk = next_chunk_fn()
+            if chunk is None:
+                return None
+            self._ri_chunk = chunk
+            self._ri_pos = 0
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise NotImplementedError
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        pass
+
+    def __iter__(self):
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class InputSplitBase(InputSplit):
+    """Multi-file byte-range partitioning engine (reference `input_split_base.cc`)."""
+
+    KBUFFER_SIZE = 2 << 20  # 2MiB default chunk (reference `input_split_base.h:40`)
+    align_bytes = 1
+
+    def __init__(self, uri: str, part_index: int, num_parts: int):
+        self.uri = uri
+        self.files = expand_uris(uri)
+        sizes = np.array([f.size for f in self.files], dtype=np.int64)
+        # cumulative start offset of each file in the global byte space
+        # (reference `Init` `input_split_base.cc:13-28`)
+        self.file_offset = np.concatenate([[0], np.cumsum(sizes)])
+        self.total_size = int(self.file_offset[-1])
+        self.chunk_size = self.KBUFFER_SIZE
+        self._fs = get_filesystem(URI(self.files[0].path))
+        self._open_file_index: Optional[int] = None
+        self._open_stream = None
+        self.reset_partition(part_index, num_parts)
+
+    # ---- virtual boundary functions ----
+    def seek_record_begin(self, data: bytes, from_pos: int) -> Optional[int]:
+        """Offset (within data, >= from_pos) of the next record begin assuming
+        ``data[from_pos]`` may be mid-record; None if not found in data."""
+        raise NotImplementedError
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        """Offset of the last record begin in data (0 if only one record begins
+        at 0; data[0] is guaranteed to be a record begin)."""
+        raise NotImplementedError
+
+    # ---- partitioning ----
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        check(0 <= part_index < num_parts,
+              f"bad partition {part_index}/{num_parts}")
+        nstep = (self.total_size + num_parts - 1) // num_parts
+        a = self.align_bytes
+        pbegin = min(nstep * part_index // a * a, self.total_size)
+        pend = min(nstep * (part_index + 1) // a * a, self.total_size)
+        self.begin = self._adjust_to_record_begin(pbegin)
+        self.end = self._adjust_to_record_begin(pend)
+        self.part_index, self.num_parts = part_index, num_parts
+        self.before_first()
+
+    def _adjust_to_record_begin(self, pos: int) -> int:
+        """Realign a provisional offset to the next record-begin boundary
+        (reference `input_split_base.cc:30-64` via SeekRecordBegin)."""
+        if pos <= 0:
+            return 0
+        if pos >= self.total_size:
+            return self.total_size
+        # file starts are record begins
+        fidx = int(np.searchsorted(self.file_offset, pos, side="right")) - 1
+        if self.file_offset[fidx] == pos:
+            return pos
+        file_end = int(self.file_offset[fidx + 1])
+        # scan forward within this file only (records never span files)
+        scan_pos = pos
+        step = 64 << 10
+        carry = b""
+        carry_base = pos
+        while scan_pos < file_end:
+            data = carry + self._pread(scan_pos, min(step, file_end - scan_pos))
+            found = self.seek_record_begin(data, 0)
+            if found is not None:
+                return carry_base + found
+            # keep a small tail so multi-byte boundaries spanning the block
+            # edge are found (recordio header = 8 bytes)
+            keep = min(len(data), 8)
+            carry = data[len(data) - keep:]
+            scan_pos += min(step, file_end - scan_pos)
+            carry_base = scan_pos - keep
+        return file_end
+
+    # ---- raw cross-file reads ----
+    def _pread(self, offset: int, size: int) -> bytes:
+        """Read ``size`` bytes at global ``offset``, crossing file boundaries
+        (reference ``Read`` `input_split_base.cc:177-209`)."""
+        out = bytearray()
+        remaining = size
+        while remaining > 0 and offset < self.total_size:
+            fidx = int(np.searchsorted(self.file_offset, offset, side="right")) - 1
+            in_file = offset - int(self.file_offset[fidx])
+            n = min(remaining, int(self.file_offset[fidx + 1]) - offset)
+            stream = self._stream_for(fidx)
+            stream.seek(in_file)
+            data = stream.read(n)
+            if len(data) != n:
+                raise DMLCError(
+                    f"short read from {self.files[fidx].path}: wanted {n}, got {len(data)}")
+            out += data
+            offset += n
+            remaining -= n
+        return bytes(out)
+
+    def _stream_for(self, fidx: int):
+        if self._open_file_index != fidx:
+            if self._open_stream is not None:
+                self._open_stream.close()
+            self._open_stream = self._fs.open_for_read(URI(self.files[fidx].path))
+            self._open_file_index = fidx
+        return self._open_stream
+
+    # ---- chunked whole-record reads ----
+    def before_first(self) -> None:
+        self._cur = self.begin
+        self._overflow = b""
+        self._reset_record_iter()
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self.chunk_size = max(chunk_size, 1 << 10)
+
+    def next_chunk(self) -> Optional[bytes]:
+        """Next blob of whole records (reference ``NextChunkEx``/``ReadChunk``
+        `input_split_base.cc:211-258`)."""
+        while True:
+            if self._cur >= self.end and not self._overflow:
+                return None
+            want = min(self.chunk_size, self.end - self._cur)
+            data = self._overflow + self._pread(self._cur, want)
+            self._cur += want
+            if self._cur >= self.end:
+                # partition range holds exactly whole records: flush all
+                self._overflow = b""
+                return data if data else None
+            cut = self.find_last_record_begin(data)
+            if cut == 0:
+                # no record boundary inside the buffer: grow and retry
+                # (reference Chunk doubling growth `input_split_base.cc:241-279`)
+                self._overflow = data
+                self.chunk_size *= 2
+                continue
+            self._overflow = data[cut:]
+            return data[:cut]
+
+    def next_record(self) -> Optional[bytes]:
+        """Iterate single records over chunks (reference ``NextRecord`` path)."""
+        return self._next_record_via(self.next_chunk, self.extract_records)
+
+    def close(self) -> None:
+        if self._open_stream is not None:
+            self._open_stream.close()
+            self._open_stream = None
+            self._open_file_index = None
+
+
+class LineSplitter(InputSplitBase):
+    """Records are text lines (reference `line_split.{h,cc}`).
+
+    A record is a maximal run of non-newline bytes; ``\\r``/``\\n`` runs
+    separate records (so ``\\r\\n`` yields one boundary and empty lines produce
+    no records, matching the reference's extract semantics
+    `line_split.cc:36-55`).
+    """
+
+    align_bytes = 1
+
+    @staticmethod
+    def _find_newline(data: bytes, pos: int) -> int:
+        """Offset of the first \\n or \\r at/after pos, or -1."""
+        ln = data.find(b"\n", pos)
+        lr = data.find(b"\r", pos)
+        if ln < 0:
+            return lr
+        if lr < 0:
+            return ln
+        return min(ln, lr)
+
+    def seek_record_begin(self, data: bytes, from_pos: int) -> Optional[int]:
+        # consume to the first newline, then skip the newline run
+        i = self._find_newline(data, from_pos)
+        if i < 0:
+            return None
+        n = len(data)
+        while i < n and data[i] in _NEWLINE:
+            i += 1
+        return i if i < n else None
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        cut = max(data.rfind(b"\n"), data.rfind(b"\r"))
+        return cut + 1 if cut >= 0 else 0
+
+    def extract_records(self, chunk: bytes, pos: int) -> Tuple[Optional[bytes], int]:
+        n = len(chunk)
+        # skip leading newline run
+        while pos < n and chunk[pos] in _NEWLINE:
+            pos += 1
+        if pos >= n:
+            return None, pos
+        end = self._find_newline(chunk, pos)
+        if end < 0:
+            end = n
+        return chunk[pos:end], end
+
+
+class RecordIOSplitter(InputSplitBase):
+    """Records are recordio frames (reference `recordio_split.{h,cc}`).
+
+    ``next_record`` returns the *payload* with multi-part records rejoined
+    (reference `recordio_split.cc:44-82`); ``next_chunk`` returns raw frame
+    blobs suitable for :class:`~dmlc_core_tpu.io.recordio.RecordIOChunkReader`.
+    """
+
+    align_bytes = 4
+
+    def seek_record_begin(self, data: bytes, from_pos: int) -> Optional[int]:
+        pos = (from_pos + 3) & ~3
+        n = len(data)
+        while pos + 8 <= n:
+            if data[pos:pos + 4] == _MAGIC_BYTES:
+                cflag, _ = decode_lrec(
+                    int.from_bytes(data[pos + 4:pos + 8], "little"))
+                if cflag in (0, 1):
+                    return pos
+            pos += 4
+        return None
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        lower = len(data) & ~3
+        if lower < 8:
+            return 0
+        words = np.frombuffer(data, dtype="<u4", count=lower // 4)
+        magic_at = np.nonzero(words[:-1] == KMAGIC)[0]
+        for w in reversed(magic_at):
+            cflag = int(words[w + 1]) >> 29
+            if cflag in (0, 1):
+                return int(w) * 4
+        return 0
+
+    def extract_records(self, chunk: bytes, pos: int) -> Tuple[Optional[bytes], int]:
+        n = len(chunk)
+        if pos + 8 > n:
+            return None, pos
+        parts: List[bytes] = []
+        while True:
+            if chunk[pos:pos + 4] != _MAGIC_BYTES:
+                raise DMLCError("recordio split: lost frame alignment")
+            cflag, length = decode_lrec(
+                int.from_bytes(chunk[pos + 4:pos + 8], "little"))
+            upper = (length + 3) & ~3
+            if pos + 8 + upper > n:
+                raise DMLCError("recordio split: truncated frame in chunk")
+            content = chunk[pos + 8:pos + 8 + length]
+            pos += 8 + upper
+            if cflag == 0:
+                return content, pos
+            if cflag == 1:
+                parts = [content]
+            elif cflag in (2, 3):
+                parts.append(_MAGIC_BYTES)
+                parts.append(content)
+                if cflag == 3:
+                    return b"".join(parts), pos
+            else:
+                raise DMLCError(f"recordio split: bad cflag {cflag}")

@@ -1,0 +1,194 @@
+"""InputSplit partition-coverage tests — mirrors reference
+``split_repeat_read_test.cc`` / ``split_test.cc``: for every nsplit, the
+concatenation of all partitions equals the whole file's records, each exactly
+once."""
+
+import os
+import random
+
+import pytest
+
+from dmlc_core_tpu.io import (URI, URISpec, create_input_split, expand_uris,
+                              open_stream)
+from dmlc_core_tpu.utils import DMLCError
+
+
+def write_lines(path, lines, newline=b"\n"):
+    with open(path, "wb") as f:
+        for ln in lines:
+            f.write(ln + newline)
+
+
+@pytest.fixture()
+def text_corpus(tmp_path):
+    rng = random.Random(7)
+    lines = [("line%06d:" % i + "x" * rng.randrange(0, 120)).encode()
+             for i in range(2000)]
+    path = tmp_path / "data.txt"
+    write_lines(path, lines)
+    return str(path), lines
+
+
+def test_line_partition_union(text_corpus):
+    path, lines = text_corpus
+    for nparts in (1, 2, 3, 5, 16):
+        got = []
+        for k in range(nparts):
+            with create_input_split(path, k, nparts, "text",
+                                    threaded=False) as s:
+                got.extend(s)
+        assert got == lines, f"nparts={nparts}"
+
+
+def test_line_partition_union_no_trailing_newline(tmp_path):
+    lines = [b"aaa", b"bb", b"cccc", b"d"]
+    path = tmp_path / "nofinalnl.txt"
+    with open(path, "wb") as f:
+        f.write(b"\n".join(lines))  # no trailing newline
+    for nparts in (1, 2, 3, 4):
+        got = []
+        for k in range(nparts):
+            with create_input_split(str(path), k, nparts, "text",
+                                    threaded=False) as s:
+                got.extend(s)
+        assert got == lines
+
+
+def test_crlf_and_empty_lines(tmp_path):
+    raw = b"a\r\nb\n\nc\r\rd\ne\n"
+    path = tmp_path / "crlf.txt"
+    with open(path, "wb") as f:
+        f.write(raw)
+    expected = [b"a", b"b", b"c", b"d", b"e"]
+    for nparts in (1, 2, 3):
+        got = []
+        for k in range(nparts):
+            with create_input_split(str(path), k, nparts, "text",
+                                    threaded=False) as s:
+                got.extend(s)
+        assert got == expected
+
+
+def test_multifile_and_wildcard(tmp_path):
+    all_lines = []
+    for i in range(4):
+        lines = [f"f{i}l{j}".encode() for j in range(50)]
+        write_lines(tmp_path / f"part-{i}.txt", lines)
+        all_lines.extend(lines)
+    # wildcard
+    got = []
+    for k in range(3):
+        with create_input_split(str(tmp_path / "part-*.txt"), k, 3, "text",
+                                threaded=False) as s:
+            got.extend(s)
+    assert got == all_lines
+    # directory expansion
+    with create_input_split(str(tmp_path), 0, 1, "text", threaded=False) as s:
+        assert list(s) == all_lines
+    # ';' separated
+    uri = ";".join(str(tmp_path / f"part-{i}.txt") for i in range(4))
+    with create_input_split(uri, 0, 1, "text", threaded=False) as s:
+        assert list(s) == all_lines
+
+
+def test_more_parts_than_records(tmp_path):
+    lines = [b"only", b"three", b"lines"]
+    path = tmp_path / "tiny.txt"
+    write_lines(path, lines)
+    got = []
+    for k in range(10):
+        with create_input_split(str(path), k, 10, "text", threaded=False) as s:
+            got.extend(s)
+    assert got == lines
+
+
+def test_chunk_iteration_covers_all(text_corpus):
+    path, lines = text_corpus
+    total = b"".join(ln + b"\n" for ln in lines)
+    got = b""
+    for k in range(4):
+        with create_input_split(path, k, 4, "text", threaded=False) as s:
+            s.hint_chunk_size(4096)
+            while True:
+                c = s.next_chunk()
+                if c is None:
+                    break
+                got += c
+    assert got == total
+
+
+def test_reset_partition_and_before_first(text_corpus):
+    path, lines = text_corpus
+    with create_input_split(path, 0, 2, "text", threaded=False) as s:
+        first = list(s)
+        s.before_first()
+        assert list(s) == first
+        s.reset_partition(1, 2)
+        second = list(s)
+        assert first + second == lines
+
+
+def test_shuffle_covers_all_and_reorders(text_corpus):
+    path, lines = text_corpus
+    with create_input_split(path, 0, 1, "text", shuffle=True,
+                            num_shuffle_parts=8, shuffle_seed=3,
+                            threaded=False) as s:
+        ep1 = list(s)
+        s.before_first()
+        ep2 = list(s)
+    assert sorted(ep1) == sorted(lines)
+    assert sorted(ep2) == sorted(lines)
+    assert ep1 != lines  # sub-part order shuffled
+    assert ep1 != ep2    # reshuffled per epoch
+
+
+def test_cached_split(tmp_path, text_corpus):
+    path, lines = text_corpus
+    cache = tmp_path / "c.cache"
+    uri = f"{path}#{cache}"
+    with create_input_split(uri, 0, 1, "text") as s:
+        ep1 = list(s)
+        s.before_first()
+        ep2 = list(s)  # replayed from cache
+    assert ep1 == lines and ep2 == lines
+    assert os.path.exists(str(cache) + ".done")
+    # second instance reads only the cache
+    with create_input_split(uri, 0, 1, "text") as s:
+        assert list(s) == lines
+
+
+def test_uri_spec():
+    spec = URISpec("hdfs://nn/data.txt?format=libsvm&x=1#cachef", 2, 4)
+    assert spec.uri == "hdfs://nn/data.txt"
+    assert spec.args == {"format": "libsvm", "x": "1"}
+    assert spec.cache_file == "cachef.split4.part2"
+    u = URI("s3://bucket/key/a.txt")
+    assert (u.scheme, u.host, u.name) == ("s3", "bucket", "/key/a.txt")
+    u2 = URI("/local/path.txt")
+    assert u2.protocol == "" and u2.name == "/local/path.txt"
+
+
+def test_expand_errors(tmp_path):
+    with pytest.raises(DMLCError):
+        expand_uris(str(tmp_path / "missing-*.txt"))
+    with pytest.raises(DMLCError):
+        create_input_split(str(tmp_path / "nope.txt"), 0, 1, "text")
+
+
+def test_shuffle_with_threaded_wrapper(text_corpus):
+    # regression: shuffle=True with the default threaded=True must work
+    path, lines = text_corpus
+    with create_input_split(path, 0, 1, "text", shuffle=True,
+                            shuffle_seed=2) as s:
+        ep1 = list(s)
+        s.before_first()
+        ep2 = list(s)
+    assert sorted(ep1) == sorted(lines) == sorted(ep2)
+    assert ep1 != ep2
+
+
+def test_threaded_equals_unthreaded(text_corpus):
+    path, lines = text_corpus
+    with create_input_split(path, 1, 3, "text", threaded=True) as t, \
+         create_input_split(path, 1, 3, "text", threaded=False) as u:
+        assert list(t) == list(u)
