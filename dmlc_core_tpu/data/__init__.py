@@ -1,0 +1,17 @@
+"""Data layer: format parsers → sparse CSR RowBlock batches
+(reference ``src/data/`` + ``include/dmlc/data.h``, SURVEY §2.4)."""
+
+from .row_block import RowBlock, RowBlockContainer  # noqa: F401
+from .parser import (ParserBase, TextParser, ThreadedParser, create_parser,  # noqa: F401
+                     PARSER_REGISTRY, CSVParserParam)
+from .iterators import (RowBlockIter, BasicRowIter, DiskRowIter,  # noqa: F401
+                        create_row_block_iter)
+from . import py_parsers  # noqa: F401
+
+__all__ = [
+    "RowBlock", "RowBlockContainer",
+    "ParserBase", "TextParser", "ThreadedParser", "create_parser",
+    "PARSER_REGISTRY", "CSVParserParam",
+    "RowBlockIter", "BasicRowIter", "DiskRowIter", "create_row_block_iter",
+    "py_parsers",
+]

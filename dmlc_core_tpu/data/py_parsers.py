@@ -1,0 +1,120 @@
+"""Pure-Python/numpy fallback parsers — same output contract as the native
+library (:mod:`dmlc_core_tpu.native`), used when ``libdmlc_native.so`` is not
+built.  Semantics mirror reference ``libsvm_parser.h`` / ``libfm_parser.h`` /
+``csv_parser.h``; performance is secondary here (the native path is the hot
+one; see SURVEY §2.4)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["parse_libsvm", "parse_libfm", "parse_csv"]
+
+
+def _finish(offsets, labels, weights, indices, values, fields, bad) -> Dict:
+    out = {
+        "offsets": np.asarray(offsets, np.int64),
+        "labels": np.asarray(labels, np.float32),
+        "weights": np.asarray(weights, np.float32),
+        "indices": np.asarray(indices, np.uint64),
+        "values": np.asarray(values, np.float32),
+        "max_index": int(max(indices)) if indices else 0,
+        "bad_lines": bad,
+    }
+    if fields is not None:
+        out["fields"] = np.asarray(fields, np.uint32)
+        out["max_field"] = int(max(fields)) if fields else 0
+    else:
+        out["max_field"] = 0
+    return out
+
+
+def _parse_sparse(data: bytes, with_fields: bool) -> Dict:
+    offsets = [0]
+    labels: list = []
+    weights: list = []
+    indices: list = []
+    values: list = []
+    fields: Optional[list] = [] if with_fields else None
+    bad = 0
+    for line in data.splitlines():
+        toks = line.split()
+        if not toks:
+            continue
+        head = toks[0].split(b":")
+        try:
+            label = float(head[0])
+            weight = float(head[1]) if len(head) > 1 else 1.0
+        except ValueError:
+            bad += 1
+            continue
+        labels.append(label)
+        weights.append(weight)
+        n = 0
+        for tok in toks[1:]:
+            parts = tok.split(b":")
+            try:
+                if with_fields:
+                    if len(parts) != 3:
+                        raise ValueError(tok)
+                    fields.append(int(parts[0]))
+                    indices.append(int(parts[1]))
+                    values.append(float(parts[2]))
+                else:
+                    indices.append(int(parts[0]))
+                    # value-less token 'idx' → implicit 1.0 (reference
+                    # libsvm_parser.h ParsePair r==1 path)
+                    values.append(float(parts[1]) if len(parts) > 1 else 1.0)
+            except ValueError:
+                bad += 1
+                break
+            n += 1
+        offsets.append(offsets[-1] + n)
+    return _finish(offsets, labels, weights, indices, values, fields, bad)
+
+
+def parse_libsvm(data: bytes, nthreads: int = 0) -> Dict:
+    return _parse_sparse(data, with_fields=False)
+
+
+def parse_libfm(data: bytes, nthreads: int = 0) -> Dict:
+    return _parse_sparse(data, with_fields=True)
+
+
+def parse_csv(data: bytes, label_col: int = -1, delim: str = ",",
+              nthreads: int = 0) -> Dict:
+    d = delim.encode()
+    offsets = [0]
+    labels: list = []
+    weights: list = []
+    indices: list = []
+    values: list = []
+    bad = 0
+    for line in data.splitlines():
+        if not line.strip():
+            continue
+        cols = line.split(d)
+        row_vals = []
+        label = 0.0
+        ok = True
+        for ci, tok in enumerate(cols):
+            try:
+                v = float(tok) if tok.strip() else 0.0
+            except ValueError:
+                ok = False
+                break
+            if ci == label_col:
+                label = v
+            else:
+                row_vals.append(v)
+        if not ok:
+            bad += 1
+            continue
+        labels.append(label)
+        weights.append(1.0)
+        indices.extend(range(len(row_vals)))
+        values.extend(row_vals)
+        offsets.append(offsets[-1] + len(row_vals))
+    return _finish(offsets, labels, weights, indices, values, None, bad)

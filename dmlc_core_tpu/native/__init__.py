@@ -1,0 +1,157 @@
+"""ctypes binding to the native C++ parse library, with transparent fallback.
+
+The reference keeps its parse hot loops native (``src/data/strtonum.h``,
+OpenMP chunk-parallel ``text_parser.h:100-115``); here the same role is played
+by ``libdmlc_native.so`` built from ``dmlc_native.cpp``.  Python callers use
+:func:`parse_libsvm` / :func:`parse_libfm` / :func:`parse_csv`, which return
+numpy CSR arrays; when the shared library is missing the pure-numpy fallbacks
+in :mod:`dmlc_core_tpu.data.py_parsers` are used instead (same results,
+slower).  Build with ``python -m dmlc_core_tpu.native.build``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libdmlc_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+
+
+class _CSRBlockC(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("n_values", ctypes.c_int64),
+        ("offsets", ctypes.POINTER(ctypes.c_int64)),
+        ("labels", ctypes.POINTER(ctypes.c_float)),
+        ("weights", ctypes.POINTER(ctypes.c_float)),
+        ("indices", ctypes.POINTER(ctypes.c_uint64)),
+        ("values", ctypes.POINTER(ctypes.c_float)),
+        ("fields", ctypes.POINTER(ctypes.c_uint32)),
+        ("max_index", ctypes.c_uint64),
+        ("max_field", ctypes.c_uint32),
+        ("bad_lines", ctypes.c_int64),
+    ]
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        for name in ("dmlc_parse_libsvm", "dmlc_parse_libfm"):
+            fn = getattr(lib, name)
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+                           ctypes.POINTER(_CSRBlockC)]
+            fn.restype = ctypes.c_int
+        lib.dmlc_parse_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_char,
+            ctypes.c_int, ctypes.POINTER(_CSRBlockC)]
+        lib.dmlc_parse_csv.restype = ctypes.c_int
+        lib.dmlc_free_block.argtypes = [ctypes.POINTER(_CSRBlockC)]
+        lib.dmlc_free_block.restype = None
+        lib.dmlc_num_threads.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native shared library is built and loadable."""
+    return _load() is not None
+
+
+def build(verbose: bool = False) -> bool:
+    """Compile the shared library in-place; returns success."""
+    from .build import build_native
+    ok = build_native(verbose=verbose)
+    global _lib
+    with _lib_lock:
+        _lib = None  # force reload
+    return ok
+
+
+class _NativeBlockOwner:
+    """Owns a C-allocated CSR block; frees it when the last numpy view dies."""
+
+    def __init__(self, lib: ctypes.CDLL, blk: _CSRBlockC):
+        self._lib = lib
+        self._blk = blk
+
+    def __del__(self):
+        try:
+            self._lib.dmlc_free_block(ctypes.byref(self._blk))
+        except Exception:
+            pass
+
+
+def _wrap_zero_copy(ptr, count: int, dtype, owner: _NativeBlockOwner) -> np.ndarray:
+    """numpy view over native memory; lifetime chained to ``owner`` via the
+    view's base object (no memcpy — the 'zero-copy numpy wrapping' the C ABI
+    is designed for)."""
+    if count == 0 or not ptr:
+        return np.empty(0, dtype)
+    nbytes = count * np.dtype(dtype).itemsize
+    buf = (ctypes.c_char * nbytes).from_address(
+        ctypes.cast(ptr, ctypes.c_void_p).value)
+    buf._dmlc_owner = owner  # keeps the C allocation alive with the view
+    return np.frombuffer(buf, dtype=dtype)
+
+
+def _block_to_numpy(lib: ctypes.CDLL, blk: _CSRBlockC,
+                    want_fields: bool) -> Dict[str, np.ndarray]:
+    n, m = blk.n_rows, blk.n_values
+    owner = _NativeBlockOwner(lib, blk)
+    out = {
+        "offsets": _wrap_zero_copy(blk.offsets, n + 1, np.int64, owner),
+        "labels": _wrap_zero_copy(blk.labels, n, np.float32, owner),
+        "weights": _wrap_zero_copy(blk.weights, n, np.float32, owner),
+        "indices": _wrap_zero_copy(blk.indices, m, np.uint64, owner),
+        "values": _wrap_zero_copy(blk.values, m, np.float32, owner),
+        "max_index": int(blk.max_index),
+        "max_field": int(blk.max_field),
+        "bad_lines": int(blk.bad_lines),
+    }
+    if want_fields:
+        out["fields"] = _wrap_zero_copy(blk.fields, m, np.uint32, owner)
+    return out
+
+
+def _run_parse(fn_name: str, data: bytes, want_fields: bool, *extra) -> Optional[Dict[str, np.ndarray]]:
+    lib = _load()
+    if lib is None:
+        return None
+    blk = _CSRBlockC()
+    fn = getattr(lib, fn_name)
+    rc = fn(data, len(data), *extra, ctypes.byref(blk))
+    if rc != 0:
+        # free whatever was allocated before the failure (free(NULL) is safe)
+        lib.dmlc_free_block(ctypes.byref(blk))
+        raise MemoryError(f"{fn_name} failed with code {rc}")
+    return _block_to_numpy(lib, blk, want_fields)
+
+
+def parse_libsvm(data: bytes, nthreads: int = 0) -> Optional[Dict[str, np.ndarray]]:
+    """Parse libsvm text → CSR dict, or None if native lib unavailable."""
+    return _run_parse("dmlc_parse_libsvm", data, False, nthreads)
+
+
+def parse_libfm(data: bytes, nthreads: int = 0) -> Optional[Dict[str, np.ndarray]]:
+    return _run_parse("dmlc_parse_libfm", data, True, nthreads)
+
+
+def parse_csv(data: bytes, label_col: int = -1, delim: str = ",",
+              nthreads: int = 0) -> Optional[Dict[str, np.ndarray]]:
+    return _run_parse("dmlc_parse_csv", data, False, label_col,
+                      delim.encode()[:1], nthreads)

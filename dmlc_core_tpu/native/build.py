@@ -1,0 +1,44 @@
+"""Build the native parse library in-place with g++.
+
+Usage: ``python -m dmlc_core_tpu.native.build``
+
+No external build system needed (the reference ships Makefile/CMake; a single
+translation unit keeps this trivial).  OpenMP is used when available.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_HERE, "dmlc_native.cpp")
+OUT = os.path.join(_HERE, "libdmlc_native.so")
+
+
+def build_native(verbose: bool = False) -> bool:
+    flags = ["-O3", "-std=c++17", "-shared", "-fPIC", "-march=native", "-fopenmp"]
+    cmd = ["g++", *flags, SRC, "-o", OUT]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        if verbose:
+            print(f"native build failed to run: {e}", file=sys.stderr)
+        return False
+    if proc.returncode != 0:
+        # retry without -march=native / -fopenmp for conservative toolchains
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", SRC, "-o", OUT]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        if verbose:
+            print(proc.stderr, file=sys.stderr)
+        return False
+    if verbose:
+        print(f"built {OUT}")
+    return True
+
+
+if __name__ == "__main__":
+    ok = build_native(verbose=True)
+    sys.exit(0 if ok else 1)

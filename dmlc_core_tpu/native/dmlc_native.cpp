@@ -1,0 +1,391 @@
+// Native hot paths for dmlc_core_tpu: text→CSR parsers with OpenMP
+// chunk-parallelism and branch-light number scanning.
+//
+// Capability parity with the reference's native parse stack:
+//   * strtonum.h:37-150   — branch-light strtof/strtoint (no INF/NAN/hex)
+//   * text_parser.h:90-118 — chunk divided among threads at line boundaries
+//   * libsvm_parser.h:36-90 — "label[:weight] idx:val..." records
+//   * libfm_parser.h:36-93  — "label[:weight] field:idx:val..." records
+//   * csv_parser.h:63-102   — dense rows, configurable label column
+//
+// This is a fresh implementation in C++17 for the TPU framework's host-side
+// ingest; the output is one CSR block (offsets/labels/weights/indices/values
+// [+fields]) handed to Python via a C ABI for zero-copy numpy wrapping, then
+// staged to TPU HBM by the pipeline layer.
+//
+// Build: g++ -O3 -std=c++17 -fopenmp -shared -fPIC dmlc_native.cpp -o libdmlc_native.so
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+// ---------------- branch-light scanners ----------------
+
+inline bool is_space(char c) { return c == ' ' || c == '\t'; }
+inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+
+// Fast float parse: sign, integer, fraction, exponent. Returns chars consumed
+// (0 on failure). Mirrors the capability of reference strtonum.h:37 (no
+// INF/NAN/hex support — data files never contain them).
+inline int parse_float(const char* p, const char* end, float* out) {
+  const char* s = p;
+  if (p == end) return 0;
+  double sign = 1.0;
+  if (*p == '-') { sign = -1.0; ++p; }
+  else if (*p == '+') { ++p; }
+  double v = 0.0;
+  bool any = false;
+  while (p != end && is_digit(*p)) { v = v * 10.0 + (*p - '0'); ++p; any = true; }
+  if (p != end && *p == '.') {
+    ++p;
+    double scale = 0.1;
+    while (p != end && is_digit(*p)) { v += (*p - '0') * scale; scale *= 0.1; ++p; any = true; }
+  }
+  if (!any) return 0;
+  if (p != end && (*p == 'e' || *p == 'E')) {
+    const char* mark = p;
+    ++p;
+    int esign = 1;
+    if (p != end && (*p == '-' || *p == '+')) { if (*p == '-') esign = -1; ++p; }
+    int e = 0;
+    bool eany = false;
+    // saturate: |exp| > 60 already over/underflows float32, and an unbounded
+    // accumulator would be UB / a DoS on hostile exponents like 1e1000000000
+    while (p != end && is_digit(*p)) {
+      if (e < 1000) e = e * 10 + (*p - '0');
+      ++p;
+      eany = true;
+    }
+    if (!eany) { p = mark; }
+    else {
+      if (e > 60) e = 60;
+      double f = 1.0;
+      double base = esign > 0 ? 10.0 : 0.1;
+      for (int i = 0; i < e; ++i) f *= base;
+      v *= f;
+    }
+  }
+  *out = static_cast<float>(sign * v);
+  return static_cast<int>(p - s);
+}
+
+inline int parse_uint64(const char* p, const char* end, uint64_t* out) {
+  const char* s = p;
+  uint64_t v = 0;
+  while (p != end && is_digit(*p)) { v = v * 10 + (*p - '0'); ++p; }
+  if (p == s) return 0;
+  *out = v;
+  return static_cast<int>(p - s);
+}
+
+// ---------------- CSR accumulation ----------------
+
+struct ThreadBlock {
+  std::vector<int64_t> offsets;     // per-row value counts (converted later)
+  std::vector<float> labels;
+  std::vector<float> weights;
+  std::vector<uint64_t> indices;
+  std::vector<float> values;
+  std::vector<uint32_t> fields;
+  uint64_t max_index = 0;
+  uint32_t max_field = 0;
+  int64_t bad_lines = 0;
+};
+
+struct CSRBlockC {
+  int64_t n_rows;
+  int64_t n_values;
+  int64_t* offsets;    // n_rows + 1
+  float* labels;       // n_rows
+  float* weights;      // n_rows (1.0 default)
+  uint64_t* indices;   // n_values
+  float* values;       // n_values
+  uint32_t* fields;    // n_values (libfm) or nullptr
+  uint64_t max_index;
+  uint32_t max_field;
+  int64_t bad_lines;
+};
+
+// split [data, data+len) into nt ranges cut at line starts
+// (reference text_parser.h:100-115 divides the chunk the same way)
+std::vector<const char*> line_aligned_cuts(const char* data, int64_t len, int nt) {
+  std::vector<const char*> cuts;
+  cuts.push_back(data);
+  const char* end = data + len;
+  for (int t = 1; t < nt; ++t) {
+    const char* p = data + (len * t) / nt;
+    while (p < end && !is_eol(*p)) ++p;
+    while (p < end && is_eol(*p)) ++p;
+    if (p < cuts.back()) p = cuts.back();
+    cuts.push_back(p);
+  }
+  cuts.push_back(end);
+  return cuts;
+}
+
+enum class Fmt { kLibSVM, kLibFM };
+
+// parse "label[:weight] a:b[:c] ..." lines into tb
+void parse_sparse_range(const char* p, const char* end, Fmt fmt, ThreadBlock* tb) {
+  while (p < end) {
+    while (p < end && is_eol(*p)) ++p;
+    if (p >= end) break;
+    const char* line_end = p;
+    while (line_end < end && !is_eol(*line_end)) ++line_end;
+    // label [:weight]
+    while (p < line_end && is_space(*p)) ++p;
+    float label = 0.f, weight = 1.f;
+    int n = parse_float(p, line_end, &label);
+    if (n == 0) {  // empty/garbage line: skip (reference skips blank lines)
+      const char* q = p;
+      while (q < line_end && is_space(*q)) ++q;
+      if (q != line_end) ++tb->bad_lines;
+      p = line_end;
+      continue;
+    }
+    p += n;
+    if (p < line_end && *p == ':') {
+      ++p;
+      n = parse_float(p, line_end, &weight);
+      if (n == 0) {  // 'label:garbage' — drop the whole row
+        ++tb->bad_lines;
+        p = line_end;
+        continue;
+      }
+      p += n;
+    }
+    tb->labels.push_back(label);
+    tb->weights.push_back(weight);
+    int64_t nvals = 0;
+    while (p < line_end) {
+      while (p < line_end && is_space(*p)) ++p;
+      if (p >= line_end) break;
+      uint64_t a = 0;
+      n = parse_uint64(p, line_end, &a);
+      if (n == 0) { ++tb->bad_lines; break; }
+      p += n;
+      if (fmt == Fmt::kLibSVM && (p >= line_end || *p != ':')) {
+        // value-less token 'idx' — implicit value 1.0
+        // (reference libsvm_parser.h ParsePair r==1 path)
+        tb->indices.push_back(a);
+        tb->values.push_back(1.0f);
+        if (a > tb->max_index) tb->max_index = a;
+        ++nvals;
+        continue;
+      }
+      if (p >= line_end || *p != ':') { ++tb->bad_lines; break; }
+      ++p;
+      if (fmt == Fmt::kLibSVM) {
+        float v = 1.0f;
+        n = parse_float(p, line_end, &v);
+        if (n == 0) { ++tb->bad_lines; break; }
+        p += n;
+        tb->indices.push_back(a);
+        tb->values.push_back(v);
+        if (a > tb->max_index) tb->max_index = a;
+      } else {  // libfm: field:idx:val
+        uint64_t idx = 0;
+        n = parse_uint64(p, line_end, &idx);
+        if (n == 0) { ++tb->bad_lines; break; }
+        p += n;
+        if (p >= line_end || *p != ':') { ++tb->bad_lines; break; }
+        ++p;
+        float v = 1.0f;
+        n = parse_float(p, line_end, &v);
+        if (n == 0) { ++tb->bad_lines; break; }
+        p += n;
+        tb->fields.push_back(static_cast<uint32_t>(a));
+        tb->indices.push_back(idx);
+        tb->values.push_back(v);
+        if (idx > tb->max_index) tb->max_index = idx;
+        if (a > tb->max_field) tb->max_field = static_cast<uint32_t>(a);
+      }
+      ++nvals;
+    }
+    tb->offsets.push_back(nvals);
+    p = line_end;
+  }
+}
+
+// dense csv: every column a value, one column (or none: -1) the label.
+// A row with any unparseable field is dropped whole and counted bad — the
+// Python fallback does the same, keeping both kernels' outputs identical.
+void parse_csv_range(const char* p, const char* end, int label_col, char delim,
+                     ThreadBlock* tb) {
+  while (p < end) {
+    while (p < end && is_eol(*p)) ++p;
+    if (p >= end) break;
+    const char* line_end = p;
+    while (line_end < end && !is_eol(*line_end)) ++line_end;
+    float label = 0.f;
+    int64_t col = 0, nvals = 0;
+    size_t mark = tb->values.size();  // rollback point for bad rows
+    bool ok = true;
+    while (true) {  // one iteration per field; runs once even for empty tail
+      while (p < line_end && is_space(*p)) ++p;
+      float v = 0.f;
+      int n = parse_float(p, line_end, &v);
+      if (n == 0) {
+        // empty cell parses as 0.0; anything unparseable kills the row
+        if (p < line_end && *p != delim && !is_space(*p)) {
+          ok = false;
+          break;
+        }
+      }
+      p += n;
+      while (p < line_end && is_space(*p)) ++p;
+      if (col == label_col) {
+        label = v;
+      } else {
+        tb->indices.push_back(static_cast<uint64_t>(nvals));
+        tb->values.push_back(v);
+        ++nvals;
+      }
+      ++col;
+      if (p < line_end && *p == delim) { ++p; continue; }
+      break;
+    }
+    if (!ok || p != line_end) {
+      ++tb->bad_lines;
+      tb->indices.resize(mark);
+      tb->values.resize(mark);
+      p = line_end;
+      continue;
+    }
+    if (nvals > 0 && static_cast<uint64_t>(nvals - 1) > tb->max_index)
+      tb->max_index = static_cast<uint64_t>(nvals - 1);
+    tb->labels.push_back(label);
+    tb->weights.push_back(1.f);
+    tb->offsets.push_back(nvals);
+    p = line_end;
+  }
+}
+
+template <typename F>
+int parse_parallel(const char* data, int64_t len, bool want_fields, int nthreads,
+                   CSRBlockC* out, F&& range_fn) {
+  int nt = 1;
+#if defined(_OPENMP)
+  nt = nthreads > 0 ? nthreads : omp_get_max_threads();
+  if (nt < 1) nt = 1;
+  if (len < (1 << 16)) nt = 1;  // small chunks: threading overhead dominates
+#endif
+  std::vector<const char*> cuts = line_aligned_cuts(data, len, nt);
+  std::vector<ThreadBlock> blocks(nt);
+#if defined(_OPENMP)
+#pragma omp parallel for num_threads(nt) schedule(static, 1)
+#endif
+  for (int t = 0; t < nt; ++t) {
+    // pre-size to dodge realloc-copy growth on large ranges:
+    // ~12 chars per "idx:val" token, ~80 chars per row are safe lower bounds
+    int64_t range = cuts[t + 1] - cuts[t];
+    blocks[t].values.reserve(range / 10);
+    blocks[t].indices.reserve(range / 10);
+    blocks[t].labels.reserve(range / 64);
+    blocks[t].weights.reserve(range / 64);
+    blocks[t].offsets.reserve(range / 64);
+    range_fn(cuts[t], cuts[t + 1], &blocks[t]);
+  }
+  // merge
+  int64_t n_rows = 0, n_values = 0;
+  uint64_t max_index = 0;
+  uint32_t max_field = 0;
+  int64_t bad = 0;
+  for (auto& b : blocks) {
+    n_rows += static_cast<int64_t>(b.labels.size());
+    n_values += static_cast<int64_t>(b.values.size());
+    if (b.max_index > max_index) max_index = b.max_index;
+    if (b.max_field > max_field) max_field = b.max_field;
+    bad += b.bad_lines;
+  }
+  out->n_rows = n_rows;
+  out->n_values = n_values;
+  out->max_index = max_index;
+  out->max_field = max_field;
+  out->bad_lines = bad;
+  out->offsets = static_cast<int64_t*>(std::malloc(sizeof(int64_t) * (n_rows + 1)));
+  out->labels = static_cast<float*>(std::malloc(sizeof(float) * (n_rows ? n_rows : 1)));
+  out->weights = static_cast<float*>(std::malloc(sizeof(float) * (n_rows ? n_rows : 1)));
+  out->indices = static_cast<uint64_t*>(std::malloc(sizeof(uint64_t) * (n_values ? n_values : 1)));
+  out->values = static_cast<float*>(std::malloc(sizeof(float) * (n_values ? n_values : 1)));
+  out->fields = want_fields
+      ? static_cast<uint32_t*>(std::malloc(sizeof(uint32_t) * (n_values ? n_values : 1)))
+      : nullptr;
+  if (!out->offsets || !out->labels || !out->weights || !out->indices || !out->values ||
+      (want_fields && !out->fields)) {
+    return -1;
+  }
+  int64_t row = 0, val = 0;
+  out->offsets[0] = 0;
+  for (auto& b : blocks) {
+    std::memcpy(out->labels + row, b.labels.data(), b.labels.size() * sizeof(float));
+    std::memcpy(out->weights + row, b.weights.data(), b.weights.size() * sizeof(float));
+    std::memcpy(out->indices + val, b.indices.data(), b.indices.size() * sizeof(uint64_t));
+    std::memcpy(out->values + val, b.values.data(), b.values.size() * sizeof(float));
+    if (want_fields)
+      std::memcpy(out->fields + val, b.fields.data(), b.fields.size() * sizeof(uint32_t));
+    for (size_t i = 0; i < b.offsets.size(); ++i) {
+      out->offsets[row + 1] = out->offsets[row] + b.offsets[i];
+      ++row;
+    }
+    val += static_cast<int64_t>(b.values.size());
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int dmlc_parse_libsvm(const char* data, int64_t len, int nthreads, CSRBlockC* out) {
+  return parse_parallel(data, len, /*want_fields=*/false, nthreads, out,
+                        [](const char* b, const char* e, ThreadBlock* tb) {
+                          parse_sparse_range(b, e, Fmt::kLibSVM, tb);
+                        });
+}
+
+int dmlc_parse_libfm(const char* data, int64_t len, int nthreads, CSRBlockC* out) {
+  return parse_parallel(data, len, /*want_fields=*/true, nthreads, out,
+                        [](const char* b, const char* e, ThreadBlock* tb) {
+                          parse_sparse_range(b, e, Fmt::kLibFM, tb);
+                        });
+}
+
+int dmlc_parse_csv(const char* data, int64_t len, int label_col, char delim,
+                   int nthreads, CSRBlockC* out) {
+  return parse_parallel(data, len, /*want_fields=*/false, nthreads, out,
+                        [label_col, delim](const char* b, const char* e, ThreadBlock* tb) {
+                          parse_csv_range(b, e, label_col, delim, tb);
+                        });
+}
+
+void dmlc_free_block(CSRBlockC* blk) {
+  std::free(blk->offsets);
+  std::free(blk->labels);
+  std::free(blk->weights);
+  std::free(blk->indices);
+  std::free(blk->values);
+  std::free(blk->fields);
+  blk->offsets = nullptr;
+  blk->labels = blk->weights = blk->values = nullptr;
+  blk->indices = nullptr;
+  blk->fields = nullptr;
+}
+
+int dmlc_num_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,166 @@
+"""Format parser tests (reference libsvm/libfm/csv parser tests): native and
+fallback kernels agree; streaming parser over partitions covers all rows."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu import native
+from dmlc_core_tpu.data import create_parser, py_parsers
+from dmlc_core_tpu.utils import DMLCError
+
+LIBSVM = b"""1 1:0.5 3:1.5 7:2
+0:0.5 2:1
+1 9:3.5
+0
+-1:2 4:1 5:1
+"""
+LIBFM = b"""1 0:1:0.5 2:3:1.5
+0:0.5 1:2:1
+"""
+CSV = b"""1.0,0.5,2.5
+0.0,1.5,3.5
+1.0,2.5,4.5
+"""
+
+
+def kernels(fmt):
+    ks = [getattr(py_parsers, f"parse_{fmt}")]
+    if native.available():
+        ks.append(getattr(native, f"parse_{fmt}"))
+    return ks
+
+
+@pytest.mark.parametrize("kernel", kernels("libsvm"))
+def test_libsvm_kernel(kernel):
+    d = kernel(LIBSVM)
+    np.testing.assert_array_equal(d["offsets"], [0, 3, 4, 5, 5, 7])
+    np.testing.assert_array_equal(d["labels"], [1, 0, 1, 0, -1])
+    np.testing.assert_array_equal(d["weights"], [1, 0.5, 1, 1, 2])
+    np.testing.assert_array_equal(d["indices"], [1, 3, 7, 2, 9, 4, 5])
+    np.testing.assert_allclose(d["values"], [0.5, 1.5, 2, 1, 3.5, 1, 1])
+    assert d["max_index"] == 9
+
+
+@pytest.mark.parametrize("kernel", kernels("libfm"))
+def test_libfm_kernel(kernel):
+    d = kernel(LIBFM)
+    np.testing.assert_array_equal(d["fields"], [0, 2, 1])
+    np.testing.assert_array_equal(d["indices"], [1, 3, 2])
+    np.testing.assert_allclose(d["values"], [0.5, 1.5, 1.0])
+    np.testing.assert_array_equal(d["labels"], [1, 0])
+    np.testing.assert_array_equal(d["weights"], [1, 0.5])
+    assert d["max_field"] == 2 and d["max_index"] == 3
+
+
+@pytest.mark.parametrize("kernel", kernels("csv"))
+def test_csv_kernel(kernel):
+    d = kernel(CSV, 0)  # label_col=0
+    np.testing.assert_array_equal(d["labels"], [1, 0, 1])
+    np.testing.assert_array_equal(d["offsets"], [0, 2, 4, 6])
+    np.testing.assert_allclose(d["values"], [0.5, 2.5, 1.5, 3.5, 2.5, 4.5])
+    assert d["max_index"] == 1
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_native_matches_fallback_on_fuzz():
+    rng = np.random.default_rng(0)
+    lines = []
+    for i in range(500):
+        n = int(rng.integers(0, 20))
+        idx = sorted(rng.choice(10000, size=n, replace=False).tolist())
+        feats = " ".join(f"{j}:{rng.random()*10:.6f}" for j in idx)
+        label = int(rng.integers(0, 2))
+        w = f":{rng.random():.4f}" if rng.random() < 0.3 else ""
+        lines.append(f"{label}{w} {feats}")
+    data = ("\n".join(lines) + "\n").encode()
+    a = native.parse_libsvm(data)
+    b = py_parsers.parse_libsvm(data)
+    np.testing.assert_array_equal(a["offsets"], b["offsets"])
+    np.testing.assert_array_equal(a["indices"], b["indices"])
+    np.testing.assert_allclose(a["values"], b["values"], rtol=1e-5)
+    np.testing.assert_allclose(a["labels"], b["labels"])
+    np.testing.assert_allclose(a["weights"], b["weights"], rtol=1e-5)
+
+
+def test_streaming_parser_partitions(tmp_path):
+    rng = np.random.default_rng(1)
+    lines = []
+    for i in range(3000):
+        n = int(rng.integers(1, 10))
+        idx = sorted(rng.choice(1000, size=n, replace=False).tolist())
+        lines.append(f"{i % 2} " + " ".join(f"{j}:1.5" for j in idx))
+    path = tmp_path / "train.libsvm"
+    path.write_text("\n".join(lines) + "\n")
+
+    total_rows = 0
+    all_labels = []
+    for k in range(4):
+        with create_parser(str(path), k, 4, "libsvm") as p:
+            for c in p:
+                blk = c.get_block()
+                total_rows += blk.size
+                all_labels.extend(blk.labels.tolist())
+    assert total_rows == 3000
+    assert sum(all_labels) == sum(i % 2 for i in range(3000))
+
+
+def test_parser_auto_format(tmp_path):
+    path = tmp_path / "d.txt"
+    path.write_text("1.0,2.0\n0.0,3.0\n")
+    with create_parser(f"{path}?format=csv&label_column=0") as p:
+        blocks = list(p)
+    assert sum(b.get_block().size for b in blocks) == 2
+    lbls = np.concatenate([b.get_block().labels for b in blocks])
+    np.testing.assert_array_equal(sorted(lbls.tolist()), [0.0, 1.0])
+    with pytest.raises(DMLCError):
+        create_parser(str(path), parser_type="parquet")
+
+
+MALFORMED_CASES = [
+    (b"1,abc,3\n2,3,4\n", "csv", {"label_col": -1}),   # bad field drops row
+    (b"1, 2 ,3\n", "csv", {"label_col": 0}),           # spaces around fields
+    (b"1,2,\n", "csv", {"label_col": -1}),             # trailing empty cell
+    (b"1 3 5 7\n", "libsvm", {}),                      # value-less implicit 1.0
+    (b"1:bad 2:3\n", "libsvm", {}),                    # bad weight drops row
+    (b"1 1:1e1000000000\n", "libsvm", {}),             # hostile exponent
+    (b"1 2:3.5e-2 4:2E3\n", "libsvm", {}),             # scientific notation
+]
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+@pytest.mark.parametrize("data,fmt,kw", MALFORMED_CASES)
+def test_native_fallback_parity_on_malformed(data, fmt, kw):
+    # the two kernels must produce identical results so training data does
+    # not depend on whether libdmlc_native.so happens to be built
+    a = getattr(native, f"parse_{fmt}")(data, **kw)
+    b = getattr(py_parsers, f"parse_{fmt}")(data, **kw)
+    np.testing.assert_array_equal(a["offsets"], b["offsets"])
+    np.testing.assert_array_equal(a["labels"], b["labels"])
+    np.testing.assert_allclose(a["values"], b["values"], rtol=1e-6)
+    assert a["bad_lines"] == b["bad_lines"]
+
+
+def test_valueless_libsvm_implicit_one():
+    d = py_parsers.parse_libsvm(b"1 3 5 7\n")
+    np.testing.assert_array_equal(d["indices"], [3, 5, 7])
+    np.testing.assert_array_equal(d["values"], [1.0, 1.0, 1.0])
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+def test_zero_copy_view_lifetime():
+    import gc
+    out = native.parse_libsvm(b"1 1:0.5 2:1.5\n0 3:2.5\n")
+    vals = out["values"]
+    del out
+    gc.collect()
+    assert vals.tolist() == [0.5, 1.5, 2.5]  # view owns the native block
+
+
+def test_bad_lines_counted():
+    d = py_parsers.parse_libsvm(b"1 3:1\nnot_a_label x\n0 5:2\n")
+    assert d["bad_lines"] == 1
+    np.testing.assert_array_equal(d["labels"], [1, 0])
+    if native.available():
+        d2 = native.parse_libsvm(b"1 3:1\nnot_a_label x\n0 5:2\n")
+        assert d2["bad_lines"] >= 1
+        np.testing.assert_array_equal(d2["labels"], [1, 0])

@@ -1,0 +1,99 @@
+"""RowBlock/RowBlockContainer tests (reference row_block.h semantics:
+push, zero-copy views, slice, save/load)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data import RowBlock, RowBlockContainer
+
+
+def make_container():
+    c = RowBlockContainer()
+    c.push_row(1.0, [1, 5, 9], [0.5, 1.5, 2.5])
+    c.push_row(0.0, [2], [1.0], weight=2.0)
+    c.push_row(1.0, [], [])
+    c.push_row(-1.0, [7, 8], [3.0, 4.0])
+    return c
+
+
+def test_push_and_block():
+    c = make_container()
+    b = c.get_block()
+    assert b.size == 4
+    assert b.num_values == 6
+    assert b.max_index == 9 and b.num_col == 10
+    label, idx, vals = b.row(0)
+    assert label == 1.0
+    np.testing.assert_array_equal(idx, [1, 5, 9])
+    np.testing.assert_array_equal(vals, [0.5, 1.5, 2.5])
+    assert b.weight(1) == 2.0 and b.weight(0) == 1.0
+    label2, idx2, _ = b.row(2)
+    assert len(idx2) == 0
+
+
+def test_sdot():
+    c = make_container()
+    b = c.get_block()
+    dense = np.arange(10, dtype=np.float32)
+    # row0: 0.5*1 + 1.5*5 + 2.5*9 = 30.5
+    assert b.sdot(0, dense) == pytest.approx(30.5)
+
+
+def test_slice():
+    b = make_container().get_block()
+    s = b.slice(1, 3)
+    assert s.size == 2
+    label, idx, vals = s.row(0)
+    assert label == 0.0
+    np.testing.assert_array_equal(idx, [2])
+    assert s.offsets[0] == 0
+
+
+def test_push_block_merge():
+    c1 = make_container()
+    c2 = RowBlockContainer()
+    c2.push_block(c1.get_block())
+    c2.push_block(c1.get_block())
+    b = c2.get_block()
+    assert b.size == 8 and b.num_values == 12
+    assert b.max_index == 9
+
+
+def test_push_after_get_block():
+    c = make_container()
+    _ = c.get_block()
+    c.push_row(5.0, [3], [1.0])
+    b = c.get_block()
+    assert b.size == 5
+    assert b.labels[-1] == 5.0
+
+
+def test_save_load_roundtrip():
+    c = make_container()
+    buf = io.BytesIO()
+    c.save(buf)
+    buf.seek(0)
+    c2 = RowBlockContainer()
+    c2.load(buf)
+    b1, b2 = c.get_block(), c2.get_block()
+    np.testing.assert_array_equal(b1.offsets, b2.offsets)
+    np.testing.assert_array_equal(b1.labels, b2.labels)
+    np.testing.assert_array_equal(b1.indices, b2.indices)
+    np.testing.assert_array_equal(b1.values, b2.values)
+    assert b2.weight(1) == 2.0
+
+
+def test_from_arrays_zero_copy():
+    offsets = np.array([0, 2, 3], np.int64)
+    labels = np.array([1, 0], np.float32)
+    indices = np.array([4, 2, 0], np.uint64)
+    values = np.array([1, 2, 3], np.float32)
+    c = RowBlockContainer.from_arrays(offsets, labels, indices, values)
+    b = c.get_block()
+    assert b.size == 2 and b.max_index == 4
+    # push after wrap folds the block into growable form
+    c.push_row(2.0, [9], [9.0])
+    assert c.get_block().size == 3
+    assert c.get_block().max_index == 9
