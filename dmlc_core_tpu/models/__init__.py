@@ -1,0 +1,12 @@
+"""Flagship sparse streaming models (SURVEY §7 phase 4)."""
+
+from .sparse import (SparseLogReg, FactorizationMachine,  # noqa: F401
+                     weighted_bce, weighted_mse)
+from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F401
+                    param_shardings, shard_params, fit_stream)
+
+__all__ = [
+    "SparseLogReg", "FactorizationMachine", "weighted_bce", "weighted_mse",
+    "make_train_step", "make_eval_step", "batch_sharding", "param_shardings",
+    "shard_params", "fit_stream",
+]

@@ -1,0 +1,15 @@
+"""TPU compute ops: XLA sparse CSR primitives + Pallas kernels."""
+
+from .csr import csr_dense_matvec, csr_embed_sum, fm_pairwise  # noqa: F401
+
+__all__ = ["csr_dense_matvec", "csr_embed_sum", "fm_pairwise",
+           "embed_bag_pallas", "embed_bag_reference"]
+
+
+def __getattr__(name):
+    # pallas imports are lazy: jax.experimental.pallas is heavyweight and not
+    # needed for the pure-XLA paths
+    if name in ("embed_bag_pallas", "embed_bag_reference"):
+        from . import pallas_embed
+        return getattr(pallas_embed, name)
+    raise AttributeError(name)

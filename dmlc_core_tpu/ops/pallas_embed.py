@@ -1,0 +1,88 @@
+"""Pallas TPU kernel: weighted embedding-bag over row-padded sparse batches.
+
+The hot op of the sparse model family (logreg/FM wide features,
+BASELINE.json north star: stage CSR batches into HBM and consume them without
+host round trips).  XLA's ``table[ids] * vals → segment_sum`` materializes a
+``[nnz, D]`` gathered intermediate in HBM; this kernel streams embedding rows
+HBM→VMEM with double-buffered async DMA and accumulates in registers, so the
+intermediate never exists and HBM traffic drops to ~1× gather + 1× output.
+
+Layout: ids/vals are **row-padded** ``[B, K]`` (K = max nnz/row, padding id 0
+with val 0; see ``pipeline.packing.pack_rowmajor``).  The table stays in HBM
+(``memory_space=ANY``) — F is typically far larger than VMEM.
+
+Grid: one program per row; per row a K-step ``fori_loop`` with 2-slot DMA
+double buffering (pallas_guide.md §Async DMA / §Double Buffering).  Use
+``interpret=True`` for CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["embed_bag_pallas", "embed_bag_reference"]
+
+
+def embed_bag_reference(ids: jax.Array, vals: jax.Array,
+                        table: jax.Array) -> jax.Array:
+    """XLA reference semantics: out[b] = Σ_k vals[b,k] · table[ids[b,k]]."""
+    return jnp.einsum("bk,bkd->bd", vals, table[ids])
+
+
+def _kernel(ids_ref, vals_ref, table_ref, out_ref, buf, sems, *, K: int, D: int):
+    b = pl.program_id(0)
+
+    def row_copy(k, slot):
+        idx = ids_ref[b * K + k]
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(idx, 1), :], buf.at[slot], sems.at[slot])
+
+    # prologue: fill slot 0
+    row_copy(0, 0).start()
+
+    def body(k, acc):
+        slot = jax.lax.rem(k, 2)
+        nxt_slot = jax.lax.rem(k + 1, 2)
+
+        @pl.when(k + 1 < K)
+        def _start_next():
+            row_copy(k + 1, nxt_slot).start()
+
+        row_copy(k, slot).wait()
+        return acc + buf[slot, 0, :] * vals_ref[0, k]
+
+    acc = jax.lax.fori_loop(0, K, body, jnp.zeros((D,), jnp.float32))
+    out_ref[0, :] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """Double-buffered DMA embedding bag.  ids,vals: [B,K]; table: [F,D] → [B,D]."""
+    B, K = ids.shape
+    F, D = table.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,            # flat ids land in SMEM pre-kernel
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, K), lambda b, ids: (b, 0)),        # vals row
+            pl.BlockSpec(memory_space=pltpu.ANY),               # table in HBM
+        ],
+        out_specs=pl.BlockSpec((1, D), lambda b, ids: (b, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, D), jnp.float32),  # double-buffer slots
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(_kernel, K=K, D=D)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, D), jnp.float32),
+        interpret=interpret,
+    )(ids.reshape(-1).astype(jnp.int32), vals.astype(jnp.float32), table)

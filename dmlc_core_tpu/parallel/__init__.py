@@ -1,0 +1,15 @@
+"""Distributed layer: device-mesh collectives, rendezvous tracker, rabit
+client, cluster launchers (reference ``tracker/`` — SURVEY §2.5, §5.8)."""
+
+from .mesh import (make_mesh, parse_mesh_spec, data_parallel_mesh,  # noqa: F401
+                   process_mesh_info)
+from .collectives import (allreduce, broadcast, allgather,  # noqa: F401
+                          reduce_scatter, MeshCollectives)
+from .tracker import RabitTracker, compute_tree, compute_ring  # noqa: F401
+from .rabit import RabitContext  # noqa: F401
+
+__all__ = [
+    "make_mesh", "parse_mesh_spec", "data_parallel_mesh", "process_mesh_info",
+    "allreduce", "broadcast", "allgather", "reduce_scatter", "MeshCollectives",
+    "RabitTracker", "compute_tree", "compute_ring", "RabitContext",
+]

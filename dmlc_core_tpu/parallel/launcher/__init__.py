@@ -1,0 +1,7 @@
+"""Cluster launchers (reference ``tracker/dmlc_tracker`` SURVEY §2.5):
+local / ssh / slurm / sge / mpi / tpu backends behind one submit CLI."""
+
+from .opts import build_parser, get_opts  # noqa: F401
+from .submit import submit, main  # noqa: F401
+
+__all__ = ["build_parser", "get_opts", "submit", "main"]

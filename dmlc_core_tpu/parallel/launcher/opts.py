@@ -1,0 +1,63 @@
+"""CLI options for the job launcher — capability parity with reference
+``tracker/dmlc_tracker/opts.py`` (`opts.py:60-163`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+__all__ = ["build_parser", "get_opts"]
+
+CLUSTERS = ["local", "ssh", "mpi", "sge", "slurm", "tpu"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dmlc-submit-tpu",
+        description="Submit a distributed job (TPU-native dmlc-submit): "
+                    "boots a rendezvous tracker and launches workers on the "
+                    "chosen cluster backend.")
+    p.add_argument("--cluster", default=os.environ.get(
+        "DMLC_SUBMIT_CLUSTER", "local"), choices=CLUSTERS,
+        help="cluster backend (env DMLC_SUBMIT_CLUSTER overrides the default)")
+    p.add_argument("--num-workers", "-n", type=int, required=True,
+                   help="number of worker processes")
+    p.add_argument("--num-servers", "-s", type=int, default=0,
+                   help="number of server processes (parameter-server mode)")
+    p.add_argument("--worker-cores", type=int, default=1)
+    p.add_argument("--worker-memory-mb", type=int, default=1024)
+    p.add_argument("--jobname", default=None)
+    p.add_argument("--host-file", default=None,
+                   help="ssh/mpi: file listing one host per line")
+    p.add_argument("--host-ip", default=None,
+                   help="tracker bind address (default: auto-detect)")
+    p.add_argument("--sync-dst-dir", default=None,
+                   help="ssh: rsync the working dir to this path on each host")
+    p.add_argument("--slurm-partition", default=None)
+    p.add_argument("--sge-queue", default=None)
+    p.add_argument("--max-attempts", type=int,
+                   default=int(os.environ.get("DMLC_MAX_ATTEMPT", "3")),
+                   help="per-worker restart attempts before giving up")
+    p.add_argument("--env", action="append", default=[],
+                   metavar="K=V", help="extra env vars forwarded to workers")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="worker command line")
+    return p
+
+
+def get_opts(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    args = build_parser().parse_args(argv)
+    if not args.command:
+        build_parser().error("no worker command given")
+    # strip a leading '--' separator
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    args.extra_env = {}
+    for kv in args.env:
+        if "=" not in kv:
+            build_parser().error(f"--env expects K=V, got {kv!r}")
+        k, v = kv.split("=", 1)
+        args.extra_env[k] = v
+    return args

@@ -1,0 +1,92 @@
+"""SSH launcher — capability parity with reference
+``tracker/dmlc_tracker/ssh.py``: host-file parsing (`ssh.py:36-70`), optional
+workdir rsync (`ssh.py:13-21`), per-host ssh spawn with env forwarding.
+
+Host file format: one ``host[:port]`` per line (the PHub fork's
+``ip:interface:port`` interface pinning collapses to plain addressing here —
+on TPU pods NIC selection is the platform's concern, not the launcher's)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Dict, List, Tuple
+
+from ...utils import DMLCError, log_info, log_warning
+
+__all__ = ["submit", "parse_host_file"]
+
+
+def parse_host_file(path: str) -> List[Tuple[str, int]]:
+    hosts: List[Tuple[str, int]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if ":" in line:
+                h, p = line.rsplit(":", 1)
+                hosts.append((h, int(p)))
+            else:
+                hosts.append((line, 22))
+    if not hosts:
+        raise DMLCError(f"host file {path!r} lists no hosts")
+    return hosts
+
+
+def _env_exports(env: Dict[str, str]) -> str:
+    return " ".join(f"{k}={_shquote(v)}" for k, v in env.items())
+
+
+def _shquote(s: str) -> str:
+    return "'" + s.replace("'", "'\"'\"'") + "'"
+
+
+def submit(args, tracker_envs: Dict[str, str]) -> int:
+    if not args.host_file:
+        raise DMLCError("ssh cluster requires --host-file")
+    hosts = parse_host_file(args.host_file)
+    nproc = args.num_workers + args.num_servers
+    workdir = os.getcwd()
+
+    if args.sync_dst_dir:
+        for host, port in set(hosts):
+            log_info("rsync %s -> %s:%s", workdir, host, args.sync_dst_dir)
+            subprocess.run(
+                ["rsync", "-az", "-e", f"ssh -p {port}", workdir + "/",
+                 f"{host}:{args.sync_dst_dir}/"], check=True)
+        workdir = args.sync_dst_dir
+
+    results = [0] * nproc
+    threads = []
+    for i in range(nproc):
+        host, port = hosts[i % len(hosts)]
+        role = "server" if i < args.num_servers else "worker"
+        env = dict(tracker_envs)
+        env.update(args.extra_env)
+        env.update({
+            "DMLC_ROLE": role,
+            "DMLC_TASK_ID": str(i),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_NUM_SERVER": str(args.num_servers),
+            "DMLC_JOB_CLUSTER": "ssh",
+        })
+        remote_cmd = (f"cd {_shquote(workdir)} && "
+                      f"{_env_exports(env)} " +
+                      " ".join(_shquote(c) for c in args.command))
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(port),
+               host, remote_cmd]
+
+        def run(cmd=cmd, slot=i, host=host):
+            rc = subprocess.call(cmd)
+            results[slot] = rc
+            if rc != 0:
+                log_warning("ssh worker %d on %s exited rc=%d", slot, host, rc)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    return next((rc for rc in results if rc), 0)

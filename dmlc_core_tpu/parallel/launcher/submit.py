@@ -1,0 +1,56 @@
+"""`dmlc-submit-tpu` entry point — capability parity with reference
+``tracker/dmlc-submit`` + ``dmlc_tracker/submit.py``: boot the rendezvous
+tracker, dispatch to the cluster backend, join until shutdown
+(`submit.py:42-53`, `tracker.py:410-433`)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import List, Optional
+
+from ...utils import log_info
+from ..tracker import RabitTracker
+from .opts import get_opts
+
+__all__ = ["main", "submit"]
+
+
+def submit(argv: Optional[List[str]] = None) -> int:
+    args = get_opts(argv)
+    tracker = RabitTracker(num_workers=args.num_workers,
+                           host_ip=args.host_ip)
+    tracker.start()
+    envs = tracker.worker_envs()
+
+    if args.cluster == "local":
+        from . import local as backend
+        rc = backend.submit(args, envs)
+    elif args.cluster == "ssh":
+        from . import ssh as backend
+        rc = backend.submit(args, envs)
+    elif args.cluster == "slurm":
+        from .batch import submit_slurm
+        rc = submit_slurm(args, envs)
+    elif args.cluster == "sge":
+        from .batch import submit_sge
+        rc = submit_sge(args, envs)
+    elif args.cluster == "mpi":
+        from .batch import submit_mpi
+        rc = submit_mpi(args, envs)
+    elif args.cluster == "tpu":
+        from . import tpu as backend
+        rc = backend.submit(args, envs)
+    else:  # pragma: no cover - argparse restricts choices
+        raise SystemExit(f"unknown cluster {args.cluster}")
+
+    tracker.stop()
+    return rc
+
+
+def main() -> None:
+    sys.exit(submit())
+
+
+if __name__ == "__main__":
+    main()

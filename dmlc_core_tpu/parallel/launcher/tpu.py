@@ -1,0 +1,95 @@
+"""`--cluster tpu`: map ranks onto a JAX multi-process (multi-host TPU) job.
+
+The reference's PS tracker boots a scheduler and hands every process
+rendezvous env (`tracker.py:336-386`).  On TPU pods that role collapses into
+the **JAX coordination service** (SURVEY §5.8): process 0 is the coordinator;
+every process calls ``jax.distributed.initialize(coordinator, n, id)`` and
+the ICI/DCN mesh replaces brokered sockets.
+
+This launcher spawns one process per TPU host (or per requested worker when
+simulating locally), exporting both contracts:
+
+* ``DMLC_*``  — rank/world/tracker env (our rabit tracker, control plane)
+* ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` —
+  consumed by :func:`initialize_jax_from_env` in worker code.
+
+On a real pod slice, process placement is normally handled by the platform
+(GKE/queued resources); this backend then only materializes env and execs the
+worker once per host.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import threading
+from typing import Dict
+
+from ...utils import get_env, log_info
+
+__all__ = ["submit", "jax_coordinator_env", "initialize_jax_from_env"]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def jax_coordinator_env(num_processes: int, host_ip: str = "127.0.0.1",
+                        port: int = 0) -> Dict[str, str]:
+    port = port or _free_port()
+    return {
+        "JAX_COORDINATOR_ADDRESS": f"{host_ip}:{port}",
+        "JAX_NUM_PROCESSES": str(num_processes),
+    }
+
+
+def initialize_jax_from_env() -> None:
+    """Worker-side bootstrap: call before first jax use.  Reads the env this
+    launcher (or the platform) exported and joins the JAX coordination
+    service — the TPU analog of the rabit client connecting to the tracker."""
+    import jax
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return  # single-process
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=get_env("JAX_NUM_PROCESSES", 1),
+        process_id=get_env("JAX_PROCESS_ID",
+                           get_env("DMLC_TASK_ID", 0)),
+    )
+
+
+def submit(args, tracker_envs: Dict[str, str]) -> int:
+    n = args.num_workers
+    coord = jax_coordinator_env(n, host_ip=args.host_ip or "127.0.0.1")
+    results = [0] * n
+    threads = []
+    for i in range(n):
+        env = dict(os.environ)
+        env.update(tracker_envs)
+        env.update(coord)
+        env.update(args.extra_env)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_TASK_ID": str(i),
+            "JAX_PROCESS_ID": str(i),
+            "DMLC_NUM_WORKER": str(n),
+            "DMLC_JOB_CLUSTER": "tpu",
+        })
+
+        def run(env=env, slot=i):
+            results[slot] = subprocess.call(args.command, env=env)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    rc = next((r for r in results if r), 0)
+    log_info("tpu job finished rc=%d", rc)
+    return rc

@@ -1,0 +1,86 @@
+"""Device mesh construction and axis conventions.
+
+The reference's parallelism is rank-based (tracker assigns ranks, data is
+sharded by ``ResetPartition(rank, nsplit)``, SURVEY §2.5).  The TPU-native
+equivalent is a named :class:`jax.sharding.Mesh`; ranks become mesh
+coordinates and XLA emits the collectives.
+
+Axis conventions used across the framework:
+
+* ``dp`` — data parallel (batch leading axis; gradient all-reduce over ICI)
+* ``mp`` — model parallel (FM factor dim / embedding dim sharding)
+* ``sp`` — sequence/context parallel (ring attention layer, ops.ring)
+
+``make_mesh("dp=4,mp=2")`` builds a mesh from a spec string; unmentioned
+capacity folds into the first axis.  ``process_mesh_info()`` exposes the
+rank/world view (process_index ≙ the reference's ``DMLC_TASK_ID``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..utils import DMLCError, check
+
+__all__ = ["make_mesh", "parse_mesh_spec", "process_mesh_info",
+           "data_parallel_mesh"]
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """Parse 'dp=4,mp=2' → {'dp': 4, 'mp': 2} (-1 allowed once: infer)."""
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise DMLCError(f"bad mesh spec component {part!r}")
+        k, v = part.split("=", 1)
+        out[k.strip()] = int(v)
+    check(list(out.values()).count(-1) <= 1, "at most one -1 axis")
+    return out
+
+
+def make_mesh(spec: str = "dp=-1",
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a named mesh from a spec string over the given (default: all)
+    devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = parse_mesh_spec(spec)
+    known = 1
+    for v in axes.values():
+        if v > 0:
+            known *= v
+    n = len(devices)
+    if -1 in axes.values():
+        check(n % known == 0,
+              f"{n} devices not divisible by fixed axes product {known}")
+        axes = {k: (n // known if v == -1 else v) for k, v in axes.items()}
+    total = int(np.prod(list(axes.values())))
+    check(total <= n, f"mesh wants {total} devices, have {n}")
+    if total < n and n % total == 0:
+        # fold unused capacity into the first axis so no chip idles silently
+        first = next(iter(axes))
+        axes[first] *= n // total
+        total = n
+    mesh_devices = np.array(devices[:total]).reshape(*axes.values())
+    return Mesh(mesh_devices, tuple(axes.keys()))
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    return make_mesh("dp=-1", devices)
+
+
+def process_mesh_info() -> Dict[str, int]:
+    """Rank/world view of the current process (multi-host: one JAX process
+    per host, reference ``DMLC_TASK_ID``/``DMLC_NUM_WORKER`` contract)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

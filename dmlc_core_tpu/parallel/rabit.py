@@ -1,0 +1,267 @@
+"""Rabit-style worker client: tracker rendezvous + host-side tree collectives.
+
+The reference delegates allreduce *execution* to downstream rabit over
+tracker-brokered TCP links (SURVEY §2.5).  For the TPU framework the
+data-plane collectives ride ICI via XLA (``parallel.collectives``); this
+module supplies the equivalent **host/control-plane** collectives between
+processes — exactly rabit's API surface::
+
+    with RabitContext.from_env() as rc:       # DMLC_TRACKER_URI/PORT env
+        total = rc.allreduce(np.array([local_sum]))   # tree allreduce
+        cfg = rc.broadcast(cfg_bytes, root=0)          # tree broadcast
+        rc.tracker_print(f"rank {rc.rank} done")
+
+Topology comes from the tracker (binary tree + recovery ring); reductions run
+leaf→root then broadcast root→leaf over persistent worker⇄worker sockets.
+A worker that restarts re-registers with ``cmd=recover`` and resumes with the
+same rank (reference `tracker.py:279-291`).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import DMLCError, check, get_env, log_info
+from .tracker import recv_json, send_json
+
+__all__ = ["RabitContext"]
+
+_OPS: Dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
+    "sum": np.add,
+    "max": np.maximum,
+    "min": np.minimum,
+    "prod": np.multiply,
+}
+
+
+def _send_blob(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_blob(sock: socket.socket) -> bytes:
+    head = _recv_exact(sock, 8)
+    (n,) = struct.unpack("<Q", head)
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise DMLCError("rabit: peer closed connection")
+        out += chunk
+    return bytes(out)
+
+
+class RabitContext:
+    """Worker-side rendezvous + collectives."""
+
+    def __init__(self, tracker_uri: str, tracker_port: int,
+                 jobid: Optional[str] = None, recover: bool = False,
+                 connect_timeout: float = 60.0, connect_links: bool = True):
+        self.tracker_addr = (tracker_uri, tracker_port)
+        self.jobid = jobid or f"job-{os.getpid()}-{socket.gethostname()}"
+        self.connect_timeout = connect_timeout
+        # listener for peer links
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(16)
+        self._listen_port = self._listener.getsockname()[1]
+        self._peer_socks: Dict[int, socket.socket] = {}
+        self._peer_lock = threading.Lock()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accepting = True
+        self._accept_thread.start()
+        self._register(recover)
+        if connect_links:
+            self._connect_links()
+
+    @classmethod
+    def from_env(cls, **kw) -> "RabitContext":
+        """Bootstrap from the DMLC_* env contract (reference `local.py:21-27`)."""
+        uri = get_env("DMLC_TRACKER_URI", "127.0.0.1")
+        port = get_env("DMLC_TRACKER_PORT", 9091)
+        jobid = os.environ.get("DMLC_TASK_ID")
+        attempt = get_env("DMLC_NUM_ATTEMPT", 0)
+        return cls(uri, port, jobid=jobid, recover=attempt > 0, **kw)
+
+    # -- rendezvous --
+    def _register(self, recover: bool) -> None:
+        sock = socket.create_connection(self.tracker_addr,
+                                        timeout=self.connect_timeout)
+        send_json(sock, {"cmd": "recover" if recover else "start",
+                         "jobid": self.jobid, "port": self._listen_port})
+        f = sock.makefile("r")
+        sock.settimeout(self.connect_timeout)
+        reply = recv_json(f)
+        sock.close()
+        if reply is not None and "error" in reply:
+            raise DMLCError(f"rabit: tracker rejected registration: "
+                            f"{reply['error']}")
+        if reply is None or "rank" not in reply:
+            raise DMLCError(f"rabit: bad tracker reply {reply!r}")
+        self.rank: int = reply["rank"]
+        self.world_size: int = reply["world"]
+        self.parent: int = reply["parent"]
+        self.children: List[int] = reply["children"]
+        self.ring_prev: int = reply["ring_prev"]
+        self.ring_next: int = reply["ring_next"]
+        self._addresses = {int(k): tuple(v)
+                           for k, v in reply["addresses"].items()}
+
+    # -- link management --
+    def _accept_loop(self) -> None:
+        while self._accepting:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                head = _recv_exact(conn, 8)
+                (peer_rank,) = struct.unpack("<q", head)
+                with self._peer_lock:
+                    self._peer_socks[peer_rank] = conn
+            except (DMLCError, OSError, struct.error):
+                # a bad handshake (reset, scanner, garbage) must never kill
+                # the accept thread — later peers still need to register
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _connect_links(self) -> None:
+        """Dial peers with rank < ours; accept from ranks > ours (a
+        deterministic direction avoids double links)."""
+        deadline = time.monotonic() + self.connect_timeout
+        needed = set(self._addresses)
+        for peer in sorted(needed):
+            if peer < self.rank:
+                sock = self._dial(peer, deadline)
+                with self._peer_lock:
+                    self._peer_socks[peer] = sock
+        # wait for inbound from higher ranks
+        higher = {p for p in needed if p > self.rank}
+        while True:
+            with self._peer_lock:
+                missing = higher - set(self._peer_socks)
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                raise DMLCError(f"rabit rank {self.rank}: peers {missing} "
+                                f"never connected")
+            time.sleep(0.01)
+
+    def _dial(self, peer: int, deadline: float) -> socket.socket:
+        host, port = self._addresses[peer]
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+                sock.sendall(struct.pack("<q", self.rank))
+                return sock
+            except OSError as e:
+                last_err = e
+                time.sleep(0.05)
+        raise DMLCError(f"rabit rank {self.rank}: cannot reach peer {peer} "
+                        f"at {host}:{port}: {last_err}")
+
+    def _sock_to(self, peer: int) -> socket.socket:
+        with self._peer_lock:
+            sock = self._peer_socks.get(peer)
+        if sock is None:
+            raise DMLCError(f"rabit rank {self.rank}: no link to {peer}")
+        return sock
+
+    # -- collectives (binary tree: reduce up, broadcast down) --
+    def allreduce(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
+        fn = _OPS.get(op)
+        if fn is None:
+            raise DMLCError(f"unknown op {op!r}; have {list(_OPS)}")
+        acc = np.array(x, copy=True)
+        for child in self.children:
+            contrib = np.frombuffer(_recv_blob(self._sock_to(child)),
+                                    dtype=acc.dtype).reshape(acc.shape)
+            acc = fn(acc, contrib)
+        if self.parent >= 0:
+            _send_blob(self._sock_to(self.parent), acc.tobytes())
+            acc = np.frombuffer(_recv_blob(self._sock_to(self.parent)),
+                                dtype=acc.dtype).reshape(acc.shape)
+        for child in self.children:
+            _send_blob(self._sock_to(child), acc.tobytes())
+        if not acc.flags.writeable:
+            # frombuffer views are read-only; callers mutate results in place
+            # (the reference rabit Allreduce is in-place by contract)
+            acc = acc.copy()
+        return acc
+
+    def broadcast(self, obj: Any, root: int = 0) -> Any:
+        """Tree broadcast of an arbitrary picklable object from ``root``.
+
+        Same two-phase traffic pattern as allreduce (climb then descend) with
+        a 'first non-empty wins' combiner, so arbitrary roots need no special
+        routing and every queued blob is always consumed."""
+        if self.world_size == 1:
+            return obj
+        payload = pickle.dumps(obj) if self.rank == root else b""
+        for child in self.children:
+            contrib = _recv_blob(self._sock_to(child))
+            if contrib and not payload:
+                payload = contrib
+        if self.parent >= 0:
+            _send_blob(self._sock_to(self.parent), payload)
+            payload = _recv_blob(self._sock_to(self.parent))
+        for child in self.children:
+            _send_blob(self._sock_to(child), payload)
+        if not payload:
+            raise DMLCError(f"broadcast: no payload reached rank {self.rank}")
+        return pickle.loads(payload)
+
+    def allgather(self, x: np.ndarray) -> np.ndarray:
+        """Gather per-rank arrays to all (via allreduce of a one-hot stack)."""
+        x = np.asarray(x)
+        stack = np.zeros((self.world_size,) + x.shape, x.dtype)
+        stack[self.rank] = x
+        return self.allreduce(stack, "sum")
+
+    # -- misc rabit API --
+    def tracker_print(self, msg: str) -> None:
+        self._tracker_cmd({"cmd": "print", "msg": msg})
+
+    def shutdown(self) -> None:
+        self._tracker_cmd({"cmd": "shutdown", "jobid": self.jobid})
+        self._accepting = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._peer_lock:
+            for sock in self._peer_socks.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._peer_socks.clear()
+
+    def _tracker_cmd(self, obj: dict) -> None:
+        sock = socket.create_connection(self.tracker_addr, timeout=10.0)
+        send_json(sock, obj)
+        sock.close()
+
+    def __enter__(self) -> "RabitContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+

@@ -1,0 +1,301 @@
+"""Rendezvous tracker: rank assignment, allreduce topology, restart recovery —
+capability parity with reference ``tracker/dmlc_tracker/tracker.py``.
+
+The reference tracker is a TCP server that (SURVEY §2.5): assigns ranks
+(sorted by host for locality, `tracker.py:294-311`), computes a **binary-tree
+allreduce topology** plus a **DFS ring** over it for bootstrap/recovery
+(`get_tree` :185, `find_share_ring` :193-210, `get_ring` :212-225), brokers
+worker⇄worker links, handles ``recover`` for restarted workers (:279-291) and
+``print``/``shutdown`` commands, then steps out of the data path.
+
+This implementation keeps the same capability on a fresh JSON-line protocol
+(the reference's magic-number binary protocol is an implementation detail of
+its C++ client; our client is :mod:`dmlc_core_tpu.parallel.rabit`):
+
+* phase 1 — every worker registers ``(jobid, host, listen_port)``;
+* phase 2 — tracker computes tree + ring, sends each worker its rank,
+  parent/children and ring prev/next **with addresses**, so link dialing
+  needs no further brokering;
+* ``recover`` — a restarted worker re-registers with its jobid and receives
+  the same rank and fresh neighbor addresses (elastic rejoin,
+  reference `tracker.py:279-291`);
+* ``print``/``shutdown`` — worker logging relay and teardown (:58-69).
+
+On TPU pods the *data-plane* collectives ride ICI via XLA (see
+``parallel.collectives``); this tracker is the control plane: bootstrap for
+non-JAX host processes, metadata exchange, elastic restart bookkeeping.  The
+``PSTracker`` analog (scheduler bootstrap env) is
+:func:`dmlc_core_tpu.parallel.launcher.tpu.jax_coordinator_env`.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import DMLCError, check, get_logger, log_info
+
+__all__ = ["RabitTracker", "compute_tree", "compute_ring", "recv_json",
+           "send_json"]
+
+logger = get_logger()
+
+
+# ---------------- topology math ----------------
+
+def compute_tree(world: int) -> Dict[int, List[int]]:
+    """Binary-tree neighbor map {rank: [neighbors]} (reference ``get_tree``
+    `tracker.py:185`: parent (r-1)//2, children 2r+1 / 2r+2)."""
+    nbrs: Dict[int, List[int]] = {r: [] for r in range(world)}
+    for r in range(1, world):
+        parent = (r - 1) // 2
+        nbrs[parent].append(r)
+        nbrs[r].append(parent)
+    return nbrs
+
+
+def tree_parent(rank: int) -> int:
+    return (rank - 1) // 2 if rank > 0 else -1
+
+
+def compute_ring(world: int) -> List[int]:
+    """DFS pre-order ring over the binary tree (reference ``find_share_ring``
+    `tracker.py:193-210`): consecutive ring hops share a tree edge, so
+    recovery traffic rides existing links."""
+    order: List[int] = []
+
+    def dfs(r: int) -> None:
+        if r >= world:
+            return
+        order.append(r)
+        dfs(2 * r + 1)
+        dfs(2 * r + 2)
+
+    dfs(0)
+    return order
+
+
+# ---------------- wire helpers (JSON-line protocol) ----------------
+
+def send_json(sock: socket.socket, obj: dict) -> None:
+    data = (json.dumps(obj) + "\n").encode()
+    sock.sendall(data)
+
+
+def recv_json(sock_file) -> Optional[dict]:
+    line = sock_file.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+# ---------------- tracker ----------------
+
+class _WorkerRecord:
+    def __init__(self, jobid: str, host: str, port: int):
+        self.jobid = jobid
+        self.host = host
+        self.port = port
+        self.rank = -1
+
+
+class RabitTracker:
+    """TCP rendezvous service (reference ``RabitTracker`` `tracker.py:137`).
+
+    >>> t = RabitTracker(num_workers=4); t.start()
+    >>> env = t.worker_envs()   # DMLC_TRACKER_URI/PORT for workers
+    >>> t.join()                 # until all workers shut down
+    """
+
+    def __init__(self, num_workers: int, host_ip: Optional[str] = None,
+                 port: int = 9091, max_port: int = 9999):
+        self.num_workers = num_workers
+        self.host_ip = host_ip or _default_host_ip()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        bound = False
+        for p in range(port, max_port + 1):  # port scan (reference :141-153)
+            try:
+                self._sock.bind((self.host_ip, p))
+                self.port = p
+                bound = True
+                break
+            except OSError:
+                continue
+        if not bound:
+            raise DMLCError(f"tracker: no free port in [{port}, {max_port}]")
+        self._sock.listen(128)
+        self._lock = threading.Condition()
+        self._workers: Dict[str, _WorkerRecord] = {}  # jobid → record
+        self._rank_of: Dict[str, int] = {}
+        self._assigned = False
+        self._shutdown_count = 0
+        self._start_time: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+
+    # -- public control --
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        log_info("tracker started at %s:%d for %d workers",
+                 self.host_ip, self.port, self.num_workers)
+
+    def worker_envs(self) -> Dict[str, str]:
+        """Env contract for workers (reference ``slave_envs`` `tracker.py:182`)."""
+        return {
+            "DMLC_TRACKER_URI": self.host_ip,
+            "DMLC_TRACKER_PORT": str(self.port),
+            "DMLC_NUM_WORKER": str(self.num_workers),
+        }
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Block until all workers sent shutdown (reference ``join`` :329-331)."""
+        deadline = time.monotonic() + timeout if timeout else None
+        with self._lock:
+            while self._shutdown_count < self.num_workers:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise DMLCError("tracker join timed out")
+                self._lock.wait(remaining)
+        if self._start_time is not None:
+            log_info("@tracker All of %d nodes got shutdown; %.2f secs between "
+                     "start and shutdown", self.num_workers,
+                     time.monotonic() - self._start_time)
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- accept/assign logic --
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        f = conn.makefile("r")
+        try:
+            msg = recv_json(f)
+            if msg is None:
+                return
+            cmd = msg.get("cmd")
+            if cmd == "print":
+                log_info("@worker: %s", msg.get("msg", ""))
+            elif cmd == "shutdown":
+                with self._lock:
+                    self._shutdown_count += 1
+                    self._lock.notify_all()
+            elif cmd in ("start", "recover"):
+                self._register_and_reply(conn, msg, recovering=(cmd == "recover"))
+            else:
+                send_json(conn, {"error": f"unknown cmd {cmd!r}"})
+        except (OSError, json.JSONDecodeError, KeyError, ValueError) as e:
+            logger.warning("tracker connection error: %s", e)
+            try:
+                send_json(conn, {"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _register_and_reply(self, conn: socket.socket, msg: dict,
+                            recovering: bool) -> None:
+        jobid = str(msg.get("jobid", ""))
+        host = msg.get("host") or conn.getpeername()[0]
+        port = int(msg["port"])
+        with self._lock:
+            if self._start_time is None:
+                self._start_time = time.monotonic()
+            rec = self._workers.get(jobid)
+            if rec is None:
+                rec = _WorkerRecord(jobid, host, port)
+                self._workers[jobid] = rec
+            else:
+                # restarted worker: keep rank, refresh address
+                rec.host, rec.port = host, port
+            if not self._assigned:
+                if len(self._workers) >= self.num_workers and not recovering:
+                    self._assign_ranks()
+                    self._lock.notify_all()
+                else:
+                    # wait until full cohort present
+                    while not self._assigned and not self._stop:
+                        self._lock.wait(timeout=1.0)
+            rec = self._workers[jobid]
+            if rec.rank < 0:
+                # a registration beyond the cohort (extra worker, or a server
+                # process misusing the worker rendezvous) gets a clean error
+                reply = {"error": f"cohort of {self.num_workers} already "
+                                  f"assigned; job {jobid!r} is not a member"}
+            else:
+                reply = self._build_assignment(rec)
+        send_json(conn, reply)
+
+    def _assign_ranks(self) -> None:
+        # sort by host then jobid for locality (reference :294-311)
+        ordered = sorted(self._workers.values(),
+                         key=lambda r: (r.host, r.jobid))
+        for rank, rec in enumerate(ordered):
+            rec.rank = rank
+            self._rank_of[rec.jobid] = rank
+        self._assigned = True
+        log_info("@tracker all %d workers registered; ranks assigned",
+                 self.num_workers)
+
+    def _addr_of(self, rank: int) -> Tuple[str, int]:
+        for rec in self._workers.values():
+            if rec.rank == rank:
+                return rec.host, rec.port
+        raise DMLCError(f"no worker with rank {rank}")
+
+    def _build_assignment(self, rec: _WorkerRecord) -> dict:
+        world = self.num_workers
+        tree = compute_tree(world)
+        ring = compute_ring(world)
+        pos = ring.index(rec.rank)
+        ring_prev = ring[(pos - 1) % world]
+        ring_next = ring[(pos + 1) % world]
+        parent = tree_parent(rec.rank)
+        children = [c for c in tree[rec.rank] if c != parent]
+        return {
+            "rank": rec.rank,
+            "world": world,
+            "parent": parent,
+            "children": children,
+            "tree_neighbors": tree[rec.rank],
+            "ring_prev": ring_prev,
+            "ring_next": ring_next,
+            "addresses": {str(r): list(self._addr_of(r))
+                          for r in set(tree[rec.rank] + [ring_prev, ring_next])
+                          if r != rec.rank},
+        }
+
+
+def _default_host_ip() -> str:
+    # prefer a routable address; fall back to loopback in sandboxes
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 53))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

@@ -1,0 +1,7 @@
+"""Host→HBM staging pipeline (TPU-native consumer side of the ingest ladder)."""
+
+from .packing import pack_flat, pack_rowmajor, batch_slices, PackStats  # noqa: F401
+from .device_loader import DeviceLoader  # noqa: F401
+
+__all__ = ["pack_flat", "pack_rowmajor", "batch_slices", "PackStats",
+           "DeviceLoader"]

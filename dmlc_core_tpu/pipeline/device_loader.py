@@ -1,0 +1,174 @@
+"""Double-buffered host→device feed: the TPU-native replacement for the
+reference's CPU consumer loop (SURVEY §7 "the prefetch ladder ends in a
+double-buffered device pipeline").
+
+Pipeline: parser (own thread) → fixed-shape packing (this thread pool) →
+``jax.device_put`` with an optional ``NamedSharding`` → bounded queue of
+device batches.  While step N computes on device, batch N+1 is already being
+transferred — the same producer/consumer contract as every other stage
+(``ThreadedIter``), ending in HBM instead of host RAM.
+
+With a sharding whose mesh spans multiple devices, ``device_put`` scatters
+the batch across them (data-parallel input sharding ≙ the reference's
+``ResetPartition(rank, nsplit)`` expressed on the device mesh instead of the
+byte range).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from ..data.iterators import RowBlockIter
+from ..data.parser import ParserBase
+from ..utils import ThreadedIter, check
+from .packing import PackStats, batch_slices, pack_flat, pack_rowmajor
+
+__all__ = ["DeviceLoader"]
+
+
+class DeviceLoader:
+    """Stream fixed-shape device batches from a parser or RowBlockIter.
+
+    Parameters
+    ----------
+    source:        ParserBase or RowBlockIter (anything yielding RowBlocks).
+    batch_rows:    rows per device batch (static shape).
+    nnz_cap:       flat layout: value capacity per batch; rowmajor layout:
+                   per-row capacity ``k_cap``.
+    layout:        'flat' (segment-sum ops) or 'rowmajor' (pallas kernel).
+    sharding:      optional ``jax.sharding.NamedSharding`` for the batch
+                   arrays (batch axis over 'dp' typically).
+    prefetch:      device batches to keep in flight (double buffer = 2).
+    drop_remainder: drop the final partial batch instead of padding it.
+    """
+
+    def __init__(self, source, batch_rows: int, nnz_cap: int,
+                 layout: str = "flat",
+                 sharding: Optional[jax.sharding.Sharding] = None,
+                 prefetch: int = 2, drop_remainder: bool = False):
+        check(layout in ("flat", "rowmajor"), f"bad layout {layout!r}")
+        self.source = source
+        self.batch_rows = batch_rows
+        self.nnz_cap = nnz_cap
+        self.layout = layout
+        self.sharding = sharding
+        self.drop_remainder = drop_remainder
+        self.stats = PackStats()
+        self._iter: ThreadedIter = ThreadedIter(max_capacity=prefetch)
+        self._iter.init(self._produce_factory(), self._reset_source)
+        self._gen = None
+
+    # -- producer side --
+    def _blocks(self) -> Iterator:
+        src = self.source
+        if isinstance(src, ParserBase):
+            for container in src:
+                yield container.get_block()
+        elif isinstance(src, RowBlockIter):
+            for blk in src:
+                yield blk
+        else:  # any iterable of RowBlocks
+            for blk in src:
+                yield blk
+
+    def _batches(self) -> Iterator[Dict[str, jax.Array]]:
+        carry = None
+        for blk in self._blocks():
+            for piece in batch_slices(blk, self.batch_rows):
+                if piece.size == self.batch_rows:
+                    yield self._to_device(piece)
+                else:
+                    # merge leftovers across source blocks
+                    if carry is None:
+                        carry = _Accum(self.batch_rows)
+                    full = carry.add(piece)
+                    if full is not None:
+                        yield self._to_device(full)
+        if carry is not None and carry.rows > 0 and not self.drop_remainder:
+            yield self._to_device(carry.flush())
+
+    def _produce_factory(self):
+        state = {"gen": None}
+
+        def next_fn(_cell):
+            if state["gen"] is None:
+                state["gen"] = self._batches()
+            try:
+                return next(state["gen"])
+            except StopIteration:
+                state["gen"] = None
+                return None
+
+        self._producer_state = state
+        return next_fn
+
+    def _reset_source(self):
+        self._producer_state["gen"] = None
+        self.source.before_first()
+
+    def _to_device(self, block) -> Dict[str, jax.Array]:
+        if self.layout == "flat":
+            host = pack_flat(block, self.batch_rows, self.nnz_cap, self.stats)
+        else:
+            host = pack_rowmajor(block, self.batch_rows, self.nnz_cap, self.stats)
+        # all packed arrays lead with the batch/nnz axis, so one sharding fits
+        return {k: jax.device_put(v, self.sharding) for k, v in host.items()}
+
+    # -- consumer side --
+    def __iter__(self):
+        while True:
+            b = self.next_batch()
+            if b is None:
+                return
+            yield b
+
+    def next_batch(self) -> Optional[Dict[str, jax.Array]]:
+        return self._iter.next()
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+
+    def close(self) -> None:
+        self._iter.destroy()
+        if hasattr(self.source, "close"):
+            self.source.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _Accum:
+    """Accumulate partial RowBlocks into a full batch."""
+
+    def __init__(self, batch_rows: int):
+        from ..data.row_block import RowBlockContainer
+        self.batch_rows = batch_rows
+        self._container_cls = RowBlockContainer
+        self._c = RowBlockContainer()
+
+    @property
+    def rows(self) -> int:
+        return self._c.size
+
+    def add(self, piece):
+        self._c.push_block(piece)
+        if self._c.size >= self.batch_rows:
+            blk = self._c.get_block()
+            out = blk.slice(0, self.batch_rows)
+            rest = blk.slice(self.batch_rows, blk.size)
+            self._c = self._container_cls()
+            if rest.size:
+                self._c.push_block(rest)
+            return out
+        return None
+
+    def flush(self):
+        blk = self._c.get_block()
+        self._c = self._container_cls()
+        return blk

@@ -1,0 +1,159 @@
+"""Pack ragged CSR RowBlocks into fixed-shape device batches.
+
+XLA compiles one program per shape (SURVEY §7: "static shapes"), so the
+variable-length RowBlocks coming off the parsers must become **fixed-shape**
+arrays before hitting the TPU.  Two layouts:
+
+* :func:`pack_flat` — flat CSR: ``ids[nnz_cap]``, ``vals[nnz_cap]``,
+  ``segments[nnz_cap]`` (row id per entry; padding entries get
+  ``segment == batch_rows`` so a trailing scratch row absorbs them — see
+  ``ops.csr``), plus ``labels/weights[batch_rows]``.  Rows whose values
+  overflow ``nnz_cap`` are truncated (counted in ``truncated``).
+* :func:`pack_rowmajor` — row-padded ``ids/vals[batch_rows, k_cap]`` for the
+  Pallas embedding-bag kernel.
+
+Padding rows carry ``weight 0`` so losses ignore them without masking logic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from ..data.row_block import RowBlock
+
+__all__ = ["pack_flat", "pack_rowmajor", "batch_slices", "PackStats"]
+
+
+@dataclass
+class PackStats:
+    rows: int = 0
+    padded_rows: int = 0
+    truncated_values: int = 0
+
+
+def _waterfill(counts: np.ndarray, cap: int) -> np.ndarray:
+    """keep[i] = min(counts[i], t) + at most 1, chosen so keep.sum() == cap
+    exactly (when counts.sum() >= cap) with the fewest values dropped."""
+    counts = counts.astype(np.int64)
+    if counts.sum() <= cap:
+        return counts
+    order = np.argsort(counts)
+    sorted_counts = counts[order]
+    n = len(counts)
+    # prefix[i] = sum of the i smallest counts
+    prefix = np.concatenate([[0], np.cumsum(sorted_counts)])
+    # with level t, usage = prefix[k] + (n - k) * t where k = #counts <= t;
+    # scan candidate levels from the sorted values
+    t = 0
+    for k in range(n):
+        remaining = n - k
+        # max level if all rows >= this one are capped equally
+        level = (cap - prefix[k]) // remaining
+        if level <= sorted_counts[k]:
+            t = max(t, level)
+            break
+        t = sorted_counts[k]
+    keep = np.minimum(counts, t)
+    leftover = cap - int(keep.sum())
+    if leftover > 0:
+        # hand spare slots to the rows still truncated, largest first
+        cand = np.argsort(-(counts - keep))
+        for i in cand[:leftover]:
+            if counts[i] > keep[i]:
+                keep[i] += 1
+    return keep
+
+
+def batch_slices(block: RowBlock, batch_rows: int) -> Iterator[RowBlock]:
+    """Split a RowBlock into consecutive ≤batch_rows slices (O(1) views)."""
+    for start in range(0, block.size, batch_rows):
+        yield block.slice(start, min(start + batch_rows, block.size))
+
+
+def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
+              stats: Optional[PackStats] = None) -> Dict[str, np.ndarray]:
+    """Flat-CSR fixed-shape batch; ``block.size`` must be ≤ batch_rows."""
+    n = block.size
+    assert n <= batch_rows, (n, batch_rows)
+    offsets = block.offsets.astype(np.int64)
+    rel = offsets - offsets[0]
+    counts = np.diff(rel)
+    total = int(rel[-1])
+
+    ids = np.zeros(nnz_cap, np.int32)
+    vals = np.zeros(nnz_cap, np.float32)
+    segments = np.full(nnz_cap, batch_rows, np.int32)  # padding → scratch row
+
+    truncated = 0
+    if total <= nnz_cap:
+        take = total
+        src_idx = slice(int(offsets[0]), int(offsets[0]) + take)
+        ids[:take] = block.indices[src_idx].astype(np.int32)
+        if block.values is not None:
+            vals[:take] = block.values[src_idx]
+        else:
+            vals[:take] = 1.0
+        segments[:take] = np.repeat(np.arange(n, dtype=np.int32), counts)
+    else:
+        # per-row truncation by water-filling: find the largest level t such
+        # that sum(min(counts, t)) <= nnz_cap, then hand the remaining slots
+        # one-by-one to the longest rows — short rows keep everything and
+        # only the minimum number of values is dropped
+        keep = _waterfill(counts, nnz_cap)
+        pos = 0
+        for r in range(n):
+            k = int(keep[r])
+            b = int(offsets[r])
+            ids[pos:pos + k] = block.indices[b:b + k].astype(np.int32)
+            if block.values is not None:
+                vals[pos:pos + k] = block.values[b:b + k]
+            else:
+                vals[pos:pos + k] = 1.0
+            segments[pos:pos + k] = r
+            pos += k
+        truncated = total - pos
+
+    labels = np.zeros(batch_rows, np.float32)
+    weights = np.zeros(batch_rows, np.float32)  # padding rows weigh 0
+    labels[:n] = block.labels
+    weights[:n] = (block.weights if block.weights is not None
+                   else np.ones(n, np.float32))
+    if stats is not None:
+        stats.rows += n
+        stats.padded_rows += batch_rows - n
+        stats.truncated_values += truncated
+    return {"ids": ids, "vals": vals, "segments": segments,
+            "labels": labels, "weights": weights}
+
+
+def pack_rowmajor(block: RowBlock, batch_rows: int, k_cap: int,
+                  stats: Optional[PackStats] = None) -> Dict[str, np.ndarray]:
+    """Row-padded [batch_rows, k_cap] batch for the Pallas embedding kernel."""
+    n = block.size
+    assert n <= batch_rows, (n, batch_rows)
+    ids = np.zeros((batch_rows, k_cap), np.int32)
+    vals = np.zeros((batch_rows, k_cap), np.float32)
+    offsets = block.offsets.astype(np.int64)
+    truncated = 0
+    for r in range(n):
+        b, e = int(offsets[r]), int(offsets[r + 1])
+        k = min(e - b, k_cap)
+        truncated += (e - b) - k
+        ids[r, :k] = block.indices[b:b + k].astype(np.int32)
+        if block.values is not None:
+            vals[r, :k] = block.values[b:b + k]
+        else:
+            vals[r, :k] = 1.0
+    labels = np.zeros(batch_rows, np.float32)
+    weights = np.zeros(batch_rows, np.float32)
+    labels[:n] = block.labels
+    weights[:n] = (block.weights if block.weights is not None
+                   else np.ones(n, np.float32))
+    if stats is not None:
+        stats.rows += n
+        stats.padded_rows += batch_rows - n
+        stats.truncated_values += truncated
+    return {"ids": ids, "vals": vals, "labels": labels, "weights": weights}

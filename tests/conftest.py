@@ -1,16 +1,41 @@
-"""Test config: force an 8-device virtual CPU mesh before JAX is imported.
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
 
 Mirrors the reference's test strategy (SURVEY §4): everything runs single-host
 CPU; distributed behavior is validated on simulated devices
 (``xla_force_host_platform_device_count``) the way the reference validates
 partitioning single-process and the tracker with ``--cluster local``.
+
+The axon TPU plugin (registered process-wide by a sitecustomize hook) is
+explicitly deregistered: tests must never depend on — or hang on — the
+tunneled real chip, and ``JAX_PLATFORMS=cpu`` alone does not stop the plugin's
+client initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+
+def _force_cpu_jax() -> None:
+    """The axon register() hook may override jax_platforms via config (which
+    wins over env), so pin the config AND drop the axon backend factory."""
+    try:
+        import jax
+        from jax._src import xla_bridge
+    except Exception:
+        return
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    reg = getattr(xla_bridge, "_backend_factories", None)
+    if isinstance(reg, dict):
+        reg.pop("axon", None)
+
+
+_force_cpu_jax()

@@ -1,0 +1,111 @@
+"""Packing + DeviceLoader tests: fixed shapes, padding/truncation accounting,
+epoch resets, row conservation."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data import RowBlockContainer, create_parser
+from dmlc_core_tpu.pipeline import (DeviceLoader, PackStats, batch_slices,
+                                    pack_flat, pack_rowmajor)
+
+
+def block_of(rows):
+    c = RowBlockContainer()
+    for label, idx, vals in rows:
+        c.push_row(label, idx, vals)
+    return c.get_block()
+
+
+def test_pack_flat_shapes_and_padding():
+    blk = block_of([(1.0, [3, 7], [0.5, 1.5]), (0.0, [2], [2.0])])
+    out = pack_flat(blk, batch_rows=4, nnz_cap=8)
+    assert out["ids"].shape == (8,) and out["labels"].shape == (4,)
+    np.testing.assert_array_equal(out["ids"][:3], [3, 7, 2])
+    np.testing.assert_array_equal(out["segments"][:3], [0, 0, 1])
+    np.testing.assert_array_equal(out["segments"][3:], [4, 4, 4, 4, 4])
+    np.testing.assert_array_equal(out["weights"], [1, 1, 0, 0])
+    assert out["vals"][3:].sum() == 0
+
+
+def test_pack_flat_truncation():
+    blk = block_of([(1.0, list(range(10)), [1.0] * 10),
+                    (0.0, list(range(10, 16)), [1.0] * 6)])
+    stats = PackStats()
+    out = pack_flat(blk, batch_rows=2, nnz_cap=8, stats=stats)
+    assert stats.truncated_values == 8
+    # both rows keep some values
+    assert (out["segments"] == 0).sum() > 0
+    assert (out["segments"] == 1).sum() > 0
+
+
+def test_waterfill_minimal_truncation():
+    from dmlc_core_tpu.pipeline.packing import _waterfill
+    # skewed rows: short rows keep everything, only the minimum is dropped
+    keep = _waterfill(np.array([1, 12]), 10)
+    assert keep.sum() == 10 and keep.tolist() == [1, 9]
+    keep = _waterfill(np.array([2, 3, 10]), 9)
+    assert keep.sum() == 9 and keep.tolist() == [2, 3, 4]
+    keep = _waterfill(np.array([5, 5, 5]), 9)
+    assert keep.sum() == 9
+    assert _waterfill(np.array([2, 2]), 10).tolist() == [2, 2]  # no-op
+    assert _waterfill(np.array([4, 4]), 1).sum() == 1
+
+
+def test_pack_rowmajor():
+    blk = block_of([(1.0, [3, 7, 9], None), (0.0, [2], [2.0])])
+    out = pack_rowmajor(blk, batch_rows=3, k_cap=2)
+    assert out["ids"].shape == (3, 2)
+    np.testing.assert_array_equal(out["ids"][0], [3, 7])   # truncated to k_cap
+    np.testing.assert_array_equal(out["vals"][0], [1, 1])  # implicit 1.0
+    np.testing.assert_array_equal(out["ids"][1], [2, 0])
+    np.testing.assert_array_equal(out["weights"], [1, 1, 0])
+
+
+def test_batch_slices():
+    blk = block_of([(float(i), [i], [1.0]) for i in range(10)])
+    pieces = list(batch_slices(blk, 4))
+    assert [p.size for p in pieces] == [4, 4, 2]
+    assert pieces[2].labels.tolist() == [8.0, 9.0]
+
+
+@pytest.fixture()
+def libsvm_file(tmp_path):
+    rng = np.random.default_rng(0)
+    path = tmp_path / "d.libsvm"
+    with open(path, "w") as f:
+        for i in range(1037):  # deliberately not a multiple of batch size
+            n = int(rng.integers(1, 6))
+            idx = sorted(rng.choice(100, n, replace=False).tolist())
+            f.write(f"{i % 2} " + " ".join(f"{j}:1" for j in idx) + "\n")
+    return str(path)
+
+
+def test_device_loader_row_conservation(libsvm_file):
+    with DeviceLoader(create_parser(libsvm_file), batch_rows=128,
+                      nnz_cap=1024) as loader:
+        batches = list(loader)
+        rows = sum(int(np.asarray(b["weights"]).sum()) for b in batches)
+        assert rows == 1037
+        assert all(b["labels"].shape == (128,) for b in batches)
+        # epochs
+        loader.before_first()
+        rows2 = sum(int(np.asarray(b["weights"]).sum()) for b in loader)
+        assert rows2 == 1037
+    assert loader.stats.rows >= 1037
+
+
+def test_device_loader_drop_remainder(libsvm_file):
+    with DeviceLoader(create_parser(libsvm_file), batch_rows=128,
+                      nnz_cap=1024, drop_remainder=True) as loader:
+        batches = list(loader)
+    assert len(batches) == 1037 // 128
+    for b in batches:
+        assert int(np.asarray(b["weights"]).sum()) == 128
+
+
+def test_device_loader_rowmajor_layout(libsvm_file):
+    with DeviceLoader(create_parser(libsvm_file), batch_rows=64, nnz_cap=8,
+                      layout="rowmajor") as loader:
+        b = loader.next_batch()
+        assert b["ids"].shape == (64, 8)
+        assert b["vals"].shape == (64, 8)
