@@ -1,0 +1,48 @@
+"""Ring attention (sequence parallelism) correctness on the 8-device virtual
+CPU mesh: exact match vs single-device attention, causal and non-causal."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from dmlc_core_tpu.ops.ring_attention import (make_ring_attention,  # noqa: E402
+                                              reference_attention)
+
+
+def make_qkv(rng, B=2, T=32, H=2, D=16):
+    return [jnp.array(rng.standard_normal((B, T, H, D)), jnp.float32)
+            for _ in range(3)]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(causal):
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.array(devices), ("sp",))
+    rng = np.random.default_rng(0)
+    q, k, v = make_qkv(rng)
+    sharding = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+    fn = make_ring_attention(mesh, "sp", causal=causal)
+    out = fn(qs, ks, vs)
+    expect = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+    # output keeps the sequence sharding (no gather to one device)
+    assert out.sharding.spec == P(None, "sp", None, None)
+
+
+def test_single_device_ring_degenerates():
+    # world=1: ring attention is just flash-style blockwise attention
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    rng = np.random.default_rng(1)
+    q, k, v = make_qkv(rng, T=8)
+    fn = make_ring_attention(mesh, "sp", causal=True)
+    out = fn(q, k, v)
+    expect = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
