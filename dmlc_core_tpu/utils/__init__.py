@@ -12,6 +12,10 @@ from .config import Config  # noqa: F401
 from .threaded_iter import ThreadedIter  # noqa: F401
 from .timer import get_time, Timer  # noqa: F401
 from . import serializer  # noqa: F401
+from .json import (  # noqa: F401
+    JSONReader, JSONWriter, JSONObjectReadHelper, AnyValue,
+    register_any_type, read_any, json_dumps, json_loads,
+)
 
 
 def split(s: str, delim: str) -> list:

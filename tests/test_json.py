@@ -1,0 +1,183 @@
+"""JSON reader/writer tests — mirrors reference ``unittest_json.cc`` shape:
+round-trips of STL-like compositions, struct helper contract, any maps."""
+
+import io
+
+import pytest
+
+from dmlc_core_tpu.utils.json import (
+    AnyValue,
+    JSONError,
+    JSONObjectReadHelper,
+    JSONReader,
+    JSONWriter,
+    json_dumps,
+    json_loads,
+    read_any,
+    register_any_type,
+)
+
+
+def test_scalar_roundtrip():
+    for v in [0, 1, -3, 3.5, True, False, None, "hello", 'quote " slash \\']:
+        assert json_loads(json_dumps(v)) == v
+
+
+def test_nested_composition_roundtrip():
+    v = {"a": [1, 2, 3], "b": {"x": [1.5, -2.5], "y": "str"},
+         "c": [], "d": {}, "e": [[1], [2, 3]]}
+    assert json_loads(json_dumps(v)) == v
+
+
+def test_output_is_valid_stdlib_json():
+    import json as stdjson
+    v = {"k": [1, {"n": None, "b": True}], "s": "line\nbreak"}
+    assert stdjson.loads(json_dumps(v)) == v
+
+
+def test_reads_stdlib_output():
+    import json as stdjson
+    v = {"k": [1, 2], "nested": {"a": "b"}, "f": 1.25}
+    assert json_loads(stdjson.dumps(v)) == v
+
+
+def test_streaming_cursor_api():
+    r = JSONReader('{"one": 1, "arr": [10, 20]}')
+    r.begin_object()
+    assert r.next_object_item() == "one"
+    assert r.read_int() == 1
+    assert r.next_object_item() == "arr"
+    vals = []
+    r.begin_array()
+    while r.next_array_item():
+        vals.append(r.read_int())
+    assert vals == [10, 20]
+    assert r.next_object_item() is None
+
+
+def test_writer_streaming_api():
+    w = JSONWriter()
+    w.begin_object()
+    w.write_object_keyvalue("a", 1)
+    w.write_object_keyvalue("b", [True, None])
+    w.end_object()
+    assert json_loads(w.getvalue()) == {"a": 1, "b": [True, None]}
+
+
+def test_error_has_line_number():
+    with pytest.raises(JSONError, match="Line 2"):
+        json_loads('{"a": 1,\n "b": }')
+
+
+def test_unterminated_string():
+    with pytest.raises(JSONError):
+        json_loads('"abc')
+
+
+def test_helper_required_and_unknown_fields():
+    h = JSONObjectReadHelper()
+    h.declare_field("name", lambda r: r.read_string())
+    h.declare_optional_field("count", lambda r: r.read_int(), default=7)
+    out = h.read_all_fields(JSONReader('{"name": "x"}'))
+    assert out == {"name": "x", "count": 7}
+
+    h2 = JSONObjectReadHelper()
+    h2.declare_field("name")
+    with pytest.raises(JSONError, match="missing required"):
+        h2.read_all_fields(JSONReader("{}"))
+
+    h3 = JSONObjectReadHelper()
+    h3.declare_field("name")
+    with pytest.raises(JSONError, match="unknown field"):
+        h3.read_all_fields(JSONReader('{"name": "x", "bogus": 1}'))
+
+
+def test_any_map_roundtrip():
+    register_any_type("int", int, int)
+    register_any_type("strlist", list, list)
+    w = JSONWriter()
+    w.begin_object()
+    w.write_object_keyvalue("n", AnyValue("int", 42))
+    w.write_object_keyvalue("l", AnyValue("strlist", ["a", "b"]))
+    w.end_object()
+
+    r = JSONReader(w.getvalue())
+    r.begin_object()
+    out = {}
+    while True:
+        k = r.next_object_item()
+        if k is None:
+            break
+        out[k] = read_any(r)
+    assert out["n"] == AnyValue("int", 42)
+    assert out["l"] == AnyValue("strlist", ["a", "b"])
+
+
+def test_unregistered_any_rejected():
+    with pytest.raises(JSONError, match="not registered"):
+        json_dumps(AnyValue("nope_never_registered", 1))
+
+
+def test_reader_from_stream_object():
+    r = JSONReader(io.StringIO('[1, "two", [3]]'))
+    assert r.read() == [1, "two", [3]]
+
+
+def test_unicode_escape():
+    assert json_loads('"\\u0041\\u00e9"') == "Aé"
+
+
+def test_surrogate_pair_from_stdlib():
+    import json as stdjson
+    s = "emoji \U0001f600 end"
+    assert json_loads(stdjson.dumps(s)) == s
+
+
+def test_large_int_exact_roundtrip():
+    for v in [10**17 + 1, 2**63 - 1, -(2**62 + 3)]:
+        assert json_loads(json_dumps(v)) == v
+        r = JSONReader(json_dumps(v))
+        assert r.read_int() == v
+
+
+def test_control_chars_valid_json():
+    import json as stdjson
+    s = "bs\b ff\f bell\x07"
+    assert stdjson.loads(json_dumps(s)) == s
+    assert json_loads(json_dumps(s)) == s
+
+
+def test_nonfinite_float_rejected():
+    for v in [float("nan"), float("inf"), float("-inf")]:
+        with pytest.raises(JSONError, match="non-finite"):
+            json_dumps(v)
+
+
+def test_helper_reuse_no_stale_values():
+    h = JSONObjectReadHelper()
+    h.declare_field("name", lambda r: r.read_string())
+    h.declare_optional_field("count", lambda r: r.read_int(), default=7)
+    assert h.read_all_fields(JSONReader('{"name": "a", "count": 5}')) == \
+        {"name": "a", "count": 5}
+    # second record omits count: default must apply, not the stale 5
+    assert h.read_all_fields(JSONReader('{"name": "b"}')) == \
+        {"name": "b", "count": 7}
+
+
+def test_write_json_streaming_hook():
+    class Point:
+        def __init__(self, x, y):
+            self.x, self.y = x, y
+
+        def write_json(self, w):
+            w.begin_object()
+            w.write_object_keyvalue("x", self.x)
+            w.write_object_keyvalue("y", self.y)
+            w.end_object()
+
+    assert json_loads(json_dumps(Point(1, 2))) == {"x": 1, "y": 2}
+
+
+def test_read_any_exported_from_package():
+    from dmlc_core_tpu import utils
+    assert hasattr(utils, "read_any")
