@@ -28,6 +28,9 @@ from .recordio import (KMAGIC, RecordIOWriter, RecordIOReader,
 from .input_split import (InputSplit, InputSplitBase, LineSplitter,
                           RecordIOSplitter, expand_uris)
 from .wrappers import ThreadedInputSplit, CachedInputSplit, ShuffleInputSplit
+from .remote_filesys import (RangedReadStream, HttpFileSystem, S3FileSystem,
+                             GCSFileSystem, WebHDFSFileSystem,
+                             AzureFileSystem, sign_v4)
 from .indexed_recordio_split import IndexedRecordIOSplit, write_recordio_index
 from .single_file_split import SingleFileSplit
 
@@ -41,6 +44,8 @@ __all__ = [
     "ThreadedInputSplit", "CachedInputSplit", "ShuffleInputSplit",
     "IndexedRecordIOSplit", "SingleFileSplit", "write_recordio_index",
     "create_input_split", "expand_uris",
+    "RangedReadStream", "HttpFileSystem", "S3FileSystem", "GCSFileSystem",
+    "WebHDFSFileSystem", "AzureFileSystem", "sign_v4",
 ]
 
 

@@ -1,0 +1,767 @@
+"""Remote object-store filesystems: HTTP, S3, GCS, WebHDFS, Azure.
+
+Capability parity with the reference's biggest native piece,
+``src/io/s3_filesys.{h,cc}`` (1012 LoC) plus ``hdfs_filesys.cc`` and
+``azure_filesys.cc``:
+
+* :class:`RangedReadStream` — the ``CURLReadStreamBase`` equivalent
+  (`s3_filesys.cc:219-361`): a seekable read stream over HTTP ranged GETs
+  with buffered fill and **restart-on-seek** (`s3_filesys.cc:234-239` —
+  a seek outside the buffer drops the in-flight transfer and re-issues a
+  Range request at the new offset).
+* :class:`S3FileSystem` — AWS **SigV4** request signing (the reference used
+  v2 HMAC-SHA1, `s3_filesys.cc:90-121`; v4 is what current S3 requires),
+  ``ListObjectsV2`` XML parsing (`s3_filesys.cc:801`), and **multipart
+  upload** write streams (Initiate/UploadPart/Complete,
+  `s3_filesys.cc:747-799`) with the same ≥5MB part buffering
+  (`s3_filesys.cc:646-653`). Credentials from the environment incl. session
+  token, region and custom endpoint (`s3_filesys.cc:926` ctor).
+* :class:`GCSFileSystem` — ``gs://`` through the S3-compatible XML API
+  (HMAC interop keys), the TPU-idiomatic object store playing S3's role.
+* :class:`WebHDFSFileSystem` — ``hdfs://`` over the WebHDFS REST API
+  (the reference wraps libhdfs JNI, `hdfs_filesys.cc:31-75`; REST keeps the
+  same Open/Read-at-offset/GetPathInfo/List surface with zero native deps).
+* :class:`AzureFileSystem` — ``azure://`` blob listing (the reference's
+  Azure backend is listing-only as well, `azure_filesys.cc:42-80`).
+
+Everything speaks plain ``http.client``, so the full wire behavior is unit-
+testable against in-process fake servers (tests/test_remote_filesys.py) —
+the moral equivalent of the reference's S3 soak test (`test/README.md:1-30`)
+without needing cloud credentials or egress.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import hmac
+import http.client
+import io
+import os
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from typing import BinaryIO, Dict, List, Optional, Tuple
+
+from ..utils import DMLCError, check
+from .filesys import FS_REGISTRY, FileInfo, FileSystem
+from .uri import URI
+
+__all__ = [
+    "RangedReadStream", "HttpFileSystem", "S3FileSystem", "GCSFileSystem",
+    "WebHDFSFileSystem", "AzureFileSystem", "sign_v4",
+]
+
+_DEFAULT_BUFFER = 2 << 20      # fill granularity (ref kBufferSize 2MiB, input_split_base.h:40)
+_MIN_PART_SIZE = 5 << 20       # S3 minimum multipart part (ref s3_filesys.cc:646)
+_MAX_RETRY = 3
+
+
+def _http_request(scheme: str, netloc: str, method: str, path_qs: str,
+                  headers: Dict[str, str], body: bytes = b"",
+                  timeout: float = 60.0) -> Tuple[int, Dict[str, str], bytes]:
+    """One HTTP round trip with retry on transient failures."""
+    last_exc: Optional[Exception] = None
+    for attempt in range(_MAX_RETRY):
+        conn = None
+        try:
+            cls = (http.client.HTTPSConnection if scheme == "https"
+                   else http.client.HTTPConnection)
+            conn = cls(netloc, timeout=timeout)
+            conn.request(method, path_qs, body=body or None, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            hdrs = {k.lower(): v for k, v in resp.getheaders()}
+            if resp.status >= 500 and attempt + 1 < _MAX_RETRY:
+                time.sleep(0.1 * (attempt + 1))
+                continue
+            return resp.status, hdrs, data
+        except (OSError, http.client.HTTPException) as e:
+            last_exc = e
+            time.sleep(0.1 * (attempt + 1))
+        finally:
+            if conn is not None:
+                conn.close()
+    raise DMLCError(f"http {method} {netloc}{path_qs} failed: {last_exc}")
+
+
+class RangedReadStream(io.RawIOBase):
+    """Seekable read stream over HTTP Range GETs with restart-on-seek.
+
+    The ``CURLReadStreamBase`` design (`s3_filesys.cc:219-361`): a buffer is
+    filled by ranged GETs starting at ``curr_bytes_``; ``Seek`` outside the
+    buffered window discards state and restarts the transfer at the new
+    offset (`s3_filesys.cc:234-239`). Subclasses provide
+    :meth:`_request_headers` to sign each range request.
+    """
+
+    def __init__(self, scheme: str, netloc: str, path_qs: str,
+                 size: Optional[int] = None,
+                 buffer_size: int = _DEFAULT_BUFFER) -> None:
+        super().__init__()
+        self._scheme = scheme
+        self._netloc = netloc
+        self._path_qs = path_qs
+        self._buffer_size = buffer_size
+        self._size = size          # lazily discovered from Content-Range
+        self._pos = 0              # logical read position
+        self._buf = b""
+        self._buf_start = 0        # file offset of self._buf[0]
+
+    # subclass hook: per-request auth headers (S3 signs every range request)
+    def _request_headers(self, method: str,
+                         headers: Dict[str, str]) -> Dict[str, str]:
+        return headers
+
+    def _fetch(self, start: int, end_excl: int) -> bytes:
+        headers = {"Range": f"bytes={start}-{end_excl - 1}"}
+        headers = self._request_headers("GET", headers)
+        status, hdrs, data = _http_request(
+            self._scheme, self._netloc, "GET", self._path_qs, headers)
+        if status == 206:
+            cr = hdrs.get("content-range", "")
+            if "/" in cr and self._size is None:
+                try:
+                    self._size = int(cr.rsplit("/", 1)[1])
+                except ValueError:
+                    pass
+            return data
+        if status == 200:
+            # server ignored Range: got whole body; slice what we asked for
+            if self._size is None:
+                self._size = len(data)
+            return data[start:end_excl]
+        if status in (404, 403):
+            raise DMLCError(
+                f"GET {self._netloc}{self._path_qs}: HTTP {status}")
+        if status == 416:           # requested range beyond EOF
+            return b""
+        raise DMLCError(
+            f"GET {self._netloc}{self._path_qs} range {start}-{end_excl}: "
+            f"HTTP {status}")
+
+    # -- io.RawIOBase interface --------------------------------------------
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def tell(self) -> int:
+        return self._pos
+
+    def _length(self) -> int:
+        if self._size is None:
+            headers = self._request_headers("HEAD", {})
+            status, hdrs, _ = _http_request(
+                self._scheme, self._netloc, "HEAD", self._path_qs, headers)
+            if status != 200 or "content-length" not in hdrs:
+                # fall back: probe with a 1-byte range GET
+                self._fetch(0, 1)
+                if self._size is None:
+                    raise DMLCError(
+                        f"cannot determine size of {self._netloc}{self._path_qs}")
+            else:
+                self._size = int(hdrs["content-length"])
+        return self._size
+
+    def seek(self, offset: int, whence: int = os.SEEK_SET) -> int:
+        if whence == os.SEEK_SET:
+            new = offset
+        elif whence == os.SEEK_CUR:
+            new = self._pos + offset
+        elif whence == os.SEEK_END:
+            new = self._length() + offset
+        else:
+            raise ValueError(f"bad whence {whence}")
+        check(new >= 0, "negative seek position")
+        # restart-on-seek: outside the buffered window → drop buffer
+        if not (self._buf_start <= new <= self._buf_start + len(self._buf)):
+            self._buf = b""
+            self._buf_start = new
+        self._pos = new
+        return self._pos
+
+    def readinto(self, b) -> int:
+        want = len(b)
+        if want == 0:
+            return 0
+        off = self._pos - self._buf_start
+        if not (0 <= off < len(self._buf)):
+            # refill buffer at current position
+            if self._size is not None and self._pos >= self._size:
+                return 0
+            fill = max(self._buffer_size, want)
+            data = self._fetch(self._pos, self._pos + fill)
+            if not data:
+                return 0
+            self._buf = data
+            self._buf_start = self._pos
+            off = 0
+        n = min(want, len(self._buf) - off)
+        b[:n] = self._buf[off:off + n]
+        self._pos += n
+        return n
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            chunks = []
+            while True:
+                c = super().read(self._buffer_size)
+                if not c:
+                    return b"".join(chunks)
+                chunks.append(c)
+        return super().read(n) or b""
+
+
+# ---------------------------------------------------------------------------
+# http:// / https:// — read-only remote files (ref HttpReadStream
+# s3_filesys.cc:533-549: unsigned ranged reads over any URL)
+# ---------------------------------------------------------------------------
+
+class HttpFileSystem(FileSystem):
+    """Read-only FS over plain HTTP(S) (reference `s3_filesys.cc:533-549`)."""
+
+    def __init__(self, scheme: str = "http") -> None:
+        self._scheme = scheme
+
+    def get_path_info(self, uri: URI) -> FileInfo:
+        status, hdrs, _ = _http_request(self._scheme, uri.host, "HEAD",
+                                        uri.name or "/", {})
+        if status != 200:
+            raise DMLCError(f"HEAD {uri.raw}: HTTP {status}")
+        return FileInfo(path=uri.raw, size=int(hdrs.get("content-length", 0)),
+                        type="file")
+
+    def list_directory(self, uri: URI) -> List[FileInfo]:
+        raise DMLCError("HttpFileSystem does not support listing")
+
+    def open(self, uri: URI, mode: str) -> BinaryIO:
+        check(mode == "r", "http(s):// is read-only")
+        return RangedReadStream(self._scheme, uri.host, uri.name or "/")
+
+
+# ---------------------------------------------------------------------------
+# AWS Signature Version 4
+# ---------------------------------------------------------------------------
+
+def _sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac_sha256(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sign_v4(method: str, host: str, path: str,
+            query: Dict[str, str], headers: Dict[str, str],
+            payload_hash: str, region: str, service: str,
+            access_key: str, secret_key: str,
+            session_token: Optional[str] = None,
+            now: Optional[_dt.datetime] = None,
+            include_content_sha256: bool = True) -> Dict[str, str]:
+    """AWS SigV4: returns ``headers`` + ``Authorization``/``x-amz-*``.
+
+    The reference signs with v2 HMAC-SHA1 (`s3_filesys.cc:90-121`); modern
+    S3/GCS-interop require v4. Canonicalization follows the official spec:
+    sorted URL-encoded query, sorted lowercase signed headers, hex payload
+    hash; signing key = HMAC chain over date/region/service.
+    """
+    now = now or _dt.datetime.now(_dt.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+
+    headers = dict(headers)
+    headers["host"] = host
+    headers["x-amz-date"] = amz_date
+    if include_content_sha256:      # S3 requires it; the generic AWS
+        headers["x-amz-content-sha256"] = payload_hash  # test suite omits it
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+
+    canonical_uri = urllib.parse.quote(path, safe="/")
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(query.items()))
+    lower = {k.lower(): v.strip() for k, v in headers.items()}
+    signed_headers = ";".join(sorted(lower))
+    canonical_headers = "".join(f"{k}:{lower[k]}\n" for k in sorted(lower))
+    canonical_request = "\n".join([
+        method, canonical_uri, canonical_query, canonical_headers,
+        signed_headers, payload_hash])
+
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        _sha256_hex(canonical_request.encode())])
+
+    k_date = _hmac_sha256(b"AWS4" + secret_key.encode(), datestamp)
+    k_region = _hmac_sha256(k_date, region)
+    k_service = _hmac_sha256(k_region, service)
+    k_signing = _hmac_sha256(k_service, "aws4_request")
+    signature = hmac.new(k_signing, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}")
+    return headers
+
+
+# ---------------------------------------------------------------------------
+# S3 (and S3-compatible endpoints: minio, GCS interop, fake test servers)
+# ---------------------------------------------------------------------------
+
+class _S3Config:
+    """Credentials/endpoint from env (reference ctor `s3_filesys.cc:926`:
+    AWS_ACCESS_KEY_ID/SECRET/SESSION_TOKEN/REGION + custom endpoint)."""
+
+    def __init__(self, scheme_env_prefix: str = "AWS",
+                 service: str = "s3") -> None:
+        env = os.environ
+        self.access_key = env.get(f"{scheme_env_prefix}_ACCESS_KEY_ID", "")
+        self.secret_key = env.get(f"{scheme_env_prefix}_SECRET_ACCESS_KEY", "")
+        self.session_token = env.get(f"{scheme_env_prefix}_SESSION_TOKEN") or None
+        self.region = (env.get(f"{scheme_env_prefix}_REGION")
+                       or env.get(f"{scheme_env_prefix}_DEFAULT_REGION")
+                       or "us-east-1")
+        self.endpoint = env.get("DMLC_S3_ENDPOINT") or env.get("S3_ENDPOINT") or ""
+        self.service = service
+
+    def resolve(self, bucket: str) -> Tuple[str, str, str]:
+        """-> (scheme, netloc, path_prefix). Custom endpoints use path-style
+        addressing (bucket in the path) so local fake servers/minio work."""
+        if self.endpoint:
+            ep = self.endpoint
+            if "://" not in ep:        # "localhost:9000" minio-style form
+                ep = "http://" + ep
+            p = urllib.parse.urlparse(ep)
+            return p.scheme or "http", p.netloc, f"/{bucket}"
+        return "https", f"{bucket}.s3.{self.region}.amazonaws.com", ""
+
+
+class _S3ReadStream(RangedReadStream):
+    """Signed ranged-read stream (reference ``s3::ReadStream``
+    `s3_filesys.cc:462-530`: every range fill re-signs the request)."""
+
+    def __init__(self, cfg: _S3Config, bucket: str, key: str,
+                 size: Optional[int] = None) -> None:
+        scheme, netloc, prefix = cfg.resolve(bucket)
+        path = f"{prefix}/{key}"
+        # wire path must be the same percent-encoded bytes sign_v4 signs
+        super().__init__(scheme, netloc, urllib.parse.quote(path, safe="/"),
+                         size=size)
+        self._cfg = cfg
+        self._sign_path = path
+
+    def _request_headers(self, method: str,
+                         headers: Dict[str, str]) -> Dict[str, str]:
+        if not self._cfg.access_key:
+            return headers
+        return sign_v4(method,
+                       self._netloc, self._sign_path, {}, headers,
+                       _sha256_hex(b""), self._cfg.region, self._cfg.service,
+                       self._cfg.access_key, self._cfg.secret_key,
+                       self._cfg.session_token)
+
+
+class _S3WriteStream(io.RawIOBase):
+    """Multipart-upload write stream (reference ``s3::WriteStream``
+    `s3_filesys.cc:551-799`): buffer ≥5MB, InitiateMultipartUpload on first
+    flush, UploadPart per buffer, CompleteMultipartUpload XML on close;
+    small objects fall back to a single PUT."""
+
+    def __init__(self, fs: "S3FileSystem", bucket: str, key: str,
+                 part_size: int = _MIN_PART_SIZE) -> None:
+        super().__init__()
+        self._fs = fs
+        self._bucket = bucket
+        self._key = key
+        self._part_size = max(part_size, 1)
+        self._buf = bytearray()
+        self._upload_id: Optional[str] = None
+        self._etags: List[str] = []
+        self._closed = False
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        self._buf.extend(b)
+        while len(self._buf) >= self._part_size:
+            self._flush_part(bytes(self._buf[:self._part_size]))
+            del self._buf[:self._part_size]
+        return len(b)
+
+    def _flush_part(self, data: bytes) -> None:
+        if self._upload_id is None:
+            status, _, body = self._fs._request(
+                "POST", self._bucket, self._key, {"uploads": ""}, b"")
+            check(status == 200, f"InitiateMultipartUpload: HTTP {status}")
+            self._upload_id = ET.fromstring(body).findtext(
+                ".//{*}UploadId") or ET.fromstring(body).findtext(".//UploadId")
+            check(bool(self._upload_id), "no UploadId in response")
+        part_no = len(self._etags) + 1
+        status, hdrs, _ = self._fs._request(
+            "PUT", self._bucket, self._key,
+            {"partNumber": str(part_no), "uploadId": self._upload_id}, data)
+        check(status == 200, f"UploadPart {part_no}: HTTP {status}")
+        self._etags.append(hdrs.get("etag", f'"{part_no}"'))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._upload_id is None:
+            # small object: single PUT (reference same fallback, :747)
+            status, _, _ = self._fs._request(
+                "PUT", self._bucket, self._key, {}, bytes(self._buf))
+            check(status == 200, f"PUT object: HTTP {status}")
+        else:
+            if self._buf:
+                self._flush_part(bytes(self._buf))
+                self._buf.clear()
+            parts = "".join(
+                f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{etag}</ETag></Part>"
+                for i, etag in enumerate(self._etags))
+            xml_body = (f"<CompleteMultipartUpload>{parts}"
+                        f"</CompleteMultipartUpload>").encode()
+            status, _, _ = self._fs._request(
+                "POST", self._bucket, self._key,
+                {"uploadId": self._upload_id}, xml_body)
+            check(status == 200, f"CompleteMultipartUpload: HTTP {status}")
+        super().close()
+
+
+class S3FileSystem(FileSystem):
+    """``s3://bucket/key`` object store (reference `s3_filesys.cc`)."""
+
+    def __init__(self, env_prefix: str = "AWS", service: str = "s3",
+                 part_size: int = _MIN_PART_SIZE) -> None:
+        self._env_prefix = env_prefix
+        self._service = service
+        self._part_size = part_size
+
+    @property
+    def cfg(self) -> _S3Config:
+        # re-read env per request (cheap: six dict lookups) so credentials
+        # and endpoint can change after the scheme singletons are created
+        return _S3Config(self._env_prefix, self._service)
+
+    def _request(self, method: str, bucket: str, key: str,
+                 query: Dict[str, str], body: bytes
+                 ) -> Tuple[int, Dict[str, str], bytes]:
+        cfg = self.cfg
+        scheme, netloc, prefix = cfg.resolve(bucket)
+        path = f"{prefix}/{key}" if key else (prefix or "/")
+        headers: Dict[str, str] = {}
+        if cfg.access_key:
+            headers = sign_v4(method, netloc, path, query, headers,
+                              _sha256_hex(body), cfg.region, cfg.service,
+                              cfg.access_key, cfg.secret_key,
+                              cfg.session_token)
+        # encode path+query exactly as sign_v4 canonicalized them, or the
+        # server-side signature check would see different bytes
+        qs = "&".join(
+            f"{urllib.parse.quote(k, safe='-_.~')}="
+            f"{urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in sorted(query.items()))
+        wire_path = urllib.parse.quote(path, safe="/")
+        path_qs = f"{wire_path}?{qs}" if qs else wire_path
+        return _http_request(scheme, netloc, method, path_qs, headers, body)
+
+    @staticmethod
+    def _split(uri: URI) -> Tuple[str, str]:
+        return uri.host, uri.name.lstrip("/")
+
+    def get_path_info(self, uri: URI) -> FileInfo:
+        bucket, key = self._split(uri)
+        # empty key = bucket root: HEAD would be HeadBucket (200) and
+        # misreport a zero-size file — go straight to the prefix probe
+        if key:
+            status, hdrs, _ = self._request("HEAD", bucket, key, {}, b"")
+            if status == 200:
+                return FileInfo(path=uri.raw,
+                                size=int(hdrs.get("content-length", 0)),
+                                type="file")
+        else:
+            status = 404
+        # directory probe: any object under the prefix? (ref TryGetPathInfo)
+        infos = self.list_directory(uri)
+        if infos:
+            return FileInfo(path=uri.raw, size=0, type="dir")
+        raise DMLCError(f"s3: no such object {uri.raw} (HTTP {status})")
+
+    def list_directory(self, uri: URI) -> List[FileInfo]:
+        bucket, key = self._split(uri)
+        prefix = key if not key or key.endswith("/") else key + "/"
+        out: List[FileInfo] = []
+        token: Optional[str] = None
+        while True:
+            q = {"list-type": "2", "prefix": prefix, "delimiter": "/"}
+            if token:
+                q["continuation-token"] = token
+            status, _, body = self._request("GET", bucket, "", q, b"")
+            check(status == 200, f"ListObjectsV2: HTTP {status}")
+            root = ET.fromstring(body)
+
+            def _find(el, tag):
+                return el.findtext(f"{{*}}{tag}") or el.findtext(tag)
+
+            for c in list(root.iter()):
+                if c.tag.endswith("Contents"):
+                    k = _find(c, "Key")
+                    if k and k != prefix:
+                        out.append(FileInfo(
+                            path=f"{uri.protocol}{bucket}/{k}",
+                            size=int(_find(c, "Size") or 0), type="file"))
+                elif c.tag.endswith("CommonPrefixes"):
+                    p = _find(c, "Prefix")
+                    if p:
+                        out.append(FileInfo(
+                            path=f"{uri.protocol}{bucket}/{p.rstrip('/')}",
+                            size=0, type="dir"))
+            token = (root.findtext("{*}NextContinuationToken")
+                     or root.findtext("NextContinuationToken"))
+            if not token:
+                return out
+
+    def open(self, uri: URI, mode: str) -> BinaryIO:
+        bucket, key = self._split(uri)
+        if mode == "r":
+            return _S3ReadStream(self.cfg, bucket, key)
+        check(mode == "w", "s3 supports modes 'r' and 'w' only")
+        return _S3WriteStream(self, bucket, key, self._part_size)
+
+
+class GCSFileSystem(S3FileSystem):
+    """``gs://`` via the GCS S3-compatible XML API with HMAC interop keys
+    (env ``GCS_ACCESS_KEY_ID``/``GCS_SECRET_ACCESS_KEY``; endpoint
+    ``https://storage.googleapis.com`` unless ``DMLC_S3_ENDPOINT`` is set).
+    TPU-idiomatic object store — plays the role S3 plays in the reference."""
+
+    def __init__(self) -> None:
+        super().__init__(env_prefix="GCS", service="s3")
+
+    @property
+    def cfg(self) -> _S3Config:
+        c = _S3Config("GCS", "s3")
+        if not c.endpoint:
+            # path-style on the shared interop endpoint
+            c.endpoint = "https://storage.googleapis.com"
+        return c
+
+
+# ---------------------------------------------------------------------------
+# WebHDFS
+# ---------------------------------------------------------------------------
+
+def _request_url(method: str, url: str,
+                 body: bytes = b"") -> Tuple[int, Dict[str, str], bytes]:
+    p = urllib.parse.urlparse(url)
+    path_qs = p.path + (f"?{p.query}" if p.query else "")
+    return _http_request(p.scheme or "http", p.netloc, method, path_qs,
+                         {}, body)
+
+
+def _webhdfs_location(status: int, hdrs: Dict[str, str],
+                      data: bytes) -> Optional[str]:
+    """Two-step WebHDFS data ops: the namenode answers OPEN/CREATE either
+    with a 307 redirect or (with ``noredirect=true``) a JSON body holding
+    the datanode ``Location``; data flows to/from that second URL."""
+    if status == 307:
+        return hdrs.get("location")
+    if status == 200 and "json" in hdrs.get("content-type", ""):
+        import json as _json
+        try:
+            return _json.loads(data).get("Location")
+        except (ValueError, AttributeError):
+            return None
+    return None
+
+
+class _WebHDFSReadStream(RangedReadStream):
+    """Ranged reads via ``OPEN&offset=..&length=..`` (maps the reference's
+    hdfsPread positional read, `hdfs_filesys.cc:31-55`, onto REST)."""
+
+    def __init__(self, scheme: str, netloc: str, path: str, size: int,
+                 user: Optional[str]) -> None:
+        super().__init__(scheme, netloc, path, size=size)
+        self._user = user
+
+    def _fetch(self, start: int, end_excl: int) -> bytes:
+        q = {"op": "OPEN", "offset": str(start),
+             "length": str(end_excl - start), "noredirect": "true"}
+        if self._user:
+            q["user.name"] = self._user
+        qs = urllib.parse.urlencode(q)
+        status, hdrs, data = _http_request(
+            self._scheme, self._netloc, "GET", f"{self._path_qs}?{qs}", {})
+        loc = _webhdfs_location(status, hdrs, data)
+        if loc is not None:
+            # namenode handed us the datanode URL — fetch the bytes there
+            status, _, data = _request_url("GET", loc)
+        if status != 200:
+            raise DMLCError(f"webhdfs OPEN {self._path_qs}: HTTP {status}")
+        return data
+
+
+class WebHDFSFileSystem(FileSystem):
+    """``hdfs://host:port/path`` over WebHDFS REST (reference wraps libhdfs
+    JNI, `hdfs_filesys.cc`; same surface, no JVM dependency).
+
+    Env: ``DMLC_WEBHDFS_SCHEME`` (default http), ``HADOOP_USER_NAME``.
+    The URI host is the namenode ``host:port`` (reference connect,
+    `hdfs_filesys.cc:94`).
+    """
+
+    def _base(self, uri: URI) -> Tuple[str, str, str]:
+        scheme = os.environ.get("DMLC_WEBHDFS_SCHEME", "http")
+        return scheme, uri.host, f"/webhdfs/v1{uri.name}"
+
+    def _user(self) -> Optional[str]:
+        return os.environ.get("HADOOP_USER_NAME")
+
+    def _op(self, uri: URI, method: str, op: str,
+            extra: Optional[Dict[str, str]] = None,
+            body: bytes = b"") -> Tuple[int, Dict[str, str], bytes]:
+        scheme, netloc, path = self._base(uri)
+        q = {"op": op}
+        if self._user():
+            q["user.name"] = self._user()  # type: ignore[assignment]
+        q.update(extra or {})
+        qs = urllib.parse.urlencode(q)
+        return _http_request(scheme, netloc, method, f"{path}?{qs}", {}, body)
+
+    @staticmethod
+    def _info_from_status(uri_prefix: str, name: str, st: dict) -> FileInfo:
+        path = uri_prefix if not name else f"{uri_prefix.rstrip('/')}/{name}"
+        return FileInfo(path=path, size=int(st.get("length", 0)),
+                        type="dir" if st.get("type") == "DIRECTORY" else "file")
+
+    def get_path_info(self, uri: URI) -> FileInfo:
+        import json as _json
+        status, _, body = self._op(uri, "GET", "GETFILESTATUS")
+        if status != 200:
+            raise DMLCError(f"webhdfs GETFILESTATUS {uri.raw}: HTTP {status}")
+        st = _json.loads(body)["FileStatus"]
+        return self._info_from_status(uri.raw, "", st)
+
+    def list_directory(self, uri: URI) -> List[FileInfo]:
+        import json as _json
+        status, _, body = self._op(uri, "GET", "LISTSTATUS")
+        if status != 200:
+            raise DMLCError(f"webhdfs LISTSTATUS {uri.raw}: HTTP {status}")
+        sts = _json.loads(body)["FileStatuses"]["FileStatus"]
+        return [self._info_from_status(uri.raw, st.get("pathSuffix", ""), st)
+                for st in sts]
+
+    def open(self, uri: URI, mode: str) -> BinaryIO:
+        if mode == "r":
+            info = self.get_path_info(uri)
+            scheme, netloc, path = self._base(uri)
+            return _WebHDFSReadStream(scheme, netloc, path, info.size,
+                                      self._user())
+        check(mode == "w", "webhdfs supports modes 'r' and 'w' only")
+        fs = self
+
+        class _Writer(io.BytesIO):
+            def close(self) -> None:
+                if not self.closed:
+                    data = self.getvalue()
+                    # step 1: namenode CREATE (no body) → datanode Location
+                    status, hdrs, resp = fs._op(uri, "PUT", "CREATE",
+                                                {"overwrite": "true",
+                                                 "noredirect": "true"}, b"")
+                    loc = _webhdfs_location(status, hdrs, resp)
+                    if loc is not None:
+                        # step 2: stream the bytes to the datanode
+                        status, _, _ = _request_url("PUT", loc, data)
+                    elif status in (200, 201):
+                        # gateway (e.g. HttpFS) accepted data directly
+                        status, _, _ = fs._op(uri, "PUT", "CREATE",
+                                              {"overwrite": "true",
+                                               "noredirect": "true"}, data)
+                    check(status in (200, 201),
+                          f"webhdfs CREATE: HTTP {status}")
+                super().close()
+
+        return _Writer()
+
+
+# ---------------------------------------------------------------------------
+# Azure (listing-only, like the reference azure_filesys.cc:42-80)
+# ---------------------------------------------------------------------------
+
+class AzureFileSystem(FileSystem):
+    """``azure://account/container/path`` blob listing via the public List
+    Blobs REST API (reference backend is also listing-only; its Open is
+    unimplemented, `azure_filesys.cc`). Env: ``AZURE_STORAGE_ENDPOINT`` to
+    override the host (for tests), ``AZURE_STORAGE_SAS`` appended as auth."""
+
+    def _endpoint(self, account: str) -> Tuple[str, str]:
+        ep = os.environ.get("AZURE_STORAGE_ENDPOINT", "")
+        if ep:
+            p = urllib.parse.urlparse(ep)
+            return p.scheme or "http", p.netloc
+        return "https", f"{account}.blob.core.windows.net"
+
+    def list_directory(self, uri: URI) -> List[FileInfo]:
+        account = uri.host
+        parts = uri.name.lstrip("/").split("/", 1)
+        container = parts[0]
+        prefix = parts[1] if len(parts) > 1 else ""
+        if prefix and not prefix.endswith("/"):
+            prefix += "/"
+        scheme, netloc = self._endpoint(account)
+        sas = os.environ.get("AZURE_STORAGE_SAS", "")
+        out: List[FileInfo] = []
+        marker = ""
+        while True:     # follow NextMarker pagination (5000 blobs per page)
+            q = {"restype": "container", "comp": "list", "prefix": prefix,
+                 "delimiter": "/"}
+            if marker:
+                q["marker"] = marker
+            qs = urllib.parse.urlencode(q) + (
+                f"&{sas.lstrip('?&')}" if sas else "")
+            status, _, body = _http_request(scheme, netloc, "GET",
+                                            f"/{container}?{qs}", {})
+            check(status == 200, f"azure List Blobs: HTTP {status}")
+            root = ET.fromstring(body)
+            for b in root.iter():
+                if b.tag.endswith("Blob"):
+                    name = b.findtext("Name") or b.findtext("{*}Name") or ""
+                    size = b.findtext(".//Content-Length") or "0"
+                    out.append(FileInfo(
+                        path=f"azure://{account}/{container}/{name}",
+                        size=int(size), type="file"))
+                elif b.tag.endswith("BlobPrefix"):
+                    name = b.findtext("Name") or ""
+                    out.append(FileInfo(
+                        path=f"azure://{account}/{container}/{name.rstrip('/')}",
+                        size=0, type="dir"))
+            marker = root.findtext("NextMarker") or ""
+            if not marker:
+                return out
+
+    def get_path_info(self, uri: URI) -> FileInfo:
+        raise DMLCError("AzureFileSystem is listing-only (as the reference)")
+
+    def open(self, uri: URI, mode: str) -> BinaryIO:
+        raise DMLCError("AzureFileSystem is listing-only (as the reference)")
+
+
+# -- scheme registration (reference io.cc:31-60) -----------------------------
+_http_fs = HttpFileSystem("http")
+_https_fs = HttpFileSystem("https")
+_s3_fs = S3FileSystem()
+_gcs_fs = GCSFileSystem()
+_hdfs_fs = WebHDFSFileSystem()
+_azure_fs = AzureFileSystem()
+
+FS_REGISTRY.register("http", description="HTTP read-only")(lambda: _http_fs)
+FS_REGISTRY.register("https", description="HTTPS read-only")(lambda: _https_fs)
+FS_REGISTRY.register("s3", description="S3 object store")(lambda: _s3_fs)
+FS_REGISTRY.register("gs", description="GCS (S3-compat XML API)")(lambda: _gcs_fs)
+FS_REGISTRY.register("hdfs", description="WebHDFS")(lambda: _hdfs_fs)
+FS_REGISTRY.register("azure", description="Azure blob (listing)")(lambda: _azure_fs)
