@@ -60,9 +60,12 @@ _MAX_RETRY = 3
 def _http_request(scheme: str, netloc: str, method: str, path_qs: str,
                   headers: Dict[str, str], body: bytes = b"",
                   timeout: float = 60.0) -> Tuple[int, Dict[str, str], bytes]:
-    """One HTTP round trip with retry on transient failures."""
+    """One HTTP round trip; retries only idempotent methods (a retried
+    POST/PUT could double-apply or fail after server-side success — e.g.
+    re-sending CompleteMultipartUpload for an already-completed id)."""
+    retries = _MAX_RETRY if method in ("GET", "HEAD") else 1
     last_exc: Optional[Exception] = None
-    for attempt in range(_MAX_RETRY):
+    for attempt in range(retries):
         conn = None
         try:
             cls = (http.client.HTTPSConnection if scheme == "https"
@@ -72,13 +75,14 @@ def _http_request(scheme: str, netloc: str, method: str, path_qs: str,
             resp = conn.getresponse()
             data = resp.read()
             hdrs = {k.lower(): v for k, v in resp.getheaders()}
-            if resp.status >= 500 and attempt + 1 < _MAX_RETRY:
+            if resp.status >= 500 and attempt + 1 < retries:
                 time.sleep(0.1 * (attempt + 1))
                 continue
             return resp.status, hdrs, data
         except (OSError, http.client.HTTPException) as e:
             last_exc = e
-            time.sleep(0.1 * (attempt + 1))
+            if attempt + 1 < retries:
+                time.sleep(0.1 * (attempt + 1))
         finally:
             if conn is not None:
                 conn.close()
@@ -127,9 +131,12 @@ class RangedReadStream(io.RawIOBase):
                     pass
             return data
         if status == 200:
-            # server ignored Range: got whole body; slice what we asked for
+            # server ignored Range: we now hold the whole object — keep it
+            # all as the buffer so we never re-download it per refill
             if self._size is None:
                 self._size = len(data)
+            self._buf = data
+            self._buf_start = 0
             return data[start:end_excl]
         if status in (404, 403):
             raise DMLCError(
@@ -230,8 +237,14 @@ class HttpFileSystem(FileSystem):
                                         uri.name or "/", {})
         if status != 200:
             raise DMLCError(f"HEAD {uri.raw}: HTTP {status}")
-        return FileInfo(path=uri.raw, size=int(hdrs.get("content-length", 0)),
-                        type="file")
+        if "content-length" in hdrs:
+            size = int(hdrs["content-length"])
+        else:
+            # chunked/dynamic responses omit Content-Length; a zero size
+            # would silently drop the file from input splits — probe instead
+            s = RangedReadStream(self._scheme, uri.host, uri.name or "/")
+            size = s._length()
+        return FileInfo(path=uri.raw, size=size, type="file")
 
     def list_directory(self, uri: URI) -> List[FileInfo]:
         raise DMLCError("HttpFileSystem does not support listing")
@@ -398,8 +411,7 @@ class _S3WriteStream(io.RawIOBase):
             status, _, body = self._fs._request(
                 "POST", self._bucket, self._key, {"uploads": ""}, b"")
             check(status == 200, f"InitiateMultipartUpload: HTTP {status}")
-            self._upload_id = ET.fromstring(body).findtext(
-                ".//{*}UploadId") or ET.fromstring(body).findtext(".//UploadId")
+            self._upload_id = ET.fromstring(body).findtext(".//{*}UploadId")
             check(bool(self._upload_id), "no UploadId in response")
         part_no = len(self._etags) + 1
         status, hdrs, _ = self._fs._request(
@@ -546,9 +558,10 @@ class GCSFileSystem(S3FileSystem):
     @property
     def cfg(self) -> _S3Config:
         c = _S3Config("GCS", "s3")
-        if not c.endpoint:
-            # path-style on the shared interop endpoint
-            c.endpoint = "https://storage.googleapis.com"
+        # a custom *S3* endpoint (minio etc.) must not reroute gs:// traffic;
+        # only the GCS-specific override applies here
+        c.endpoint = (os.environ.get("DMLC_GCS_ENDPOINT")
+                      or "https://storage.googleapis.com")
         return c
 
 
@@ -617,7 +630,8 @@ class WebHDFSFileSystem(FileSystem):
 
     def _base(self, uri: URI) -> Tuple[str, str, str]:
         scheme = os.environ.get("DMLC_WEBHDFS_SCHEME", "http")
-        return scheme, uri.host, f"/webhdfs/v1{uri.name}"
+        path = urllib.parse.quote(uri.name, safe="/")
+        return scheme, uri.host, f"/webhdfs/v1{path}"
 
     def _user(self) -> Optional[str]:
         return os.environ.get("HADOOP_USER_NAME")

@@ -12,19 +12,12 @@ from .config import Config  # noqa: F401
 from .threaded_iter import ThreadedIter  # noqa: F401
 from .timer import get_time, Timer  # noqa: F401
 from . import serializer  # noqa: F401
+from .concurrency import (  # noqa: F401
+    ConcurrentBlockingQueue, Spinlock, ThreadLocalStore, ObjectPool,
+)
+from .memory_io import MemoryFixedSizeStream, MemoryStringStream  # noqa: F401
+from .common import split, hash_combine, byteswap  # noqa: F401
 from .json import (  # noqa: F401
     JSONReader, JSONWriter, JSONObjectReadHelper, AnyValue,
     register_any_type, read_any, json_dumps, json_loads,
 )
-
-
-def split(s: str, delim: str) -> list:
-    """Split helper mirroring ``dmlc::Split`` (`common.h:20-37`): istream
-    getline semantics — a trailing delimiter does NOT produce an empty last
-    segment, and empty input yields []."""
-    if s == "":
-        return []
-    parts = s.split(delim)
-    if parts and parts[-1] == "":
-        parts.pop()
-    return parts

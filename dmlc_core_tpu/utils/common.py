@@ -1,0 +1,46 @@
+"""Small shared helpers — capability parity with reference
+``include/dmlc/common.h`` and ``include/dmlc/endian.h``.
+
+* :func:`split` — delimiter split skipping empty fields (`common.h:20-37`).
+* :func:`hash_combine` — boost-style hash mixing (`common.h:41-46`).
+* :func:`byteswap` — endian swap over a bytes-like of fixed-size elements
+  (`endian.h:30-40`); numpy does this on arrays, this covers raw buffers.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+__all__ = ["split", "hash_combine", "byteswap"]
+
+
+def split(s: str, delim: str) -> List[str]:
+    """Split mirroring ``dmlc::Split`` (`common.h:20-37`): istream getline
+    semantics — interior empties are kept, a trailing delimiter does NOT
+    produce an empty last segment, empty input yields []."""
+    if s == "":
+        return []
+    parts = s.split(delim)
+    if parts and parts[-1] == "":
+        parts.pop()
+    return parts
+
+
+def hash_combine(seed: int, value: int) -> int:
+    """Boost ``hash_combine`` mixing (reference `common.h:41-46`)."""
+    return (seed ^ (value + 0x9E3779B9 + ((seed << 6) & 0xFFFFFFFF)
+                    + (seed >> 2))) & 0xFFFFFFFF
+
+
+def byteswap(data: bytes, elem_size: int) -> bytes:
+    """Swap endianness of each ``elem_size``-byte element
+    (reference ``ByteSwap`` `endian.h:30-40`)."""
+    if elem_size == 1:
+        return bytes(data)
+    if len(data) % elem_size:
+        raise ValueError(f"buffer of {len(data)} bytes is not a multiple "
+                         f"of elem size {elem_size}")
+    out = bytearray(len(data))
+    for i in range(0, len(data), elem_size):
+        out[i:i + elem_size] = data[i:i + elem_size][::-1]
+    return bytes(out)

@@ -1,0 +1,183 @@
+"""Concurrency primitives — capability parity with reference
+``include/dmlc/concurrency.h`` and ``include/dmlc/thread_local.h``.
+
+* :class:`ConcurrentBlockingQueue` — bounded-or-unbounded MPMC blocking queue
+  in FIFO or PRIORITY mode with the reference's ``SignalForKill`` shutdown
+  protocol (`concurrency.h:65-253`): after the signal, every blocked ``pop``
+  wakes and returns ``None``, and the kill state is sticky until resumed.
+* :class:`Spinlock` — busy-wait lock (`concurrency.h:24-60`). In CPython a
+  pure spin is rarely right; this implementation spins a bounded number of
+  times then parks on a real lock, which matches the reference's intent
+  (cheap under low contention) without burning the GIL.
+* :class:`ThreadLocalStore` — per-thread singleton store
+  (`thread_local.h:35-78`): one instance of a factory per thread, with
+  ``clear`` support for tests.
+* :class:`ObjectPool` — free-list object pool (reference ``MemoryPool``
+  `memory.h:22-80`): recycle expensive buffers (e.g. chunk bytearrays)
+  across pipeline iterations instead of reallocating.
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import threading
+from typing import Any, Callable, Deque, Dict, Generic, List, Optional, TypeVar
+
+__all__ = ["ConcurrentBlockingQueue", "Spinlock", "ThreadLocalStore",
+           "ObjectPool", "FIFO", "PRIORITY"]
+
+T = TypeVar("T")
+
+FIFO = "fifo"
+PRIORITY = "priority"
+
+
+class Spinlock:
+    """Bounded spin then park (`concurrency.h:24-60`). Context-manager."""
+
+    __slots__ = ("_lock", "_spins")
+
+    def __init__(self, spins: int = 64) -> None:
+        self._lock = threading.Lock()
+        self._spins = spins
+
+    def acquire(self) -> None:
+        for _ in range(self._spins):
+            if self._lock.acquire(blocking=False):
+                return
+        self._lock.acquire()
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> "Spinlock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class ConcurrentBlockingQueue(Generic[T]):
+    """MPMC blocking queue, FIFO or priority, with SignalForKill
+    (`concurrency.h:65-253`).
+
+    ``push(v)`` blocks while full (bounded mode); ``pop()`` blocks while
+    empty; ``signal_for_kill()`` wakes all waiters — blocked ``pop`` returns
+    ``None`` and blocked ``push`` returns ``False`` — and stays in effect
+    until :meth:`resume`.  Priority mode pops the highest ``priority`` first
+    (reference ``Push(v, priority)`` `concurrency.h:103`).
+    """
+
+    def __init__(self, max_size: int = 0, policy: str = FIFO) -> None:
+        assert policy in (FIFO, PRIORITY)
+        self._policy = policy
+        self._max = max_size
+        self._fifo: Deque[T] = collections.deque()
+        self._heap: List[Any] = []
+        self._seq = 0                      # FIFO tiebreak within a priority
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._kill = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._fifo) + len(self._heap)
+
+    def _full(self) -> bool:
+        return self._max > 0 and (len(self._fifo) + len(self._heap)) >= self._max
+
+    def push(self, value: T, priority: int = 0,
+             timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            while self._full() and not self._kill:
+                if not self._not_full.wait(timeout):
+                    return False
+            if self._kill:
+                return False
+            if self._policy == FIFO:
+                self._fifo.append(value)
+            else:
+                self._seq += 1
+                heapq.heappush(self._heap, (-priority, self._seq, value))
+            self._not_empty.notify()
+            return True
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[T]:
+        with self._lock:
+            while not (self._fifo or self._heap) and not self._kill:
+                if not self._not_empty.wait(timeout):
+                    return None
+            if self._kill and not (self._fifo or self._heap):
+                return None
+            if self._policy == FIFO:
+                v = self._fifo.popleft()
+            else:
+                v = heapq.heappop(self._heap)[2]
+            self._not_full.notify()
+            return v
+
+    def signal_for_kill(self) -> None:
+        """Wake all waiters; queue refuses new work (`concurrency.h:208`)."""
+        with self._lock:
+            self._kill = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    def resume(self) -> None:
+        with self._lock:
+            self._kill = False
+
+    @property
+    def killed(self) -> bool:
+        return self._kill
+
+
+class ThreadLocalStore:
+    """Per-thread singleton store (`thread_local.h:35-78`): ``get(factory)``
+    returns this thread's instance for that factory, constructing once."""
+
+    _tls = threading.local()
+
+    @classmethod
+    def get(cls, factory: Callable[[], T]) -> T:
+        store: Dict[Any, Any] = getattr(cls._tls, "store", None)
+        if store is None:
+            store = {}
+            cls._tls.store = store
+        key = factory
+        if key not in store:
+            store[key] = factory()
+        return store[key]
+
+    @classmethod
+    def clear(cls) -> None:
+        cls._tls.store = {}
+
+
+class ObjectPool(Generic[T]):
+    """Free-list pool for reusable buffers (reference ``MemoryPool``
+    `memory.h:22-80`; same recycling idea as ``ThreadedIter::Recycle``
+    `threadediter.h:385`)."""
+
+    def __init__(self, factory: Callable[[], T], max_free: int = 16) -> None:
+        self._factory = factory
+        self._free: List[T] = []
+        self._max_free = max_free
+        self._lock = threading.Lock()
+
+    def acquire(self) -> T:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return self._factory()
+
+    def release(self, obj: T) -> None:
+        with self._lock:
+            if len(self._free) < self._max_free:
+                self._free.append(obj)
+
+    def __enter__(self):
+        raise TypeError("use pool.acquire()/release(), not a context manager")
