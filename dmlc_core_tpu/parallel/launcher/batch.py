@@ -10,88 +10,64 @@ the generated wrapper maps onto DMLC_TASK_ID."""
 from __future__ import annotations
 
 import os
-import shlex
-import stat
 import subprocess
-import tempfile
-from typing import Dict, List
+from typing import Dict
 
-from ...utils import DMLCError, log_info
+from ...utils import log_info
+from .wrapper import write_wrapper_script
 
 __all__ = ["submit_slurm", "submit_sge", "submit_mpi"]
 
 
-def _wrapper_script(args, tracker_envs: Dict[str, str], rank_env: str,
-                    cluster: str) -> str:
-    env = dict(tracker_envs)
-    env.update(args.extra_env)
-    env.update({
-        "DMLC_NUM_WORKER": str(args.num_workers),
-        "DMLC_NUM_SERVER": str(args.num_servers),
-        "DMLC_JOB_CLUSTER": cluster,
-    })
-    exports = "\n".join(f"export {k}={shlex.quote(v)}" for k, v in env.items())
-    ns = args.num_servers
-    cmd = " ".join(shlex.quote(c) for c in args.command)
-    body = f"""#!/bin/bash
-{exports}
-export DMLC_TASK_ID="${{{rank_env}}}"
-if [ "${{DMLC_TASK_ID}}" -lt "{ns}" ]; then
-  export DMLC_ROLE=server
-else
-  export DMLC_ROLE=worker
-fi
-exec {cmd}
-"""
-    fd, path = tempfile.mkstemp(prefix="dmlc_run_", suffix=".sh")
-    with os.fdopen(fd, "w") as f:
-        f.write(body)
-    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR)
-    return path
+def _launch(args, cmd, label: str, script: str) -> int:
+    log_info("%s%s: %s", label, " (dry run)" if args.dry_run else "",
+             " ".join(cmd))
+    try:
+        if args.dry_run:
+            return 0
+        # srun / qsub -sync y / mpirun all block until the job ends, so the
+        # wrapper can be removed once the call returns
+        return subprocess.call(cmd)
+    finally:
+        try:
+            os.unlink(script)
+        except OSError:
+            pass
 
 
 def submit_slurm(args, tracker_envs: Dict[str, str]) -> int:
     nproc = args.num_workers + args.num_servers
-    script = _wrapper_script(args, tracker_envs, "SLURM_PROCID", "slurm")
+    script = write_wrapper_script(
+        args, tracker_envs, "slurm",
+        'export DMLC_TASK_ID="${SLURM_PROCID}"')
     cmd = ["srun", "-n", str(nproc)]
     if args.slurm_partition:
         cmd += ["-p", args.slurm_partition]
     cmd.append(script)
-    log_info("slurm: %s", " ".join(cmd))
-    return subprocess.call(cmd)
+    return _launch(args, cmd, "slurm", script)
 
 
 def submit_sge(args, tracker_envs: Dict[str, str]) -> int:
     nproc = args.num_workers + args.num_servers
-    # SGE_TASK_ID is 1-based; shift inside the wrapper
-    script = _wrapper_script(args, tracker_envs, "DMLC_SGE_RANK", "sge")
-    with open(script) as f:
-        body = f.read().replace(
-            'export DMLC_TASK_ID="${DMLC_SGE_RANK}"',
-            'export DMLC_TASK_ID="$((SGE_TASK_ID - 1))"')
-    with open(script, "w") as f:
-        f.write(body)
+    # SGE_TASK_ID is 1-based
+    script = write_wrapper_script(
+        args, tracker_envs, "sge",
+        'export DMLC_TASK_ID="$((SGE_TASK_ID - 1))"')
     cmd = ["qsub", "-cwd", "-t", f"1-{nproc}", "-b", "y", "-sync", "y"]
     if args.sge_queue:
         cmd += ["-q", args.sge_queue]
     cmd.append(script)
-    log_info("sge: %s", " ".join(cmd))
-    return subprocess.call(cmd)
+    return _launch(args, cmd, "sge", script)
 
 
 def submit_mpi(args, tracker_envs: Dict[str, str]) -> int:
     nproc = args.num_workers + args.num_servers
-    # OpenMPI vs MPICH rank env detection happens in the wrapper at runtime
-    script = _wrapper_script(args, tracker_envs, "DMLC_MPI_RANK", "mpi")
-    with open(script) as f:
-        body = f.read().replace(
-            'export DMLC_TASK_ID="${DMLC_MPI_RANK}"',
-            'export DMLC_TASK_ID="${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-0}}"')
-    with open(script, "w") as f:
-        f.write(body)
+    # OpenMPI vs MPICH rank env detected in the wrapper at runtime
+    script = write_wrapper_script(
+        args, tracker_envs, "mpi",
+        'export DMLC_TASK_ID="${OMPI_COMM_WORLD_RANK:-${PMI_RANK:-0}}"')
     cmd = ["mpirun", "-n", str(nproc)]
     if args.host_file:
         cmd += ["--hostfile", args.host_file]
     cmd.append(script)
-    log_info("mpi: %s", " ".join(cmd))
-    return subprocess.call(cmd)
+    return _launch(args, cmd, "mpi", script)

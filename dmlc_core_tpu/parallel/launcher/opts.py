@@ -9,7 +9,7 @@ from typing import List, Optional
 
 __all__ = ["build_parser", "get_opts"]
 
-CLUSTERS = ["local", "ssh", "mpi", "sge", "slurm", "tpu"]
+CLUSTERS = ["local", "ssh", "mpi", "sge", "slurm", "yarn", "mesos", "tpu"]
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -36,6 +36,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ssh: rsync the working dir to this path on each host")
     p.add_argument("--slurm-partition", default=None)
     p.add_argument("--sge-queue", default=None)
+    p.add_argument("--yarn-queue", default=None,
+                   help="yarn: capacity-scheduler queue")
+    p.add_argument("--mesos-master", default=None,
+                   help="mesos: master host:port (env MESOS_MASTER)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the scheduler submission without running it")
     p.add_argument("--max-attempts", type=int,
                    default=int(os.environ.get("DMLC_MAX_ATTEMPT", "3")),
                    help="per-worker restart attempts before giving up")

@@ -23,6 +23,15 @@ def submit(argv: Optional[List[str]] = None) -> int:
     tracker.start()
     envs = tracker.worker_envs()
 
+    if args.dry_run and args.cluster in ("local", "ssh", "tpu"):
+        # direct-spawn backends have no scheduler command to preview:
+        # show the resolved job spec and stop before launching anything
+        log_info("%s (dry run): %d workers + %d servers, env %s, cmd: %s",
+                 args.cluster, args.num_workers, args.num_servers,
+                 envs, " ".join(args.command))
+        tracker.stop()
+        return 0
+
     if args.cluster == "local":
         from . import local as backend
         rc = backend.submit(args, envs)
@@ -38,6 +47,12 @@ def submit(argv: Optional[List[str]] = None) -> int:
     elif args.cluster == "mpi":
         from .batch import submit_mpi
         rc = submit_mpi(args, envs)
+    elif args.cluster == "yarn":
+        from .yarn import submit_yarn
+        rc = submit_yarn(args, envs)
+    elif args.cluster == "mesos":
+        from .mesos import submit_mesos
+        rc = submit_mesos(args, envs)
     elif args.cluster == "tpu":
         from . import tpu as backend
         rc = backend.submit(args, envs)

@@ -1,0 +1,68 @@
+"""Shared wrapper-script generation for scheduler-based launchers.
+
+One home for the DMLC_* env contract so slurm/sge/mpi/yarn/mesos cannot
+drift (reference equivalent: the env assembly in
+``tracker/dmlc_tracker/tracker.py:410-433`` shared by every submit backend).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import stat
+import tempfile
+from typing import Dict
+
+__all__ = ["job_env", "render_exports", "write_wrapper_script"]
+
+
+def job_env(args, tracker_envs: Dict[str, str], cluster: str) -> Dict[str, str]:
+    """The launch env contract common to every backend."""
+    env = dict(tracker_envs)
+    env.update(args.extra_env)
+    env.update({
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+        "DMLC_JOB_CLUSTER": cluster,
+        "DMLC_MAX_ATTEMPT": str(args.max_attempts),
+    })
+    return env
+
+
+def render_exports(env: Dict[str, str]) -> str:
+    return "\n".join(f"export {k}={shlex.quote(v)}" for k, v in env.items())
+
+
+def write_wrapper_script(args, tracker_envs: Dict[str, str], cluster: str,
+                         rank_snippet: str) -> str:
+    """Write an executable wrapper that exports the env contract, runs
+    ``rank_snippet`` (shell lines that must set ``DMLC_TASK_ID``), derives
+    ``DMLC_ROLE`` from the server split, and execs the worker command."""
+    exports = render_exports(job_env(args, tracker_envs, cluster))
+    cmd = " ".join(shlex.quote(c) for c in args.command)
+    ns = args.num_servers
+    nproc = args.num_workers + args.num_servers
+    body = f"""#!/bin/bash
+{exports}
+{rank_snippet}
+if [ -n "${{DMLC_TASK_ID}}" ] && [ "${{DMLC_TASK_ID}}" -ge 0 ] \\
+   && [ "${{DMLC_TASK_ID}}" -lt "{nproc}" ]; then
+  if [ "${{DMLC_TASK_ID}}" -lt "{ns}" ]; then
+    export DMLC_ROLE=server
+  else
+    export DMLC_ROLE=worker
+  fi
+else
+  # unknown/out-of-range id (e.g. a scheduler-restarted container):
+  # let the tracker assign a recovered rank instead of trusting the id
+  unset DMLC_TASK_ID
+  export DMLC_ROLE=worker
+  export DMLC_RECOVER=1
+fi
+exec {cmd}
+"""
+    fd, path = tempfile.mkstemp(prefix=f"dmlc_{cluster}_", suffix=".sh")
+    with os.fdopen(fd, "w") as f:
+        f.write(body)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR)
+    return path

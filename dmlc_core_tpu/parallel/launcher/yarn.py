@@ -1,0 +1,82 @@
+"""YARN launcher — capability parity with reference ``tracker/dmlc_tracker/
+yarn.py`` (+ the Java client/AM under ``tracker/yarn/``).
+
+The reference builds a custom Java ApplicationMaster (`yarn.py:35-42`,
+`Client.java`, `ApplicationMaster.java`) that negotiates containers, injects
+the ``DMLC_*`` env and restarts failed tasks up to ``DMLC_MAX_ATTEMPT``
+with node blacklisting (`ApplicationMaster.java:73-74,535-563`).
+
+TPU-native expression: no custom AM — we target YARN's stock
+**DistributedShell** application with a generated wrapper script that maps
+the container index onto ``DMLC_TASK_ID``/``DMLC_ROLE`` and exports the
+tracker rendezvous env. Container ids are only a *hint*: a YARN-restarted
+container gets a fresh (higher, out-of-range) id, in which case the wrapper
+clears ``DMLC_TASK_ID`` and sets ``DMLC_RECOVER=1`` so the tracker's
+``recover`` protocol (`tracker.py:279-291` analog in
+``dmlc_core_tpu.parallel.tracker``) assigns the orphaned rank at
+rendezvous; the AM's maxNumAttempt policy maps onto ``--max-attempts``
+forwarded as ``DMLC_MAX_ATTEMPT``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Dict, List
+
+from ...utils import DMLCError, log_info
+from .wrapper import write_wrapper_script
+
+__all__ = ["submit_yarn", "build_yarn_command"]
+
+# CONTAINER_ID ends in _<attempt>_<id>; ids start at 1 and container 1 is
+# the AM itself, so first-allocation task index = id - 2 (out-of-range ids
+# fall through to tracker-assigned recovery in the shared wrapper)
+_RANK_SNIPPET = '''cid="${CONTAINER_ID##*_}"
+cid="$((10#$cid))"
+export DMLC_TASK_ID="$((cid - 2))"'''
+
+
+def build_yarn_command(args, tracker_envs: Dict[str, str]) -> List[str]:
+    """Generate the DistributedShell submission (one container per task)."""
+    script = write_wrapper_script(args, tracker_envs, "yarn", _RANK_SNIPPET)
+    nproc = args.num_workers + args.num_servers
+    hadoop = os.environ.get("HADOOP_HOME", "")
+    hadoop_bin = os.path.join(hadoop, "bin", "hadoop") if hadoop else "hadoop"
+    jar = os.environ.get(
+        "DMLC_YARN_DSHELL_JAR",
+        "hadoop-yarn-applications-distributedshell.jar")
+    cmd = [
+        hadoop_bin, "org.apache.hadoop.yarn.applications."
+                    "distributedshell.Client",
+        "-jar", jar,
+        "-shell_script", script,
+        "-num_containers", str(nproc),
+        "-container_memory", str(args.worker_memory_mb),
+        "-container_vcores", str(args.worker_cores),
+    ]
+    if args.jobname:
+        cmd += ["-appname", args.jobname]
+    if args.yarn_queue:
+        cmd += ["-queue", args.yarn_queue]
+    return cmd
+
+
+def submit_yarn(args, tracker_envs: Dict[str, str]) -> int:
+    cmd = build_yarn_command(args, tracker_envs)
+    script = cmd[cmd.index("-shell_script") + 1]
+    log_info("yarn%s: %s", " (dry run)" if args.dry_run else "",
+             " ".join(cmd))
+    try:
+        if args.dry_run:
+            return 0
+        return subprocess.call(cmd)
+    except FileNotFoundError as e:
+        raise DMLCError(
+            f"yarn submit needs the hadoop CLI on PATH (or HADOOP_HOME): {e}"
+        ) from e
+    finally:
+        try:
+            os.unlink(script)
+        except OSError:
+            pass
