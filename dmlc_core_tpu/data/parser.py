@@ -83,11 +83,15 @@ class TextParser(ParserBase):
         self.nthreads = nthreads
 
     def parse_next(self) -> Optional[RowBlockContainer]:
-        chunk = self.source.next_chunk()
+        from ..utils.metrics import metrics
+        with metrics.stage("parser.chunk").time():
+            chunk = self.source.next_chunk()
         if chunk is None:
             return None
         self.bytes_read += len(chunk)
-        d = self.parse_fn(chunk)
+        metrics.throughput("parser.bytes").add(len(chunk))
+        with metrics.stage("parser.parse").time():
+            d = self.parse_fn(chunk)
         return RowBlockContainer.from_arrays(
             d["offsets"], d["labels"], d["indices"], d.get("values"),
             d.get("weights"), d.get("fields"),

@@ -24,6 +24,10 @@ def _launch(args, cmd, label: str, script: str) -> int:
              " ".join(cmd))
     try:
         if args.dry_run:
+            # the wrapper IS the substance of the submission: show it,
+            # since the temp file is removed below
+            with open(script) as f:
+                log_info("%s wrapper script:\n%s", label, f.read())
             return 0
         # srun / qsub -sync y / mpirun all block until the job ends, so the
         # wrapper can be removed once the call returns

@@ -30,7 +30,14 @@ def _inline_command(args, tracker_envs: Dict[str, str], task_id: int) -> str:
     exports = "; ".join(f"export {k}={shlex.quote(v)}"
                         for k, v in env.items())
     cmd = " ".join(shlex.quote(c) for c in args.command)
-    return f"{exports}; exec {cmd}"
+    # same in-place retry loop as wrapper.wrapper_body: stable task id +
+    # incrementing DMLC_NUM_ATTEMPT drives the tracker's recover protocol
+    retry = ("attempt=0; while :; do "
+             f'DMLC_NUM_ATTEMPT="$attempt" {cmd}; rc=$?; '
+             '[ "$rc" -eq 0 ] && exit 0; '
+             'attempt=$((attempt + 1)); '
+             '[ "$attempt" -ge "${DMLC_MAX_ATTEMPT}" ] && exit "$rc"; done')
+    return f"{exports}; {retry}"
 
 
 def build_mesos_commands(args, tracker_envs: Dict[str, str]) -> List[List[str]]:
@@ -63,11 +70,12 @@ def submit_mesos(args, tracker_envs: Dict[str, str]) -> int:
         for c in cmds:
             log_info("mesos: %s", " ".join(c))
             procs.append(subprocess.Popen(c))
-    except FileNotFoundError as e:
+    except OSError as e:
+        # any mid-loop spawn failure (missing binary, EMFILE, perms) must
+        # not leak the tasks already submitted
         for p in procs:
             p.terminate()
-        raise DMLCError(
-            f"mesos submit needs mesos-execute on PATH: {e}") from e
+        raise DMLCError(f"mesos submit failed: {e}") from e
     rc = 0
     for p in procs:
         rc = p.wait() or rc

@@ -13,7 +13,8 @@ import stat
 import tempfile
 from typing import Dict
 
-__all__ = ["job_env", "render_exports", "write_wrapper_script"]
+__all__ = ["job_env", "render_exports", "wrapper_body",
+           "write_wrapper_script"]
 
 
 def job_env(args, tracker_envs: Dict[str, str], cluster: str) -> Dict[str, str]:
@@ -33,34 +34,55 @@ def render_exports(env: Dict[str, str]) -> str:
     return "\n".join(f"export {k}={shlex.quote(v)}" for k, v in env.items())
 
 
-def write_wrapper_script(args, tracker_envs: Dict[str, str], cluster: str,
-                         rank_snippet: str) -> str:
-    """Write an executable wrapper that exports the env contract, runs
-    ``rank_snippet`` (shell lines that must set ``DMLC_TASK_ID``), derives
-    ``DMLC_ROLE`` from the server split, and execs the worker command."""
+def wrapper_body(args, tracker_envs: Dict[str, str], cluster: str,
+                 rank_snippet: str) -> str:
+    """Wrapper shell body: export the env contract, run ``rank_snippet``
+    (shell lines that must set ``DMLC_TASK_ID``), derive ``DMLC_ROLE`` from
+    the server split, then run the worker in an **in-place retry loop**.
+
+    The retry loop is how scheduler jobs get elastic recovery: the task id
+    (= rabit jobid) stays stable across attempts and ``DMLC_NUM_ATTEMPT``
+    increments, so on attempt > 0 the rabit client sends ``recover`` and the
+    tracker re-issues the same rank with fresh neighbor addresses
+    (``dmlc_core_tpu.parallel.rabit.RabitContext.from_env`` +
+    ``parallel.tracker`` — the analog of reference `tracker.py:279-291` and
+    of the YARN AM's maxNumAttempt restart, `ApplicationMaster.java:210`).
+    An out-of-range id (e.g. a container id beyond the cohort) fails fast
+    with a clear message rather than joining with a bogus rank."""
     exports = render_exports(job_env(args, tracker_envs, cluster))
     cmd = " ".join(shlex.quote(c) for c in args.command)
     ns = args.num_servers
     nproc = args.num_workers + args.num_servers
-    body = f"""#!/bin/bash
+    return f"""#!/bin/bash
 {exports}
 {rank_snippet}
-if [ -n "${{DMLC_TASK_ID}}" ] && [ "${{DMLC_TASK_ID}}" -ge 0 ] \\
-   && [ "${{DMLC_TASK_ID}}" -lt "{nproc}" ]; then
-  if [ "${{DMLC_TASK_ID}}" -lt "{ns}" ]; then
-    export DMLC_ROLE=server
-  else
-    export DMLC_ROLE=worker
-  fi
-else
-  # unknown/out-of-range id (e.g. a scheduler-restarted container):
-  # let the tracker assign a recovered rank instead of trusting the id
-  unset DMLC_TASK_ID
-  export DMLC_ROLE=worker
-  export DMLC_RECOVER=1
+if [ -z "${{DMLC_TASK_ID}}" ] || [ "${{DMLC_TASK_ID}}" -lt 0 ] \\
+   || [ "${{DMLC_TASK_ID}}" -ge "{nproc}" ]; then
+  echo "dmlc wrapper: task id '${{DMLC_TASK_ID}}' outside cohort of {nproc}" >&2
+  exit 1
 fi
-exec {cmd}
+if [ "${{DMLC_TASK_ID}}" -lt "{ns}" ]; then
+  export DMLC_ROLE=server
+else
+  export DMLC_ROLE=worker
+fi
+attempt=0
+while :; do
+  DMLC_NUM_ATTEMPT="$attempt" {cmd}
+  rc=$?
+  [ "$rc" -eq 0 ] && exit 0
+  attempt=$((attempt + 1))
+  echo "dmlc wrapper: task ${{DMLC_TASK_ID}} exited rc=$rc" \\
+       "(attempt $attempt/${{DMLC_MAX_ATTEMPT}})" >&2
+  [ "$attempt" -ge "${{DMLC_MAX_ATTEMPT}}" ] && exit "$rc"
+done
 """
+
+
+def write_wrapper_script(args, tracker_envs: Dict[str, str], cluster: str,
+                         rank_snippet: str) -> str:
+    """Write :func:`wrapper_body` to an executable temp file."""
+    body = wrapper_body(args, tracker_envs, cluster, rank_snippet)
     fd, path = tempfile.mkstemp(prefix=f"dmlc_{cluster}_", suffix=".sh")
     with os.fdopen(fd, "w") as f:
         f.write(body)

@@ -69,6 +69,8 @@ def submit_yarn(args, tracker_envs: Dict[str, str]) -> int:
              " ".join(cmd))
     try:
         if args.dry_run:
+            with open(script) as f:
+                log_info("yarn wrapper script:\n%s", f.read())
             return 0
         return subprocess.call(cmd)
     except FileNotFoundError as e:

@@ -110,12 +110,26 @@ class DeviceLoader:
         self.source.before_first()
 
     def _to_device(self, block) -> Dict[str, jax.Array]:
-        if self.layout == "flat":
-            host = pack_flat(block, self.batch_rows, self.nnz_cap, self.stats)
-        else:
-            host = pack_rowmajor(block, self.batch_rows, self.nnz_cap, self.stats)
-        # all packed arrays lead with the batch/nnz axis, so one sharding fits
-        return {k: jax.device_put(v, self.sharding) for k, v in host.items()}
+        from ..utils.metrics import metrics, trace_span
+        with trace_span("device_loader.pack"), \
+                metrics.stage("device_loader.pack").time():
+            if self.layout == "flat":
+                host = pack_flat(block, self.batch_rows, self.nnz_cap,
+                                 self.stats)
+            else:
+                host = pack_rowmajor(block, self.batch_rows, self.nnz_cap,
+                                     self.stats)
+        with trace_span("device_loader.h2d"), \
+                metrics.stage("device_loader.h2d").time():
+            # packed arrays lead with the batch/nnz axis: one sharding fits
+            out = {k: jax.device_put(v, self.sharding)
+                   for k, v in host.items()}
+        metrics.counter("device_loader.batches").add(1)
+        # real rows in this block (the final partial batch has fewer than
+        # batch_rows; the padded device shape is not the row count)
+        metrics.throughput("device_loader.rows").add(
+            getattr(block, "size", self.batch_rows))
+        return out
 
     # -- consumer side --
     def __iter__(self):

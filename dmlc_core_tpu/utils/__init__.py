@@ -17,6 +17,10 @@ from .concurrency import (  # noqa: F401
 )
 from .memory_io import MemoryFixedSizeStream, MemoryStringStream  # noqa: F401
 from .common import split, hash_combine, byteswap  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter, Gauge, ThroughputMeter, StageTimer, MetricsRegistry,
+    metrics, trace_span, profile_trace,
+)
 from .json import (  # noqa: F401
     JSONReader, JSONWriter, JSONObjectReadHelper, AnyValue,
     register_any_type, read_any, json_dumps, json_loads,

@@ -1,0 +1,261 @@
+"""Metrics & tracing subsystem — the structured upgrade over the
+reference's ad-hoc instrumentation (SURVEY §5).
+
+The reference's observability is wall-clock ``GetTime()`` (`timer.h:27`)
+plus periodic MB/s prints in ingest loops (`basic_row_iter.h:68-76`,
+`disk_row_iter.h:120-126`) and a tracker job-duration log
+(`tracker.py:317-320`). This module keeps those habits but makes them
+first-class and queryable:
+
+* :class:`Counter` / :class:`Gauge` — monotonic / point-in-time values.
+* :class:`ThroughputMeter` — bytes-or-records rate with total + windowed
+  rate (what the MB/s prints computed inline).
+* :class:`StageTimer` — accumulated wall time per pipeline stage, usable
+  as a context manager or decorator; exposes count/total/mean.
+* :class:`MetricsRegistry` — process-global named registry with
+  ``snapshot()`` (one dict, JSON-serializable) and ``report()`` logging.
+* :func:`trace_span` — context manager emitting a ``jax.profiler``
+  TraceAnnotation when JAX is importable (shows up on the TPU trace
+  timeline), and a no-op otherwise; the idiomatic replacement for the
+  reference's printf timing.
+* :func:`profile_trace` — wrap a block in ``jax.profiler``
+  start_trace/stop_trace for offline TensorBoard inspection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+from .logging import log_info
+
+__all__ = [
+    "Counter", "Gauge", "ThroughputMeter", "StageTimer", "MetricsRegistry",
+    "metrics", "trace_span", "profile_trace",
+]
+
+
+class Counter:
+    """Monotonic counter (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self._v}
+
+
+class Gauge:
+    """Last-set value."""
+
+    def __init__(self) -> None:
+        self._v: float = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "gauge", "value": self._v}
+
+
+class ThroughputMeter:
+    """Rate meter: total units + overall and windowed rates.
+
+    The structured form of the reference's inline MB/s computation
+    (`basic_row_iter.h:70-75`): ``add(n)`` per batch, ``rate()`` anywhere.
+    """
+
+    def __init__(self, window_sec: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._start = clock()
+        self._total = 0
+        self._win_start = self._start
+        self._win_total = 0
+        self._win_rate = 0.0
+        self._win_closed = False
+        self._window = window_sec
+        self._lock = threading.Lock()
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self._total += n
+            self._win_total += n
+            now = self._clock()
+            if now - self._win_start >= self._window:
+                self._win_rate = self._win_total / (now - self._win_start)
+                self._win_closed = True
+                self._win_start = now
+                self._win_total = 0
+
+    @property
+    def total(self) -> int:
+        return self._total
+
+    def rate(self) -> float:
+        """Overall units/sec since construction."""
+        dt = self._clock() - self._start
+        return self._total / dt if dt > 0 else 0.0
+
+    def windowed_rate(self) -> float:
+        """Units/sec over the current/most recent window. A stalled stream
+        (no ``add`` calls) decays toward 0 as the open window ages — it must
+        NOT keep reporting the last healthy rate."""
+        with self._lock:
+            elapsed = self._clock() - self._win_start
+            if elapsed >= self._window:
+                # window overdue: rate over the open (possibly stalled) span
+                return self._win_total / elapsed
+            if self._win_closed:
+                return self._win_rate
+            return self.rate()      # before the first window closes
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "throughput", "total": self._total,
+                "rate": self.rate(), "windowed_rate": self.windowed_rate()}
+
+
+class StageTimer:
+    """Accumulated wall time for one pipeline stage.
+
+    Use as context manager::
+
+        with metrics.stage("parse").time():
+            ...
+
+    or decorate a function with the timer itself
+    (``@metrics.stage("parse")``). Reports count / total / mean seconds.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._count = 0
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def time(self) -> Iterator[None]:
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            dt = self._clock() - t0
+            with self._lock:
+                self._count += 1
+                self._total += dt
+
+    def __call__(self, fn: Callable) -> Callable:
+        def wrapped(*a, **kw):
+            with self.time():
+                return fn(*a, **kw)
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total_sec(self) -> float:
+        return self._total
+
+    @property
+    def mean_sec(self) -> float:
+        return self._total / self._count if self._count else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "stage", "count": self._count,
+                "total_sec": self._total, "mean_sec": self.mean_sec}
+
+
+class MetricsRegistry:
+    """Named metrics with one-call snapshot/report.
+
+    Hierarchical names by convention (``ingest.bytes``, ``device.batches``).
+    """
+
+    def __init__(self) -> None:
+        self._m: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls, **kw):
+        with self._lock:
+            m = self._m.get(name)
+            if m is None:
+                m = cls(**kw)
+                self._m[name] = m
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def throughput(self, name: str, window_sec: float = 5.0) -> ThroughputMeter:
+        return self._get(name, ThroughputMeter, window_sec=window_sec)
+
+    def stage(self, name: str) -> StageTimer:
+        return self._get(name, StageTimer)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: v.snapshot() for k, v in sorted(self._m.items())}
+
+    def report(self) -> None:
+        for name, snap in self.snapshot().items():
+            log_info("metric %s: %s", name,
+                     " ".join(f"{k}={v:.3f}" if isinstance(v, float)
+                              else f"{k}={v}" for k, v in snap.items()
+                              if k != "type"))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._m.clear()
+
+
+#: process-global registry (modules grab sub-metrics by name)
+metrics = MetricsRegistry()
+
+
+@contextlib.contextmanager
+def trace_span(name: str) -> Iterator[None]:
+    """Annotate a host-side span on the jax.profiler timeline; no-op when
+    JAX is unavailable. The idiomatic upgrade of printf timing (SURVEY §5)."""
+    ann = None
+    try:
+        import jax.profiler as _prof
+        ann = _prof.TraceAnnotation(name)
+    except Exception:
+        pass
+    if ann is None:
+        yield
+        return
+    with ann:
+        yield
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace (view in TensorBoard / Perfetto)."""
+    import jax.profiler as _prof
+    _prof.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        _prof.stop_trace()

@@ -34,7 +34,7 @@ def test_yarn_command_shape():
     assert "export DMLC_NUM_WORKER=3" in body
     assert "export DMLC_NUM_SERVER=1" in body
     assert "DMLC_MAX_ATTEMPT" in body
-    assert "exec python train.py --lr 0.1" in body
+    assert 'DMLC_NUM_ATTEMPT="$attempt" python train.py --lr 0.1' in body
     os.unlink(script)
 
 
@@ -45,8 +45,8 @@ def test_yarn_wrapper_rank_and_role():
     cmd = build_yarn_command(args, ENVS)
     script = cmd[cmd.index("-shell_script") + 1]
     body = open(script).read().replace(
-        "exec python train.py --lr 0.1",
-        'echo "$DMLC_TASK_ID $DMLC_ROLE"')
+        "python train.py --lr 0.1",
+        'echo "$DMLC_TASK_ID $DMLC_ROLE"; true')
     open(script, "w").write(body)
     out = subprocess.run(
         ["bash", script],
@@ -79,31 +79,66 @@ def test_mesos_commands_one_per_task():
         role = "server" if tid < 1 else "worker"
         assert f"export DMLC_ROLE={role}" in inline
         assert "export DMLC_TRACKER_URI=10.0.0.1" in inline
-        assert inline.endswith("exec python train.py --lr 0.1")
-        # the inline command must execute: run it with a stub
+        assert "python train.py --lr 0.1" in inline
+        # the inline command must execute (with retry machinery): stub the
+        # worker with a child shell (env-prefix vars are only visible to
+        # the child process, not to same-line expansion)
         out = subprocess.run(
-            ["bash", "-c", inline.replace("exec python train.py --lr 0.1",
-                                          'echo "$DMLC_TASK_ID $DMLC_ROLE"')],
+            ["bash", "-c", inline.replace(
+                "python train.py --lr 0.1",
+                "sh -c 'echo \"$DMLC_TASK_ID $DMLC_ROLE $DMLC_NUM_ATTEMPT\"'")],
             capture_output=True, text=True)
-        assert out.stdout.split() == [str(tid), role]
+        assert out.stdout.split() == [str(tid), role, "0"]
 
 
-def test_yarn_restarted_container_recovers_via_tracker():
-    """Out-of-range container id (YARN restart) must clear DMLC_TASK_ID and
-    flag DMLC_RECOVER so the tracker assigns the orphaned rank."""
+def test_yarn_out_of_range_container_fails_fast():
+    """An out-of-range container id must fail with a clear message, not
+    join the cohort with a bogus rank."""
     args = _args("yarn")
     cmd = build_yarn_command(args, ENVS)
     script = cmd[cmd.index("-shell_script") + 1]
-    body = open(script).read().replace(
-        "exec python train.py --lr 0.1",
-        'echo "id=${DMLC_TASK_ID:-unset} role=$DMLC_ROLE rec=${DMLC_RECOVER:-0}"')
-    open(script, "w").write(body)
     out = subprocess.run(
         ["bash", script],
         env={**os.environ,
              "CONTAINER_ID": "container_1700000000001_0001_01_000099"},
         capture_output=True, text=True)
-    assert out.stdout.split() == ["id=unset", "role=worker", "rec=1"]
+    assert out.returncode == 1
+    assert "outside cohort" in out.stderr
+    os.unlink(script)
+
+
+def test_wrapper_retry_loop_drives_recover_protocol():
+    """The wrapper must rerun a failing worker with DMLC_NUM_ATTEMPT
+    incremented (what flips the rabit client into `recover` mode) up to
+    DMLC_MAX_ATTEMPT, keeping the task id stable."""
+    args = get_opts(["--cluster", "yarn", "-n", "2", "--max-attempts", "3",
+                     "--", "bash", "-c",
+                     'echo "att=$DMLC_NUM_ATTEMPT id=$DMLC_TASK_ID"; '
+                     '[ "$DMLC_NUM_ATTEMPT" -ge 2 ]'])
+    cmd = build_yarn_command(args, ENVS)
+    script = cmd[cmd.index("-shell_script") + 1]
+    out = subprocess.run(
+        ["bash", script],
+        env={**os.environ,
+             "CONTAINER_ID": "container_1700000000001_0001_01_000002"},
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    assert out.stdout.splitlines() == [
+        "att=0 id=0", "att=1 id=0", "att=2 id=0"]
+    os.unlink(script)
+
+
+def test_wrapper_retry_exhaustion_propagates_rc():
+    args = get_opts(["--cluster", "yarn", "-n", "1", "--max-attempts", "2",
+                     "--", "bash", "-c", "exit 7"])
+    cmd = build_yarn_command(args, ENVS)
+    script = cmd[cmd.index("-shell_script") + 1]
+    out = subprocess.run(
+        ["bash", script],
+        env={**os.environ,
+             "CONTAINER_ID": "container_1700000000001_0001_01_000002"},
+        capture_output=True, text=True)
+    assert out.returncode == 7
     os.unlink(script)
 
 
