@@ -81,16 +81,21 @@ class TextParser(ParserBase):
         self.source = source
         self.parse_fn = parse_fn
         self.nthreads = nthreads
+        from ..utils.metrics import metrics
+        # cache metric handles: the registry lookup is locked, this is the
+        # per-chunk hot path
+        self._m_chunk = metrics.stage("parser.chunk")
+        self._m_parse = metrics.stage("parser.parse")
+        self._m_bytes = metrics.throughput("parser.bytes")
 
     def parse_next(self) -> Optional[RowBlockContainer]:
-        from ..utils.metrics import metrics
-        with metrics.stage("parser.chunk").time():
+        with self._m_chunk.time():
             chunk = self.source.next_chunk()
         if chunk is None:
             return None
         self.bytes_read += len(chunk)
-        metrics.throughput("parser.bytes").add(len(chunk))
-        with metrics.stage("parser.parse").time():
+        self._m_bytes.add(len(chunk))
+        with self._m_parse.time():
             d = self.parse_fn(chunk)
         return RowBlockContainer.from_arrays(
             d["offsets"], d["labels"], d["indices"], d.get("values"),

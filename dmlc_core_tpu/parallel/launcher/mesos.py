@@ -18,7 +18,7 @@ import subprocess
 from typing import Dict, List
 
 from ...utils import DMLCError, log_info
-from .wrapper import job_env
+from .wrapper import job_env, retry_loop
 
 __all__ = ["submit_mesos", "build_mesos_commands"]
 
@@ -30,14 +30,7 @@ def _inline_command(args, tracker_envs: Dict[str, str], task_id: int) -> str:
     exports = "; ".join(f"export {k}={shlex.quote(v)}"
                         for k, v in env.items())
     cmd = " ".join(shlex.quote(c) for c in args.command)
-    # same in-place retry loop as wrapper.wrapper_body: stable task id +
-    # incrementing DMLC_NUM_ATTEMPT drives the tracker's recover protocol
-    retry = ("attempt=0; while :; do "
-             f'DMLC_NUM_ATTEMPT="$attempt" {cmd}; rc=$?; '
-             '[ "$rc" -eq 0 ] && exit 0; '
-             'attempt=$((attempt + 1)); '
-             '[ "$attempt" -ge "${DMLC_MAX_ATTEMPT}" ] && exit "$rc"; done')
-    return f"{exports}; {retry}"
+    return f"{exports}; {retry_loop(cmd, oneline=True)}"
 
 
 def build_mesos_commands(args, tracker_envs: Dict[str, str]) -> List[List[str]]:

@@ -13,7 +13,7 @@ import stat
 import tempfile
 from typing import Dict
 
-__all__ = ["job_env", "render_exports", "wrapper_body",
+__all__ = ["job_env", "render_exports", "retry_loop", "wrapper_body",
            "write_wrapper_script"]
 
 
@@ -34,21 +34,40 @@ def render_exports(env: Dict[str, str]) -> str:
     return "\n".join(f"export {k}={shlex.quote(v)}" for k, v in env.items())
 
 
+def retry_loop(cmd: str, *, oneline: bool = False) -> str:
+    """The in-place retry protocol, shared by every scheduler backend: the
+    task id (= rabit jobid) stays stable across attempts while
+    ``DMLC_NUM_ATTEMPT`` increments, so on attempt > 0 the rabit client
+    sends ``recover`` and the tracker re-issues the same rank with fresh
+    neighbor addresses (``RabitContext.from_env`` + ``parallel.tracker``,
+    the analog of reference `tracker.py:279-291` / the YARN AM's
+    maxNumAttempt restart, `ApplicationMaster.java:210`)."""
+    body = [
+        f'DMLC_NUM_ATTEMPT="$attempt" {cmd}',
+        'rc=$?',
+        '[ "$rc" -eq 0 ] && exit 0',
+        'attempt=$((attempt + 1))',
+        'echo "dmlc: task ${DMLC_TASK_ID} exited rc=$rc'
+        ' (attempt $attempt/${DMLC_MAX_ATTEMPT})" >&2',
+        '[ "$attempt" -ge "${DMLC_MAX_ATTEMPT}" ] && exit "$rc"',
+    ]
+    if oneline:
+        return f'attempt=0; while :; do {"; ".join(body)}; done'
+    inner = "\n".join("  " + ln for ln in body)
+    return f"attempt=0\nwhile :; do\n{inner}\ndone"
+
+
 def wrapper_body(args, tracker_envs: Dict[str, str], cluster: str,
                  rank_snippet: str) -> str:
     """Wrapper shell body: export the env contract, run ``rank_snippet``
     (shell lines that must set ``DMLC_TASK_ID``), derive ``DMLC_ROLE`` from
-    the server split, then run the worker in an **in-place retry loop**.
+    the server split, then run the worker under :func:`retry_loop`.
 
-    The retry loop is how scheduler jobs get elastic recovery: the task id
-    (= rabit jobid) stays stable across attempts and ``DMLC_NUM_ATTEMPT``
-    increments, so on attempt > 0 the rabit client sends ``recover`` and the
-    tracker re-issues the same rank with fresh neighbor addresses
-    (``dmlc_core_tpu.parallel.rabit.RabitContext.from_env`` +
-    ``parallel.tracker`` — the analog of reference `tracker.py:279-291` and
-    of the YARN AM's maxNumAttempt restart, `ApplicationMaster.java:210`).
-    An out-of-range id (e.g. a container id beyond the cohort) fails fast
-    with a clear message rather than joining with a bogus rank."""
+    A missing, non-numeric, or out-of-range id fails fast with a clear
+    message rather than joining the tracker with a bogus rank (in-place
+    retry covers worker-process death; a scheduler that reschedules the
+    whole task re-runs this wrapper and recovers through the same
+    stable-id path)."""
     exports = render_exports(job_env(args, tracker_envs, cluster))
     cmd = " ".join(shlex.quote(c) for c in args.command)
     ns = args.num_servers
@@ -56,8 +75,12 @@ def wrapper_body(args, tracker_envs: Dict[str, str], cluster: str,
     return f"""#!/bin/bash
 {exports}
 {rank_snippet}
-if [ -z "${{DMLC_TASK_ID}}" ] || [ "${{DMLC_TASK_ID}}" -lt 0 ] \\
-   || [ "${{DMLC_TASK_ID}}" -ge "{nproc}" ]; then
+case "${{DMLC_TASK_ID}}" in
+  (''|*[!0-9]*)
+    echo "dmlc wrapper: task id '${{DMLC_TASK_ID}}' is not a number" >&2
+    exit 1;;
+esac
+if [ "${{DMLC_TASK_ID}}" -ge "{nproc}" ]; then
   echo "dmlc wrapper: task id '${{DMLC_TASK_ID}}' outside cohort of {nproc}" >&2
   exit 1
 fi
@@ -66,16 +89,7 @@ if [ "${{DMLC_TASK_ID}}" -lt "{ns}" ]; then
 else
   export DMLC_ROLE=worker
 fi
-attempt=0
-while :; do
-  DMLC_NUM_ATTEMPT="$attempt" {cmd}
-  rc=$?
-  [ "$rc" -eq 0 ] && exit 0
-  attempt=$((attempt + 1))
-  echo "dmlc wrapper: task ${{DMLC_TASK_ID}} exited rc=$rc" \\
-       "(attempt $attempt/${{DMLC_MAX_ATTEMPT}})" >&2
-  [ "$attempt" -ge "${{DMLC_MAX_ATTEMPT}}" ] && exit "$rc"
-done
+{retry_loop(cmd)}
 """
 
 

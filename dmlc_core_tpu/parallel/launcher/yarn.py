@@ -9,13 +9,14 @@ with node blacklisting (`ApplicationMaster.java:73-74,535-563`).
 TPU-native expression: no custom AM — we target YARN's stock
 **DistributedShell** application with a generated wrapper script that maps
 the container index onto ``DMLC_TASK_ID``/``DMLC_ROLE`` and exports the
-tracker rendezvous env. Container ids are only a *hint*: a YARN-restarted
-container gets a fresh (higher, out-of-range) id, in which case the wrapper
-clears ``DMLC_TASK_ID`` and sets ``DMLC_RECOVER=1`` so the tracker's
-``recover`` protocol (`tracker.py:279-291` analog in
-``dmlc_core_tpu.parallel.tracker``) assigns the orphaned rank at
-rendezvous; the AM's maxNumAttempt policy maps onto ``--max-attempts``
-forwarded as ``DMLC_MAX_ATTEMPT``.
+tracker rendezvous env. Failure handling: the AM's maxNumAttempt policy
+maps onto ``--max-attempts`` (forwarded as ``DMLC_MAX_ATTEMPT``) driving an
+**in-place retry loop** inside the container — the worker restarts with a
+stable task id and an incremented ``DMLC_NUM_ATTEMPT``, which flips the
+rabit client into the tracker's ``recover`` protocol (`tracker.py:279-291`
+analog). Container-*level* replacement (a fresh container with a new id) is
+not supported by stock DistributedShell; a deployment that needs it should
+front this launcher with a custom AM, as the reference does.
 """
 
 from __future__ import annotations
@@ -30,8 +31,8 @@ from .wrapper import write_wrapper_script
 __all__ = ["submit_yarn", "build_yarn_command"]
 
 # CONTAINER_ID ends in _<attempt>_<id>; ids start at 1 and container 1 is
-# the AM itself, so first-allocation task index = id - 2 (out-of-range ids
-# fall through to tracker-assigned recovery in the shared wrapper)
+# the AM itself, so first-allocation task index = id - 2 (the shared
+# wrapper fails fast on non-numeric/out-of-range ids)
 _RANK_SNIPPET = '''cid="${CONTAINER_ID##*_}"
 cid="$((10#$cid))"
 export DMLC_TASK_ID="$((cid - 2))"'''

@@ -111,24 +111,26 @@ class DeviceLoader:
 
     def _to_device(self, block) -> Dict[str, jax.Array]:
         from ..utils.metrics import metrics, trace_span
-        with trace_span("device_loader.pack"), \
-                metrics.stage("device_loader.pack").time():
+        if not hasattr(self, "_m_pack"):     # cache handles: per-batch path
+            self._m_pack = metrics.stage("device_loader.pack")
+            self._m_h2d = metrics.stage("device_loader.h2d")
+            self._m_batches = metrics.counter("device_loader.batches")
+            self._m_rows = metrics.throughput("device_loader.rows")
+        with trace_span("device_loader.pack"), self._m_pack.time():
             if self.layout == "flat":
                 host = pack_flat(block, self.batch_rows, self.nnz_cap,
                                  self.stats)
             else:
                 host = pack_rowmajor(block, self.batch_rows, self.nnz_cap,
                                      self.stats)
-        with trace_span("device_loader.h2d"), \
-                metrics.stage("device_loader.h2d").time():
+        with trace_span("device_loader.h2d"), self._m_h2d.time():
             # packed arrays lead with the batch/nnz axis: one sharding fits
             out = {k: jax.device_put(v, self.sharding)
                    for k, v in host.items()}
-        metrics.counter("device_loader.batches").add(1)
+        self._m_batches.add(1)
         # real rows in this block (the final partial batch has fewer than
         # batch_rows; the padded device shape is not the row count)
-        metrics.throughput("device_loader.rows").add(
-            getattr(block, "size", self.batch_rows))
+        self._m_rows.add(getattr(block, "size", self.batch_rows))
         return out
 
     # -- consumer side --

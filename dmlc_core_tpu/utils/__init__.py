@@ -17,6 +17,9 @@ from .concurrency import (  # noqa: F401
 )
 from .memory_io import MemoryFixedSizeStream, MemoryStringStream  # noqa: F401
 from .common import split, hash_combine, byteswap  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    Serializable, CheckpointManager, save_pytree, load_pytree, fast_forward,
+)
 from .metrics import (  # noqa: F401
     Counter, Gauge, ThroughputMeter, StageTimer, MetricsRegistry,
     metrics, trace_span, profile_trace,

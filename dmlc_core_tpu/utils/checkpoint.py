@@ -1,0 +1,301 @@
+"""Checkpoint/resume substrate — the policy layer the reference leaves to
+downstream, built on the substrate it ships (SURVEY §5 checkpoint/resume):
+``Serializable`` Load/Save (`io.h:112-126`), the STL/struct serializer
+(`serializer.h`), binary RowBlock Save/Load (`row_block.h:181-205`) and
+parameter JSON save/load (`parameter.h:185-197`).
+
+TPU-native expression:
+
+* :func:`save_pytree` / :func:`load_pytree` — stream-serialize a nested
+  dict/list/tuple of arrays (jax or numpy; jax arrays land as numpy and are
+  re-``device_put`` by the caller with whatever sharding the restore mesh
+  uses — checkpoints are **sharding-agnostic**, the same way reference
+  serialization is endian-portable, `serializer.h` ``DMLC_IO_NO_ENDIAN_SWAP``).
+* :class:`Serializable` — the duck-typed Save/Load protocol.
+* :class:`CheckpointManager` — versioned on-disk checkpoints with atomic
+  publish (write to temp + rename), a JSON manifest, latest/step restore and
+  bounded retention. Works over any URI the filesystem layer can write
+  (local, s3, hdfs...) with atomicity guaranteed on ``file://``.
+
+Step/epoch position of the *data* pipeline is part of the saved state:
+``DeviceLoader`` counts consumed batches, and :func:`fast_forward` replays
+a restored loader to the recorded position (the ingest analog of the
+reference's resumable cache files, `cached_input_split.h`).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import struct
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .json import json_dumps, json_loads
+from .logging import DMLCError, check, log_info
+
+__all__ = [
+    "Serializable", "save_pytree", "load_pytree", "CheckpointManager",
+    "fast_forward",
+]
+
+_MAGIC = b"DMLCKPT1"
+
+
+class Serializable:
+    """Save/Load protocol (reference ``Serializable`` `io.h:112-126`)."""
+
+    def save(self, stream) -> None:
+        raise NotImplementedError
+
+    def load(self, stream) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> stream
+# ---------------------------------------------------------------------------
+
+def _to_numpy(x):
+    """jax.Array (possibly sharded) → host numpy; numpy passes through."""
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "__array__"):      # jax.Array and friends
+        return np.asarray(x)
+    return None
+
+
+def _write_blob(stream, b: bytes) -> None:
+    stream.write(struct.pack("<Q", len(b)))
+    stream.write(b)
+
+
+def _read_exact(stream, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = stream.read(n - len(out))
+        if not chunk:
+            raise DMLCError("checkpoint stream truncated")
+        out += chunk
+    return out
+
+
+def _read_blob(stream) -> bytes:
+    (n,) = struct.unpack("<Q", _read_exact(stream, 8))
+    return _read_exact(stream, n)
+
+
+def save_pytree(stream, tree: Any) -> None:
+    """Serialize a pytree of arrays/scalars. Layout: magic, JSON treedef
+    (structure with leaf placeholders), then each array leaf as
+    (dtype, shape, raw bytes)."""
+    leaves: List[np.ndarray] = []
+
+    def strip(node):
+        arr = _to_numpy(node)
+        if arr is not None:
+            leaves.append(arr)
+            return {"__leaf__": len(leaves) - 1}
+        if isinstance(node, dict):
+            check(all(isinstance(k, str) for k in node),
+                  "checkpoint dict keys must be str")
+            check("__leaf__" not in node and "__tuple__" not in node,
+                  "reserved key in checkpoint tree")
+            return {k: strip(v) for k, v in node.items()}
+        if isinstance(node, tuple):
+            return {"__tuple__": [strip(v) for v in node]}
+        if isinstance(node, list):
+            return [strip(v) for v in node]
+        if node is None or isinstance(node, (bool, int, float, str)):
+            return node
+        raise DMLCError(f"cannot checkpoint {type(node).__name__}")
+
+    treedef = strip(tree)
+    stream.write(_MAGIC)
+    _write_blob(stream, json_dumps(treedef).encode())
+    stream.write(struct.pack("<I", len(leaves)))
+    for arr in leaves:
+        arr = np.ascontiguousarray(arr)
+        _write_blob(stream, str(arr.dtype).encode())
+        stream.write(struct.pack("<I", arr.ndim))
+        for d in arr.shape:
+            stream.write(struct.pack("<Q", d))
+        _write_blob(stream, arr.tobytes())
+
+
+def load_pytree(stream, template: Any = None) -> Any:
+    """Deserialize a pytree. With ``template``, container *types* are taken
+    from it (NamedTuples — e.g. optax optimizer states — and custom dicts
+    restore as their original classes; a plain load can only produce
+    dict/list/tuple)."""
+    magic = _read_exact(stream, len(_MAGIC))
+    check(magic == _MAGIC, f"not a dmlc checkpoint (magic {magic!r})")
+    treedef = json_loads(_read_blob(stream).decode())
+    (nleaves,) = struct.unpack("<I", _read_exact(stream, 4))
+    leaves = []
+    for _ in range(nleaves):
+        dtype = np.dtype(_read_blob(stream).decode())
+        (ndim,) = struct.unpack("<I", _read_exact(stream, 4))
+        shape = tuple(struct.unpack("<Q", _read_exact(stream, 8))[0]
+                      for _ in range(ndim))
+        raw = _read_blob(stream)
+        leaves.append(np.frombuffer(raw, dtype=dtype).reshape(shape).copy())
+
+    def rebuild(node):
+        if isinstance(node, dict):
+            if "__leaf__" in node:
+                return leaves[node["__leaf__"]]
+            if "__tuple__" in node:
+                return tuple(rebuild(v) for v in node["__tuple__"])
+            return {k: rebuild(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [rebuild(v) for v in node]
+        return node
+
+    def rebuild_like(tmpl, node):
+        if isinstance(node, dict) and "__leaf__" in node:
+            return leaves[node["__leaf__"]]
+        if isinstance(node, dict) and "__tuple__" in node:
+            children = node["__tuple__"]
+            check(isinstance(tmpl, tuple) and len(tmpl) == len(children),
+                  f"template mismatch: expected {len(children)}-tuple, "
+                  f"got {type(tmpl).__name__}")
+            vals = [rebuild_like(t, c) for t, c in zip(tmpl, children)]
+            if hasattr(tmpl, "_fields"):        # NamedTuple: keep the type
+                return type(tmpl)(*vals)
+            return tuple(vals)
+        if isinstance(node, dict):
+            check(isinstance(tmpl, dict),
+                  f"template mismatch: expected dict, got "
+                  f"{type(tmpl).__name__}")
+            out = {k: rebuild_like(tmpl[k], v) if k in tmpl else rebuild(v)
+                   for k, v in node.items()}
+            return type(tmpl)(out) if type(tmpl) is not dict else out
+        if isinstance(node, list):
+            if isinstance(tmpl, list):
+                check(len(tmpl) == len(node),
+                      f"template mismatch: list of {len(tmpl)} vs "
+                      f"checkpointed {len(node)}")
+                return [rebuild_like(ti, v) for ti, v in zip(tmpl, node)]
+            return [rebuild(v) for v in node]
+        return node
+
+    if template is None:
+        return rebuild(treedef)
+    return rebuild_like(template, treedef)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Versioned checkpoints with atomic publish and bounded retention.
+
+    Directory layout::
+
+        <dir>/ckpt-<step>.bin     one pytree per step
+        <dir>/MANIFEST.json       {"latest": step, "steps": [...], "meta": {}}
+
+    ``save`` writes to a temp file in the same directory then ``os.rename``s
+    (atomic on POSIX), then rewrites the manifest — a crash mid-save leaves
+    the previous checkpoint fully intact (the property the reference gets
+    from rebuildable cache files, `disk_row_iter.h:95-108`).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        self.dir = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.dir, f"ckpt-{step}.bin")
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, "MANIFEST.json")
+
+    def _read_manifest(self) -> Dict[str, Any]:
+        try:
+            with open(self._manifest_path()) as f:
+                return json_loads(f.read())
+        except FileNotFoundError:
+            return {"latest": None, "steps": [], "meta": {}}
+
+    def _write_manifest(self, m: Dict[str, Any]) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".manifest-")
+        with os.fdopen(fd, "w") as f:
+            f.write(json_dumps(m))
+        os.replace(tmp, self._manifest_path())
+
+    @property
+    def steps(self) -> List[int]:
+        return list(self._read_manifest()["steps"])
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._read_manifest()["latest"]
+
+    def save(self, step: int, state: Any,
+             meta: Optional[Dict[str, Any]] = None) -> str:
+        check(step >= 0, "checkpoint step must be >= 0")
+        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=f".ckpt-{step}-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                save_pytree(f, state)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(step))       # atomic publish
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        m = self._read_manifest()
+        if step not in m["steps"]:
+            m["steps"] = sorted(m["steps"] + [step])
+        m["latest"] = max(s for s in m["steps"])
+        if meta:
+            m["meta"][str(step)] = meta
+        # prune before the single manifest write
+        while len(m["steps"]) > self.max_to_keep:
+            drop = m["steps"].pop(0)
+            m["meta"].pop(str(drop), None)
+            try:
+                os.unlink(self._path(drop))
+            except OSError:
+                pass
+        self._write_manifest(m)
+        log_info("checkpoint: saved step %d -> %s", step, self._path(step))
+        return self._path(step)
+
+    def restore(self, step: Optional[int] = None,
+                template: Any = None) -> Tuple[int, Any]:
+        """-> (step, state). Default: latest. ``template`` restores
+        container types (see :func:`load_pytree`) — pass a freshly-built
+        state of the same structure to get optax NamedTuples etc. back."""
+        m = self._read_manifest()
+        if step is None:
+            step = m["latest"]
+        if step is None:
+            raise DMLCError(f"no checkpoints in {self.dir}")
+        check(step in m["steps"], f"no checkpoint for step {step}; "
+                                  f"have {m['steps']}")
+        with open(self._path(step), "rb") as f:
+            return step, load_pytree(f, template=template)
+
+    def meta(self, step: int) -> Dict[str, Any]:
+        return self._read_manifest()["meta"].get(str(step), {})
+
+
+def fast_forward(loader, num_batches: int) -> int:
+    """Skip ``num_batches`` batches of a fresh loader to resume mid-epoch
+    (the data-position half of resume). Returns batches actually skipped."""
+    skipped = 0
+    while skipped < num_batches:
+        if loader.next_batch() is None:
+            break
+        skipped += 1
+    return skipped

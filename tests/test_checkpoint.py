@@ -1,0 +1,185 @@
+"""Checkpoint/resume tests: pytree round trips, atomic manager semantics,
+retention, crash-safety, and full train-interrupt-resume on a real model."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.utils import DMLCError
+from dmlc_core_tpu.utils.checkpoint import (
+    CheckpointManager,
+    fast_forward,
+    load_pytree,
+    save_pytree,
+)
+
+
+def _roundtrip(tree):
+    buf = io.BytesIO()
+    save_pytree(buf, tree)
+    buf.seek(0)
+    return load_pytree(buf)
+
+
+def test_pytree_roundtrip_mixed():
+    tree = {
+        "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                   "b": np.zeros(4, dtype=np.float64)},
+        "step": 17,
+        "lr": 0.125,
+        "name": "fm",
+        "flags": [True, False, None],
+        "shape": (3, 4),
+        "ints": np.array([1, 2, 3], dtype=np.int64),
+    }
+    out = _roundtrip(tree)
+    np.testing.assert_array_equal(out["params"]["w"], tree["params"]["w"])
+    assert out["params"]["w"].dtype == np.float32
+    np.testing.assert_array_equal(out["ints"], tree["ints"])
+    assert out["step"] == 17 and out["lr"] == 0.125
+    assert out["flags"] == [True, False, None]
+    assert out["shape"] == (3, 4)          # tuples survive as tuples
+
+
+def test_pytree_jax_arrays_roundtrip_as_numpy():
+    import jax.numpy as jnp
+    tree = {"w": jnp.arange(8, dtype=jnp.float32), "nested": [jnp.ones(3)]}
+    out = _roundtrip(tree)
+    assert isinstance(out["w"], np.ndarray)
+    np.testing.assert_array_equal(out["w"], np.arange(8, dtype=np.float32))
+
+
+def test_pytree_bad_magic():
+    with pytest.raises(DMLCError, match="magic"):
+        load_pytree(io.BytesIO(b"NOTACKPTxxxx"))
+
+
+def test_pytree_unserializable_type():
+    with pytest.raises(DMLCError, match="cannot checkpoint"):
+        save_pytree(io.BytesIO(), {"f": lambda: 1})
+
+
+def test_manager_save_restore_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    assert mgr.latest_step is None
+    for step in [10, 20, 30]:
+        mgr.save(step, {"w": np.full(4, step, np.float32), "step": step},
+                 meta={"loss": 1.0 / step})
+    step, state = mgr.restore()
+    assert step == 30
+    np.testing.assert_array_equal(state["w"], np.full(4, 30, np.float32))
+    step, state = mgr.restore(20)
+    assert state["step"] == 20
+    assert mgr.meta(20) == {"loss": 0.05}
+
+
+def test_manager_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    for step in range(5):
+        mgr.save(step, {"s": step})
+    assert mgr.steps == [3, 4]
+    assert not os.path.exists(os.path.join(tmp_path, "ckpt-0.bin"))
+    with pytest.raises(DMLCError, match="no checkpoint for step 0"):
+        mgr.restore(0)
+
+
+def test_manager_crash_safety(tmp_path, monkeypatch):
+    """A save that dies mid-write must leave the previous checkpoint and
+    manifest fully intact (atomic publish)."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"w": np.ones(3)})
+
+    import dmlc_core_tpu.utils.checkpoint as cp
+    real = cp.save_pytree
+
+    def exploding(stream, tree):
+        real(stream, {"partial": np.ones(1)})
+        raise RuntimeError("disk died")
+
+    monkeypatch.setattr(cp, "save_pytree", exploding)
+    with pytest.raises(RuntimeError):
+        mgr.save(2, {"w": np.zeros(3)})
+    monkeypatch.setattr(cp, "save_pytree", real)
+
+    assert mgr.latest_step == 1
+    step, state = mgr.restore()
+    np.testing.assert_array_equal(state["w"], np.ones(3))
+    # no stray temp files
+    assert all(not f.startswith(".ckpt") for f in os.listdir(tmp_path))
+
+
+def test_manager_reopen_between_runs(tmp_path):
+    CheckpointManager(str(tmp_path)).save(5, {"x": 1})
+    mgr2 = CheckpointManager(str(tmp_path))   # fresh process analog
+    step, state = mgr2.restore()
+    assert (step, state["x"]) == (5, 1)
+
+
+def test_train_interrupt_resume(tmp_path):
+    """The full contract: train k steps, checkpoint, 'crash', restore into a
+    fresh model+loader, fast-forward the data, finish — final params equal
+    an uninterrupted run (bitwise, since the data order is deterministic)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from dmlc_core_tpu.data import create_parser
+    from dmlc_core_tpu.models import FactorizationMachine
+    from dmlc_core_tpu.models.train import make_train_step
+    from dmlc_core_tpu.pipeline.device_loader import DeviceLoader
+
+    path = tmp_path / "t.libsvm"
+    path.write_text("".join(
+        f"{i%2} {i%13+1}:0.5 {(i*3)%13+1}:1.0\n" for i in range(512)))
+
+    def make_loader():
+        p = create_parser(f"file://{path}", 0, 1, "libsvm")
+        return DeviceLoader(p, batch_rows=64, nnz_cap=256)
+
+    model = FactorizationMachine(num_features=16, dim=4)
+    opt = optax.adam(1e-2)
+    step_fn = jax.jit(make_train_step(model, opt))
+
+    def init_state():
+        params = model.init(jax.random.PRNGKey(0))
+        return params, opt.init(params)
+
+    # --- uninterrupted run: 8 batches
+    params, opt_state = init_state()
+    loader = make_loader()
+    for _ in range(8):
+        batch = loader.next_batch()
+        params, opt_state, _loss = step_fn(params, opt_state, batch)
+    loader.close()
+    ref = jax.tree_util.tree_map(np.asarray, params)
+
+    # --- interrupted run: 5 batches, checkpoint, crash, resume, 3 more
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    params, opt_state = init_state()
+    loader = make_loader()
+    for i in range(5):
+        batch = loader.next_batch()
+        params, opt_state, _loss = step_fn(params, opt_state, batch)
+    mgr.save(5, {"params": params, "opt_state": opt_state,
+                 "batches_consumed": 5})
+    loader.close()
+    del params, opt_state                      # "crash"
+
+    # template restore: optax NamedTuple state types must come back intact
+    p0, o0 = init_state()
+    step, state = mgr.restore(
+        template={"params": p0, "opt_state": o0, "batches_consumed": 0})
+    assert step == 5 and state["batches_consumed"] == 5
+    params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+    opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+    assert type(opt_state) is type(o0)
+    loader = make_loader()
+    assert fast_forward(loader, state["batches_consumed"]) == 5
+    for _ in range(3):
+        batch = loader.next_batch()
+        params, opt_state, _loss = step_fn(params, opt_state, batch)
+    loader.close()
+
+    resumed = jax.tree_util.tree_map(np.asarray, params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, ref, resumed)
