@@ -81,14 +81,22 @@ class TextParser(ParserBase):
         self.source = source
         self.parse_fn = parse_fn
         self.nthreads = nthreads
+        self._bind_metrics()
+
+    def _bind_metrics(self) -> None:
+        # cache metric handles: the registry lookup is locked and this is
+        # the per-chunk hot path; re-bind when the registry generation
+        # changes (metrics.reset() between epochs must not orphan us)
         from ..utils.metrics import metrics
-        # cache metric handles: the registry lookup is locked, this is the
-        # per-chunk hot path
+        self._m_gen = metrics.generation
         self._m_chunk = metrics.stage("parser.chunk")
         self._m_parse = metrics.stage("parser.parse")
         self._m_bytes = metrics.throughput("parser.bytes")
 
     def parse_next(self) -> Optional[RowBlockContainer]:
+        from ..utils.metrics import metrics
+        if self._m_gen != metrics.generation:
+            self._bind_metrics()
         with self._m_chunk.time():
             chunk = self.source.next_chunk()
         if chunk is None:

@@ -2,14 +2,29 @@
 
 from .csr import csr_dense_matvec, csr_embed_sum, fm_pairwise  # noqa: F401
 
+# NOTE: the bare `ring_attention`/`ulysses_attention` building-block fns
+# are NOT re-exported here — their names collide with their submodules
+# (Python binds a submodule as a package attribute on first import, which
+# would shadow the function). Import them from the submodule:
+#   from dmlc_core_tpu.ops.ring_attention import ring_attention
 __all__ = ["csr_dense_matvec", "csr_embed_sum", "fm_pairwise",
-           "embed_bag_pallas", "embed_bag_reference"]
+           "embed_bag_pallas", "embed_bag_reference",
+           "make_ring_attention", "reference_attention",
+           "make_ulysses_attention"]
 
 
 def __getattr__(name):
-    # pallas imports are lazy: jax.experimental.pallas is heavyweight and not
+    # heavyweight imports are lazy: pallas / shard_map machinery is not
     # needed for the pure-XLA paths
-    if name in ("embed_bag_pallas", "embed_bag_reference"):
-        from . import pallas_embed
-        return getattr(pallas_embed, name)
+    import importlib
+    lazy = {
+        "embed_bag_pallas": "pallas_embed",
+        "embed_bag_reference": "pallas_embed",
+        "make_ring_attention": "ring_attention",
+        "reference_attention": "ring_attention",
+        "make_ulysses_attention": "ulysses",
+    }
+    if name in lazy:
+        mod = importlib.import_module(f".{lazy[name]}", __name__)
+        return getattr(mod, name)
     raise AttributeError(name)

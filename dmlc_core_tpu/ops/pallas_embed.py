@@ -71,7 +71,7 @@ def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, K), lambda b, ids: (b, 0)),        # vals row
-            pl.BlockSpec(memory_space=pltpu.ANY),               # table in HBM
+            pl.BlockSpec(memory_space=pl.ANY),               # table in HBM
         ],
         out_specs=pl.BlockSpec((1, D), lambda b, ids: (b, 0)),
         scratch_shapes=[

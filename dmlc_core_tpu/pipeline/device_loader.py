@@ -109,13 +109,20 @@ class DeviceLoader:
         self._producer_state["gen"] = None
         self.source.before_first()
 
+    def _bind_metrics(self) -> None:
+        # cached handles (locked registry lookups are off the per-batch
+        # path); re-bind when the registry generation changes
+        from ..utils.metrics import metrics
+        self._m_gen = metrics.generation
+        self._m_pack = metrics.stage("device_loader.pack")
+        self._m_h2d = metrics.stage("device_loader.h2d")
+        self._m_batches = metrics.counter("device_loader.batches")
+        self._m_rows = metrics.throughput("device_loader.rows")
+
     def _to_device(self, block) -> Dict[str, jax.Array]:
         from ..utils.metrics import metrics, trace_span
-        if not hasattr(self, "_m_pack"):     # cache handles: per-batch path
-            self._m_pack = metrics.stage("device_loader.pack")
-            self._m_h2d = metrics.stage("device_loader.h2d")
-            self._m_batches = metrics.counter("device_loader.batches")
-            self._m_rows = metrics.throughput("device_loader.rows")
+        if getattr(self, "_m_gen", None) != metrics.generation:
+            self._bind_metrics()
         with trace_span("device_loader.pack"), self._m_pack.time():
             if self.layout == "flat":
                 host = pack_flat(block, self.batch_rows, self.nnz_cap,

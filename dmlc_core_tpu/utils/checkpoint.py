@@ -59,12 +59,21 @@ class Serializable:
 # ---------------------------------------------------------------------------
 
 def _to_numpy(x):
-    """jax.Array (possibly sharded) → host numpy; numpy passes through."""
+    """jax.Array (possibly sharded) → host numpy; numpy passes through.
+    Rejects object dtype at SAVE time — its raw bytes are pointers and the
+    checkpoint would only fail at restore, after the crash it was meant to
+    survive."""
     if isinstance(x, np.ndarray):
-        return x
-    if hasattr(x, "__array__"):      # jax.Array and friends
-        return np.asarray(x)
-    return None
+        arr = x
+    elif hasattr(x, "__array__"):    # jax.Array and friends
+        arr = np.asarray(x)
+    else:
+        return None
+    if arr.dtype.hasobject:
+        raise DMLCError(
+            f"cannot checkpoint object-dtype array (dtype {arr.dtype}); "
+            f"convert to a numeric/bytes dtype first")
+    return arr
 
 
 def _write_blob(stream, b: bytes) -> None:
@@ -222,11 +231,25 @@ class CheckpointManager:
                 return json_loads(f.read())
         except FileNotFoundError:
             return {"latest": None, "steps": [], "meta": {}}
+        except ValueError:
+            # truncated/corrupt manifest (crash mid-publish): the fsynced
+            # ckpt files are the source of truth — rebuild from them
+            steps = sorted(
+                int(f[len("ckpt-"):-len(".bin")])
+                for f in os.listdir(self.dir)
+                if f.startswith("ckpt-") and f.endswith(".bin")
+                and f[len("ckpt-"):-len(".bin")].isdigit())
+            log_info("checkpoint: manifest corrupt, rebuilt from %d files",
+                     len(steps))
+            return {"latest": steps[-1] if steps else None,
+                    "steps": steps, "meta": {}}
 
     def _write_manifest(self, m: Dict[str, Any]) -> None:
         fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".manifest-")
         with os.fdopen(fd, "w") as f:
             f.write(json_dumps(m))
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._manifest_path())
 
     @property

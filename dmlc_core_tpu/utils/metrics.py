@@ -192,6 +192,9 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._m: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        #: bumped by reset(); hot paths that cache metric handles compare
+        #: this (one int read, no lock) and re-fetch when it changes
+        self.generation = 0
 
     def _get(self, name: str, cls, **kw):
         with self._lock:
@@ -227,6 +230,7 @@ class MetricsRegistry:
     def reset(self) -> None:
         with self._lock:
             self._m.clear()
+            self.generation += 1
 
 
 #: process-global registry (modules grab sub-metrics by name)

@@ -61,6 +61,32 @@ def test_pytree_unserializable_type():
         save_pytree(io.BytesIO(), {"f": lambda: 1})
 
 
+def test_pytree_object_dtype_rejected_at_save():
+    with pytest.raises(DMLCError, match="object-dtype"):
+        save_pytree(io.BytesIO(),
+                    {"x": np.array(["a", "bb"], dtype=object)})
+
+
+def test_template_list_length_mismatch_errors():
+    buf = io.BytesIO()
+    save_pytree(buf, {"layers": [np.ones(2), np.ones(3), np.ones(4)]})
+    buf.seek(0)
+    with pytest.raises(DMLCError, match="template mismatch"):
+        load_pytree(buf, template={"layers": [np.zeros(2), np.zeros(3)]})
+
+
+def test_corrupt_manifest_rebuilt_from_files(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(3, {"x": np.ones(2)})
+    mgr.save(7, {"x": np.full(2, 7.0)})
+    # simulate crash-truncated manifest
+    open(os.path.join(tmp_path, "MANIFEST.json"), "w").close()
+    mgr2 = CheckpointManager(str(tmp_path))
+    assert mgr2.latest_step == 7
+    step, state = mgr2.restore()
+    np.testing.assert_array_equal(state["x"], np.full(2, 7.0))
+
+
 def test_manager_save_restore_latest(tmp_path):
     mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
     assert mgr.latest_step is None

@@ -92,6 +92,20 @@ def test_trace_span_noop_safe():
     assert x == 2
 
 
+def test_cached_handles_rebind_after_reset(tmp_path):
+    """A parser built BEFORE metrics.reset() must still report into the
+    registry afterwards (generation-based rebinding)."""
+    f = tmp_path / "r.libsvm"
+    f.write_text("".join(f"{i%2} {i%5+1}:1.0\n" for i in range(100)))
+    from dmlc_core_tpu.data import create_parser
+    p = create_parser(f"file://{f}", 0, 1, "libsvm", threaded=False)
+    metrics.reset()                       # epoch boundary
+    rows = sum(blk.size for blk in p)
+    p.close()
+    assert rows == 100
+    assert metrics.snapshot()["parser.bytes"]["total"] == f.stat().st_size
+
+
 def test_ingest_populates_global_metrics(tmp_path):
     metrics.reset()
     f = tmp_path / "d.libsvm"
