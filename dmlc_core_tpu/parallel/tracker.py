@@ -39,8 +39,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..utils import DMLCError, check, get_logger, log_info
 
-__all__ = ["RabitTracker", "compute_tree", "compute_ring", "recv_json",
-           "send_json"]
+__all__ = ["RabitTracker", "PSTracker", "compute_tree", "compute_ring",
+           "recv_json", "send_json"]
 
 logger = get_logger()
 
@@ -287,6 +287,78 @@ class RabitTracker:
                           for r in set(tree[rec.rank] + [ring_prev, ring_next])
                           if r != rec.rank},
         }
+
+
+class PSTracker:
+    """Parameter-server bootstrap — capability parity with reference
+    ``PSTracker`` (`tracker.py:336-386`): launch the **scheduler** process
+    locally with ``DMLC_ROLE=scheduler`` and hand every worker/server the
+    same ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT`` rendezvous env.
+
+    The scheduler binary itself is downstream (ps-lite in the reference;
+    here any command — e.g. a process running
+    :func:`dmlc_core_tpu.parallel.launcher.tpu.initialize_jax_from_env` as
+    coordinator). ``pscmd=None`` skips the spawn and only materializes env,
+    matching the reference's behavior when no scheduler command is given.
+    """
+
+    def __init__(self, host_ip: Optional[str] = None, port: int = 9100,
+                 max_port: int = 9999, pscmd: Optional[List[str]] = None):
+        self.host_ip = host_ip or _default_host_ip()
+        # reserve a free port and HOLD the socket (a bind-then-close probe
+        # races: two trackers scanning concurrently would both pick the
+        # same port); released right before the scheduler spawns
+        self.port = None
+        self._reserve: Optional[socket.socket] = None
+        for p in range(port, max_port + 1):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind((self.host_ip, p))
+                self.port = p
+                self._reserve = s
+                break
+            except OSError:
+                s.close()
+        if self.port is None:
+            raise DMLCError(f"pstracker: no free port in [{port}, {max_port}]")
+        self.pscmd = pscmd
+        self._proc = None
+
+    def worker_envs(self) -> Dict[str, str]:
+        return {
+            "DMLC_PS_ROOT_URI": self.host_ip,
+            "DMLC_PS_ROOT_PORT": str(self.port),
+        }
+
+    def start(self) -> None:
+        if not self.pscmd:
+            return
+        import os
+        import subprocess
+        env = dict(os.environ)
+        env.update(self.worker_envs())
+        env["DMLC_ROLE"] = "scheduler"
+        if self._reserve is not None:
+            # hand the port to the scheduler (it binds it itself, as
+            # ps-lite does); SO_REUSEADDR makes the TIME_WAIT-free rebind
+            # immediate — the race window is just this spawn
+            self._reserve.close()
+            self._reserve = None
+        self._proc = subprocess.Popen(self.pscmd, env=env)
+        log_info("pstracker: scheduler started at %s:%d (pid %d)",
+                 self.host_ip, self.port, self._proc.pid)
+
+    def join(self) -> int:
+        return self._proc.wait() if self._proc else 0
+
+    def stop(self) -> None:
+        if self._reserve is not None:
+            self._reserve.close()
+            self._reserve = None
+        if self._proc and self._proc.poll() is None:
+            self._proc.terminate()
+            self._proc.wait()
 
 
 def _default_host_ip() -> str:

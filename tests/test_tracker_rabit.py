@@ -157,3 +157,23 @@ def test_local_launcher_end_to_end(tmp_path):
         cwd="/root/repo", capture_output=True, text=True, timeout=120,
         env=env)
     assert rc.returncode == 0, rc.stderr
+
+
+def test_pstracker_env_and_scheduler_spawn():
+    """PSTracker parity (reference tracker.py:336-386): scheduler process
+    gets DMLC_ROLE=scheduler + PS root env; workers get the same env."""
+    import subprocess
+    import sys
+    from dmlc_core_tpu.parallel.tracker import PSTracker
+    t = PSTracker(host_ip="127.0.0.1",
+                  pscmd=[sys.executable, "-c",
+                         "import os; "
+                         "assert os.environ['DMLC_ROLE']=='scheduler'; "
+                         "assert os.environ['DMLC_PS_ROOT_URI']=='127.0.0.1'; "
+                         "assert int(os.environ['DMLC_PS_ROOT_PORT'])>0"])
+    env = t.worker_envs()
+    assert env["DMLC_PS_ROOT_URI"] == "127.0.0.1"
+    assert int(env["DMLC_PS_ROOT_PORT"]) >= 9100
+    t.start()
+    assert t.join() == 0
+    t.stop()
