@@ -2,6 +2,7 @@
 
 from .sparse import (SparseLogReg, FactorizationMachine,  # noqa: F401
                      weighted_bce, weighted_mse)
+from .ftrl import ftrl, FTRLState  # noqa: F401
 from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F401
                     param_shardings, shard_params, fit_stream)
 

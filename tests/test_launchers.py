@@ -150,3 +150,51 @@ def test_submit_dry_run_all_clusters():
         rc = submit(["--cluster", cluster, "-n", "2", "--dry-run",
                      "--", "definitely-not-a-real-binary"])
         assert rc == 0, cluster
+
+
+def test_bootstrap_fixup_env():
+    from dmlc_core_tpu.parallel.launcher.bootstrap import fixup_env
+    # slurm rank → task id → role + jax contract: jax process ids are the
+    # WORKER-relative index (global ids 0..ns-1 are servers, which do not
+    # join the jax process group)
+    e = fixup_env({"SLURM_PROCID": "3", "DMLC_NUM_SERVER": "2",
+                   "DMLC_NUM_WORKER": "6"})
+    assert e["DMLC_TASK_ID"] == "3"
+    assert e["DMLC_ROLE"] == "worker"
+    assert e["JAX_PROCESS_ID"] == "1"       # 3 - 2 servers
+    assert e["JAX_NUM_PROCESSES"] == "6"
+    # first worker (task id == ns) must be jax process 0 (the coordinator)
+    e = fixup_env({"SLURM_PROCID": "2", "DMLC_NUM_SERVER": "2",
+                   "DMLC_NUM_WORKER": "6"})
+    assert e["JAX_PROCESS_ID"] == "0"
+    # sge is 1-based; servers get no jax process id
+    e = fixup_env({"SGE_TASK_ID": "1", "DMLC_NUM_SERVER": "2"})
+    assert e["DMLC_TASK_ID"] == "0"
+    assert e["DMLC_ROLE"] == "server"
+    assert "JAX_PROCESS_ID" not in e
+    # SGE non-array jobs export the literal 'undefined': must not crash
+    e = fixup_env({"SGE_TASK_ID": "undefined"})
+    assert "DMLC_TASK_ID" not in e
+    # explicit values never overwritten
+    e = fixup_env({"DMLC_TASK_ID": "7", "SLURM_PROCID": "1",
+                   "DMLC_ROLE": "worker"})
+    assert e["DMLC_TASK_ID"] == "7"
+
+
+def test_bootstrap_unpack_and_exec(tmp_path):
+    import subprocess
+    import sys
+    import zipfile
+    with zipfile.ZipFile(tmp_path / "bundle.zip", "w") as z:
+        z.writestr("inner.txt", "shipped")
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.bootstrap",
+         "--", sys.executable, "-c",
+         "import os; print(os.environ['DMLC_ROLE'], "
+         "open('bundle/inner.txt').read())"],
+        cwd=tmp_path, capture_output=True, text=True,
+        env={**__import__('os').environ, "SLURM_PROCID": "0",
+             "DMLC_NUM_SERVER": "0", "DMLC_NUM_WORKER": "1",
+             "PYTHONPATH": "/root/repo"})
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "worker shipped"

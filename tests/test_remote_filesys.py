@@ -552,3 +552,28 @@ def test_webhdfs_write(hdfs_server):
     with open_stream(f"hdfs://{host}/out/result.bin", "w") as w:
         w.write(b"written via webhdfs")
     assert h.files["/out/result.bin"] == b"written via webhdfs"
+
+
+# ---------------------------------------------------------------------------
+# fs CLI (reference filesys_test.cc ls/cat/cp driver)
+# ---------------------------------------------------------------------------
+
+def test_fscli_ls_cat_cp_stat(tmp_path, capsys, s3_server):
+    from dmlc_core_tpu.io.fscli import main
+    src = tmp_path / "in.txt"
+    src.write_bytes(b"cli payload " * 100)
+
+    assert main(["stat", f"file://{src}"]) == 0
+    out = capsys.readouterr().out
+    assert f"file {src.stat().st_size}" in out
+
+    assert main(["ls", f"file://{tmp_path}"]) == 0
+    assert "in.txt" in capsys.readouterr().out
+
+    # cp local -> s3 (multipart machinery), then cat s3 back
+    assert main(["cp", f"file://{src}", "s3://bkt/out.txt"]) == 0
+    assert _FakeS3Handler.objects["bkt/out.txt"] == src.read_bytes()
+    assert main(["cat", "s3://bkt/out.txt"]) == 0
+
+    # bad URI → rc 1, no traceback
+    assert main(["stat", "file:///definitely/not/there"]) == 1
