@@ -52,6 +52,9 @@ def cmd_cat(uri_str: str) -> int:
         while True:
             chunk = src.read(_CHUNK)
             if not chunk:
+                # flush HERE so a closed pipe raises inside main's handler,
+                # not at interpreter-shutdown where it prints noise
+                sys.stdout.buffer.flush()
                 return 0
             sys.stdout.buffer.write(chunk)
 

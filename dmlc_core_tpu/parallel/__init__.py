@@ -5,10 +5,12 @@ from .mesh import (make_mesh, parse_mesh_spec, data_parallel_mesh,  # noqa: F401
                    process_mesh_info)
 from .collectives import (allreduce, broadcast, allgather,  # noqa: F401
                           reduce_scatter, MeshCollectives)
-from .tracker import RabitTracker, compute_tree, compute_ring  # noqa: F401
+from .tracker import (RabitTracker, PSTracker, compute_tree,  # noqa: F401
+                      compute_ring)
 from .rabit import RabitContext  # noqa: F401
 
 __all__ = [
+    "PSTracker",
     "make_mesh", "parse_mesh_spec", "data_parallel_mesh", "process_mesh_info",
     "allreduce", "broadcast", "allgather", "reduce_scatter", "MeshCollectives",
     "RabitTracker", "compute_tree", "compute_ring", "RabitContext",

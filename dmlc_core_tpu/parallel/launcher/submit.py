@@ -18,8 +18,11 @@ __all__ = ["main", "submit"]
 
 def submit(argv: Optional[List[str]] = None) -> int:
     args = get_opts(argv)
-    tracker = RabitTracker(num_workers=args.num_workers,
-                           host_ip=args.host_ip)
+    # a single-host job must rendezvous over loopback: the auto-detected
+    # "routable" address may not be reachable from inside sandboxes/netns
+    host_ip = args.host_ip or ("127.0.0.1" if args.cluster == "local"
+                               else None)
+    tracker = RabitTracker(num_workers=args.num_workers, host_ip=host_ip)
     tracker.start()
     envs = tracker.worker_envs()
 

@@ -1,0 +1,85 @@
+"""Distributed data-parallel logistic regression over the framework's OWN
+control plane: each worker ingests its partition and aggregates gradients
+with the tracker-brokered tree allreduce (`parallel.rabit`) — the same
+shape as a rabit job on the reference, no JAX multi-host required.
+
+Launch with the framework's launcher (any backend)::
+
+    python -m dmlc_core_tpu.parallel.launcher.submit --cluster local -n 4 \
+        -- python examples/distributed_logreg.py <uri>
+
+Every worker reads `DMLC_TASK_ID`/`DMLC_NUM_WORKER` from the env contract,
+ingests partition `(task_id, num_worker)` of the SAME uri (partition-correct
+byte math: the union of what the workers read is exactly the input), and
+allreduces dense gradients per batch, so all workers hold identical weights
+— verified at the end with an allreduced weight-digest.
+
+On a TPU pod you would instead shard batches over a `dp` mesh axis and let
+XLA psum the gradients (`docs/distributed.md`); this example exercises the
+socket data plane that serves host-side / heterogeneous jobs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from dmlc_core_tpu.data import create_parser
+from dmlc_core_tpu.parallel import RabitContext
+from dmlc_core_tpu.pipeline.packing import batch_slices
+from dmlc_core_tpu.utils import get_env, log_info
+
+
+def sigmoid(z: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-np.clip(z, -30, 30)))
+
+
+def main() -> None:
+    uri = sys.argv[1] if len(sys.argv) > 1 else "/tmp/dist_logreg.libsvm"
+    num_features = int(os.environ.get("NUM_FEATURES", "1024"))
+    lr = float(os.environ.get("LR", "0.1"))
+    epochs = int(os.environ.get("EPOCHS", "2"))
+
+    rank = get_env("DMLC_TASK_ID", 0)
+    world = get_env("DMLC_NUM_WORKER", 1)
+    ctx = RabitContext.from_env()
+    log_info("worker rank=%d/%d starts on partition %d/%d",
+             ctx.rank, ctx.world_size, rank, world)
+
+    # NOTE collective discipline: every worker must issue the SAME sequence
+    # of allreduces (partitions hold different batch counts, so a per-batch
+    # allreduce would desync) — accumulate locally, allreduce once per epoch
+    w = np.zeros(num_features, np.float64)
+    for epoch in range(epochs):
+        grad = np.zeros_like(w)
+        seen = 0
+        parser = create_parser(uri, rank, world, "libsvm")
+        for container in parser:
+            blk = container.get_block()
+            for rows in batch_slices(blk, 256):
+                for i in range(rows.size):
+                    label, idx, val = rows.row(i)
+                    x = val if val is not None else np.ones_like(
+                        idx, np.float32)
+                    p = sigmoid(float(np.dot(w[idx], x)))
+                    grad[idx] += (p - (1.0 if label > 0 else 0.0)) * x
+                seen += rows.size
+        parser.close()
+        # ONE tree allreduce per epoch over tracker-brokered links
+        stats = ctx.allreduce(np.concatenate([grad, [float(seen)]]))
+        total = max(1.0, stats[-1])
+        w -= lr * stats[:-1] / total
+
+    # every worker must hold byte-identical weights
+    digest = np.array([w.sum(), np.abs(w).sum()])
+    agreed = ctx.allreduce(digest) / ctx.world_size
+    assert np.allclose(agreed, digest), "weights diverged across workers"
+    log_info("rank %d done: |w|=%.6f (all workers agree)",
+             ctx.rank, float(np.abs(w).sum()))
+    ctx.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Integration tests running the shipped examples through the real
+launcher — the full stack in one shot: tracker rendezvous, partitioned
+ingest, tree allreduce, identical replicas."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_distributed_logreg_example(tmp_path):
+    data = tmp_path / "d.libsvm"
+    import random
+    rnd = random.Random(0)
+    with open(data, "w") as f:
+        for _ in range(1500):
+            y = rnd.randint(0, 1)
+            f.write(f"{y} {1 if y else 2}:1.0 {rnd.randint(3, 500)}:0.3\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
+         "--cluster", "local", "-n", "3",
+         "--env", f"PYTHONPATH={REPO}",
+         "--", sys.executable,
+         os.path.join(REPO, "examples", "distributed_logreg.py"), str(data)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO, "EPOCHS": "2"})
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert out.stderr.count("all workers agree") == 3
+    assert "all 3 processes exited cleanly" in out.stderr

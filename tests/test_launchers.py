@@ -185,6 +185,7 @@ def test_bootstrap_unpack_and_exec(tmp_path):
     import subprocess
     import sys
     import zipfile
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with zipfile.ZipFile(tmp_path / "bundle.zip", "w") as z:
         z.writestr("inner.txt", "shipped")
     out = subprocess.run(
@@ -193,8 +194,8 @@ def test_bootstrap_unpack_and_exec(tmp_path):
          "import os; print(os.environ['DMLC_ROLE'], "
          "open('bundle/inner.txt').read())"],
         cwd=tmp_path, capture_output=True, text=True,
-        env={**__import__('os').environ, "SLURM_PROCID": "0",
+        env={**os.environ, "SLURM_PROCID": "0",
              "DMLC_NUM_SERVER": "0", "DMLC_NUM_WORKER": "1",
-             "PYTHONPATH": "/root/repo"})
+             "PYTHONPATH": repo})
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "worker shipped"
