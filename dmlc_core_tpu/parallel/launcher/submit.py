@@ -26,6 +26,16 @@ def submit(argv: Optional[List[str]] = None) -> int:
     tracker.start()
     envs = tracker.worker_envs()
 
+    ps_tracker = None
+    if args.num_servers > 0:
+        # parameter-server mode: every process also gets the scheduler
+        # rendezvous env (reference starts PSTracker whenever nserver > 0,
+        # tracker.py:336-386)
+        from ..tracker import PSTracker
+        ps_tracker = PSTracker(host_ip=host_ip or tracker.host_ip)
+        envs.update(ps_tracker.worker_envs())
+        ps_tracker.start()
+
     if args.dry_run and args.cluster in ("local", "ssh", "tpu"):
         # direct-spawn backends have no scheduler command to preview:
         # show the resolved job spec and stop before launching anything
@@ -33,6 +43,8 @@ def submit(argv: Optional[List[str]] = None) -> int:
                  args.cluster, args.num_workers, args.num_servers,
                  envs, " ".join(args.command))
         tracker.stop()
+        if ps_tracker is not None:
+            ps_tracker.stop()
         return 0
 
     if args.cluster == "local":
@@ -63,6 +75,8 @@ def submit(argv: Optional[List[str]] = None) -> int:
         raise SystemExit(f"unknown cluster {args.cluster}")
 
     tracker.stop()
+    if ps_tracker is not None:
+        ps_tracker.stop()
     return rc
 
 

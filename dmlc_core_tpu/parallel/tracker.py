@@ -232,7 +232,11 @@ class RabitTracker:
                 # restarted worker: keep rank, refresh address
                 rec.host, rec.port = host, port
             if not self._assigned:
-                if len(self._workers) >= self.num_workers and not recovering:
+                # a `recover` can also be the registration that COMPLETES
+                # the cohort (a worker that crashed before first rendezvous
+                # and was restarted by the launcher retry loop) — assignment
+                # must trigger regardless of the command
+                if len(self._workers) >= self.num_workers:
                     self._assign_ranks()
                     self._lock.notify_all()
                 else:

@@ -28,3 +28,35 @@ def test_distributed_logreg_example(tmp_path):
     assert out.returncode == 0, out.stderr[-3000:]
     assert out.stderr.count("all workers agree") == 3
     assert "all 3 processes exited cleanly" in out.stderr
+
+
+def test_failure_injection_worker_crash_and_recover(tmp_path):
+    """Fault injection (SURVEY §5): one worker crashes on its first
+    attempt; the launcher retry loop restarts it with DMLC_NUM_ATTEMPT=1,
+    the cohort assembles with the reborn worker, and the job completes."""
+    script = tmp_path / "flaky_worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "import numpy as np\n"
+        "from dmlc_core_tpu.parallel import RabitContext\n"
+        "tid = os.environ['DMLC_TASK_ID']\n"
+        "att = int(os.environ.get('DMLC_NUM_ATTEMPT', '0'))\n"
+        "if tid == '1' and att == 0:\n"
+        "    print('INJECTED-CRASH', flush=True)\n"
+        "    sys.exit(1)\n"
+        "ctx = RabitContext.from_env()\n"
+        "out = ctx.allreduce(np.array([float(ctx.rank)]))\n"
+        "assert out[0] == sum(range(ctx.world_size))\n"
+        "print('SURVIVED rank', ctx.rank, 'attempt', att, flush=True)\n"
+        "ctx.shutdown()\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
+         "--cluster", "local", "-n", "3",
+         "--env", f"PYTHONPATH={REPO}",
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "INJECTED-CRASH" in out.stdout
+    assert out.stdout.count("SURVIVED") == 3
+    assert "attempt 1" in out.stdout          # the reborn worker

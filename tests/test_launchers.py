@@ -199,3 +199,20 @@ def test_bootstrap_unpack_and_exec(tmp_path):
              "PYTHONPATH": repo})
     assert out.returncode == 0, out.stderr
     assert out.stdout.strip() == "worker shipped"
+
+
+def test_ps_mode_exports_scheduler_env(tmp_path):
+    """-s N must hand every process the PS rendezvous env (reference
+    starts PSTracker whenever nserver > 0)."""
+    import sys
+    from dmlc_core_tpu.parallel.launcher.submit import submit
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os\n"
+        "assert os.environ['DMLC_PS_ROOT_URI']\n"
+        "assert int(os.environ['DMLC_PS_ROOT_PORT']) > 0\n")
+    rc = submit(["--cluster", "local", "-n", "2", "-s", "1",
+                 "--host-ip", "127.0.0.1",
+                 "--env", f"PYTHONPATH={os.path.dirname(os.path.dirname(os.path.abspath(__file__)))}",
+                 "--", sys.executable, str(probe)])
+    assert rc == 0
