@@ -1,0 +1,234 @@
+"""Extended benchmark suite covering BASELINE.json's config list — one JSON
+line per config (the root ``bench.py`` stays the driver's single headline
+number; this suite is for profiling the rest):
+
+* ``libsvm``    — sparse text → device batches (same as bench.py)
+* ``csv``       — dense HIGGS-style CSV → device batches
+* ``libfm``     — field-aware sparse (Criteo-style) → device batches
+* ``recordio``  — .rec streaming: write then partitioned read MB/s
+* ``allreduce`` — mesh psum bus-bandwidth (GB/s) over available devices
+* ``sharded``   — multi-partition libfm ingest (all parts on this host),
+                  the single-host stand-in for multi-chip sharded InputSplit
+
+Usage: ``python benchmarks/bench_suite.py [config ...]`` (default: all).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MB = 1 << 20
+TARGET_MB = int(os.environ.get("DMLC_BENCH_MB", "64"))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _gen_libsvm(path: str, libfm: bool = False) -> None:
+    import numpy as np
+    if os.path.exists(path) and os.path.getsize(path) >= TARGET_MB * MB * 0.9:
+        return
+    rng = np.random.default_rng(0)
+    with open(path, "wb") as f:
+        written = 0
+        while written < TARGET_MB * MB:
+            rows = []
+            for i in range(10000):
+                n = int(rng.integers(5, 40))
+                idx = np.sort(rng.choice(1_000_000, size=n, replace=False))
+                vals = rng.random(n)
+                if libfm:
+                    toks = b" ".join(b"%d:%d:%.4f" % (j % 40, j, v)
+                                     for j, v in zip(idx.tolist(),
+                                                     vals.tolist()))
+                else:
+                    toks = b" ".join(b"%d:%.4f" % (j, v)
+                                     for j, v in zip(idx.tolist(),
+                                                     vals.tolist()))
+                rows.append(b"%d " % (i & 1) + toks)
+            blob = b"\n".join(rows) + b"\n"
+            f.write(blob)
+            written += len(blob)
+
+
+def _gen_csv(path: str, ncol: int = 29) -> None:
+    import numpy as np
+    if os.path.exists(path) and os.path.getsize(path) >= TARGET_MB * MB * 0.9:
+        return
+    rng = np.random.default_rng(0)
+    with open(path, "wb") as f:
+        written = 0
+        while written < TARGET_MB * MB:
+            block = rng.random((5000, ncol)).astype(np.float32)
+            lines = [(b"%d," % (i & 1)) + b",".join(b"%.5f" % v for v in row)
+                     for i, row in enumerate(block)]
+            blob = b"\n".join(lines) + b"\n"
+            f.write(blob)
+            written += len(blob)
+
+
+def _ingest_rate(uri: str, fmt: str, parts: int = 1) -> float:
+    import jax
+    from dmlc_core_tpu.data import create_parser
+    from dmlc_core_tpu.pipeline import DeviceLoader
+    path = uri.split("://", 1)[-1].split("?")[0]
+    size_mb = os.path.getsize(path) / MB
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        last = None
+        for part in range(parts):
+            loader = DeviceLoader(
+                create_parser(uri, part, parts, fmt),
+                batch_rows=4096, nnz_cap=131072, prefetch=4)
+            for batch in loader:
+                last = batch
+            loader.close()
+        if last is not None:
+            jax.block_until_ready(last["vals"])
+        best = max(best, size_mb / (time.perf_counter() - t0))
+    return best
+
+
+def bench_libsvm() -> dict:
+    path = "/tmp/bench_suite.libsvm"
+    _gen_libsvm(path)
+    v = _ingest_rate(f"file://{path}", "libsvm")
+    return {"metric": "libsvm_ingest_to_device", "value": round(v, 1),
+            "unit": "MB/s"}
+
+
+def bench_libfm() -> dict:
+    path = "/tmp/bench_suite.libfm"
+    _gen_libsvm(path, libfm=True)
+    v = _ingest_rate(f"file://{path}", "libfm")
+    return {"metric": "libfm_ingest_to_device", "value": round(v, 1),
+            "unit": "MB/s"}
+
+
+def bench_sharded() -> dict:
+    """All 4 partitions ingested on this host — single-host stand-in for the
+    multi-chip sharded InputSplit config."""
+    path = "/tmp/bench_suite.libfm"
+    _gen_libsvm(path, libfm=True)
+    v = _ingest_rate(f"file://{path}", "libfm", parts=4)
+    return {"metric": "libfm_sharded4_ingest", "value": round(v, 1),
+            "unit": "MB/s"}
+
+
+def bench_csv() -> dict:
+    path = "/tmp/bench_suite.csv"
+    _gen_csv(path)
+    import jax
+    from dmlc_core_tpu.data import create_parser
+    size_mb = os.path.getsize(path) / MB
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p = create_parser(f"file://{path}?label_column=0", 0, 1, "csv")
+        for _blk in p:
+            pass
+        p.close()
+        best = max(best, size_mb / (time.perf_counter() - t0))
+    return {"metric": "csv_parse_rowblocks", "value": round(best, 1),
+            "unit": "MB/s"}
+
+
+def bench_recordio() -> dict:
+    """.rec streaming: write records, then partitioned read (reference
+    recordio_test.cc + split_read_test.cc instrumentation)."""
+    import numpy as np
+    from dmlc_core_tpu.io import RecordIOWriter, create_input_split
+    path = "/tmp/bench_suite.rec"
+    rng = np.random.default_rng(0)
+    if not (os.path.exists(path)
+            and os.path.getsize(path) >= TARGET_MB * MB * 0.9):
+        with open(path, "wb") as f:
+            w = RecordIOWriter(f)
+            written = 0
+            while written < TARGET_MB * MB:
+                rec = rng.integers(0, 256, size=int(rng.integers(
+                    1 << 10, 64 << 10)), dtype=np.uint8).tobytes()
+                w.write_record(rec)
+                written += len(rec)
+    size_mb = os.path.getsize(path) / MB
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        total = 0
+        for part in range(2):
+            sp = create_input_split(f"file://{path}", part, 2, "recordio",
+                                    threaded=True)
+            while True:
+                rec = sp.next_record()
+                if rec is None:
+                    break
+                total += len(rec)
+            sp.close()
+        best = max(best, (total / MB) / (time.perf_counter() - t0))
+    return {"metric": "recordio_partitioned_read", "value": round(best, 1),
+            "unit": "MB/s"}
+
+
+def bench_allreduce() -> dict:
+    """psum bus-bandwidth over all available devices (ICI on a pod; this
+    host's devices otherwise). Bus BW = 2*(n-1)/n * bytes / time."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("dp",))
+    elems = (64 * MB) // 4
+    x = jnp.ones((elems,), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None)))
+
+    @jax.jit
+    def psum_all(v):
+        return shard_map(lambda t: jax.lax.psum(t, "dp"), mesh=mesh,
+                         in_specs=P(None), out_specs=P(None),
+                         check_vma=False)(v)
+
+    psum_all(xs).block_until_ready()          # compile
+    best = 0.0
+    for _ in range(5):
+        t0 = time.perf_counter()
+        psum_all(xs).block_until_ready()
+        dt = time.perf_counter() - t0
+        bus = (2 * (n - 1) / max(n, 1)) * (elems * 4) / dt / (1 << 30)
+        best = max(best, bus)
+    return {"metric": "allreduce_bus_bw", "value": round(best, 2),
+            "unit": "GB/s", "devices": n}
+
+
+ALL = {
+    "libsvm": bench_libsvm,
+    "csv": bench_csv,
+    "libfm": bench_libfm,
+    "sharded": bench_sharded,
+    "recordio": bench_recordio,
+    "allreduce": bench_allreduce,
+}
+
+
+def main() -> None:
+    picks = sys.argv[1:] or list(ALL)
+    for name in picks:
+        log(f"running {name} ...")
+        try:
+            print(json.dumps(ALL[name]()), flush=True)
+        except Exception as e:  # noqa: BLE001 - report and continue
+            print(json.dumps({"metric": name, "error": str(e)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
