@@ -202,9 +202,14 @@ class RangedReadStream(io.RawIOBase):
             data = self._fetch(self._pos, self._pos + fill)
             if not data:
                 return 0
-            self._buf = data
-            self._buf_start = self._pos
-            off = 0
+            # a 200-fallback (server ignored Range) leaves the WHOLE object
+            # in self._buf — recompute the window instead of clobbering it,
+            # or each refill would re-download the full object
+            off = self._pos - self._buf_start
+            if not (0 <= off < len(self._buf)):
+                self._buf = data
+                self._buf_start = self._pos
+                off = 0
         n = min(want, len(self._buf) - off)
         b[:n] = self._buf[off:off + n]
         self._pos += n

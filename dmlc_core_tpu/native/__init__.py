@@ -48,8 +48,14 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            return None
+        from .build import build_native, is_fresh
+        if not is_fresh():
+            # build-on-first-use: the .so is never committed (VERDICT r1 #8)
+            # and a source edit invalidates it via the recorded source hash
+            if not build_native() and not os.path.exists(_LIB_PATH):
+                # no compiler AND no previous artifact → python fallback;
+                # a stale-but-loadable .so is still better than none
+                return None
         lib = ctypes.CDLL(_LIB_PATH)
         for name in ("dmlc_parse_libsvm", "dmlc_parse_libfm"):
             fn = getattr(lib, name)

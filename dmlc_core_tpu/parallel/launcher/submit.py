@@ -28,17 +28,26 @@ def submit(argv: Optional[List[str]] = None) -> int:
 
     ps_tracker = None
     if args.num_servers > 0:
-        # parameter-server mode: every process also gets the scheduler
-        # rendezvous env (reference starts PSTracker whenever nserver > 0,
-        # tracker.py:336-386)
+        # parameter-server mode: launch the user command locally as the
+        # SCHEDULER (DMLC_ROLE=scheduler) and hand every process the same
+        # rendezvous env — the reference passes the job command as pscmd
+        # whenever nserver > 0 (reference local.py:72, tracker.py:410-425);
+        # without a scheduler the PS root port has no listener and
+        # server/worker rendezvous hangs
         from ..tracker import PSTracker
-        ps_tracker = PSTracker(host_ip=host_ip or tracker.host_ip)
+        ps_tracker = PSTracker(host_ip=host_ip or tracker.host_ip,
+                               pscmd=list(args.command),
+                               extra_env={
+                                   "DMLC_NUM_WORKER": str(args.num_workers),
+                                   "DMLC_NUM_SERVER": str(args.num_servers),
+                                   **args.extra_env,
+                               })
         envs.update(ps_tracker.worker_envs())
-        ps_tracker.start()
 
     if args.dry_run and args.cluster in ("local", "ssh", "tpu"):
         # direct-spawn backends have no scheduler command to preview:
         # show the resolved job spec and stop before launching anything
+        # (incl. the PS scheduler — ps_tracker.start() runs user code)
         log_info("%s (dry run): %d workers + %d servers, env %s, cmd: %s",
                  args.cluster, args.num_workers, args.num_servers,
                  envs, " ".join(args.command))
@@ -46,6 +55,9 @@ def submit(argv: Optional[List[str]] = None) -> int:
         if ps_tracker is not None:
             ps_tracker.stop()
         return 0
+
+    if ps_tracker is not None:
+        ps_tracker.start()
 
     if args.cluster == "local":
         from . import local as backend

@@ -13,8 +13,17 @@ processes — exactly rabit's API surface::
 
 Topology comes from the tracker (binary tree + recovery ring); reductions run
 leaf→root then broadcast root→leaf over persistent worker⇄worker sockets.
-A worker that restarts re-registers with ``cmd=recover`` and resumes with the
-same rank (reference `tracker.py:279-291`).
+
+Elastic recovery (reference `tracker.py:80-135,279-291`): a restarted worker
+re-registers with ``cmd=recover`` and resumes with the same rank; the tracker
+bumps a **link generation** and pushes a ``reset_links`` control message to
+every survivor's peer listener.  On reset each worker drops all peer sockets
+(fresh sockets ⇒ no stale half-blobs), and the collective in flight aborts
+with a socket error and retries after links are rebuilt at the new
+generation.  Each blob is framed with the collective's sequence number so a
+cohort that diverged mid-collective (some workers already completed the op —
+the case the reference hands to downstream rabit's checkpoint ring) fails
+loudly instead of silently mixing results.
 """
 
 from __future__ import annotations
@@ -25,11 +34,11 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils import DMLCError, check, get_env, log_info
+from ..utils import DMLCError, check, get_env, log_info, log_warning
 from .tracker import recv_json, send_json
 
 __all__ = ["RabitContext"]
@@ -41,21 +50,32 @@ _OPS: Dict[str, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
     "prod": np.multiply,
 }
 
-
-def _send_blob(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+_CTRL_RANK = -2  # listener handshake sentinel: tracker control message
 
 
-def _recv_blob(sock: socket.socket) -> bytes:
-    head = _recv_exact(sock, 8)
-    (n,) = struct.unpack("<Q", head)
-    return _recv_exact(sock, n)
+def _send_blob(sock: socket.socket, payload: bytes, seq: int) -> None:
+    sock.sendall(struct.pack("<qQ", seq, len(payload)) + payload)
+
+
+def _recv_blob(sock: socket.socket, seq: int) -> bytes:
+    head = _recv_exact(sock, 16)
+    got_seq, n = struct.unpack("<qQ", head)
+    payload = _recv_exact(sock, n)
+    if got_seq != seq:
+        raise DMLCError(
+            f"rabit: collective out of sync (expected op #{seq}, peer sent "
+            f"#{got_seq}) — the cohort diverged across a mid-collective "
+            f"restart; resume from a checkpoint instead")
+    return payload
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     out = bytearray()
     while len(out) < n:
-        chunk = sock.recv(n - len(out))
+        try:
+            chunk = sock.recv(n - len(out))
+        except OSError as e:
+            raise DMLCError(f"rabit: peer link lost ({e})") from e
         if not chunk:
             raise DMLCError("rabit: peer closed connection")
         out += chunk
@@ -67,10 +87,12 @@ class RabitContext:
 
     def __init__(self, tracker_uri: str, tracker_port: int,
                  jobid: Optional[str] = None, recover: bool = False,
-                 connect_timeout: float = 60.0, connect_links: bool = True):
+                 connect_timeout: float = 60.0, connect_links: bool = True,
+                 recover_timeout: float = 120.0):
         self.tracker_addr = (tracker_uri, tracker_port)
         self.jobid = jobid or f"job-{os.getpid()}-{socket.gethostname()}"
         self.connect_timeout = connect_timeout
+        self.recover_timeout = recover_timeout
         # listener for peer links
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -78,7 +100,11 @@ class RabitContext:
         self._listener.listen(16)
         self._listen_port = self._listener.getsockname()[1]
         self._peer_socks: Dict[int, socket.socket] = {}
+        self._sock_gen: Dict[int, int] = {}
         self._peer_lock = threading.Lock()
+        self._reset_event = threading.Event()
+        self._target_gen = 0
+        self._seq = 0  # collective sequence number (frame guard)
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accepting = True
@@ -117,6 +143,8 @@ class RabitContext:
         self.children: List[int] = reply["children"]
         self.ring_prev: int = reply["ring_prev"]
         self.ring_next: int = reply["ring_next"]
+        self.generation: int = reply.get("generation", 0)
+        self._target_gen = self.generation
         self._addresses = {int(k): tuple(v)
                            for k, v in reply["addresses"].items()}
 
@@ -130,8 +158,24 @@ class RabitContext:
             try:
                 head = _recv_exact(conn, 8)
                 (peer_rank,) = struct.unpack("<q", head)
+                if peer_rank == _CTRL_RANK:
+                    self._handle_ctrl(conn)
+                    continue
+                (gen,) = struct.unpack("<q", _recv_exact(conn, 8))
                 with self._peer_lock:
+                    old = self._peer_socks.get(peer_rank)
+                    if old is not None:
+                        if self._sock_gen.get(peer_rank, -1) > gen:
+                            # a stale dial arriving after a newer link was
+                            # already established: reject it
+                            conn.close()
+                            continue
+                        try:
+                            old.close()
+                        except OSError:
+                            pass
                     self._peer_socks[peer_rank] = conn
+                    self._sock_gen[peer_rank] = gen
             except (DMLCError, OSError, struct.error):
                 # a bad handshake (reset, scanner, garbage) must never kill
                 # the accept thread — later peers still need to register
@@ -140,21 +184,69 @@ class RabitContext:
                 except OSError:
                     pass
 
+    def _handle_ctrl(self, conn: socket.socket) -> None:
+        """Tracker control message after the -2 handshake: one JSON line."""
+        try:
+            msg = recv_json(conn.makefile("r"))
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if not msg or msg.get("cmd") != "reset_links":
+            return
+        gen = int(msg["generation"])
+        addrs = {int(k): tuple(v) for k, v in msg.get("addresses", {}).items()}
+        with self._peer_lock:
+            if gen <= self._target_gen:
+                return
+            self._target_gen = gen
+            # refresh neighbor addresses (restarted peers moved ports)
+            for r in list(self._addresses):
+                if r in addrs:
+                    self._addresses[r] = addrs[r]
+            # drop every pre-reset socket — shutdown(SHUT_RDWR) first, which
+            # (unlike close alone) interrupts a recv blocked in another
+            # thread with EOF/error; guarantees no stale half-blob survives
+            # into the repaired topology
+            for r, s in list(self._peer_socks.items()):
+                if self._sock_gen.get(r, -1) < gen:
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                    del self._peer_socks[r]
+                    self._sock_gen.pop(r, None)
+        log_warning("rabit rank %d: link reset to generation %d", self.rank, gen)
+        self._reset_event.set()
+
     def _connect_links(self) -> None:
         """Dial peers with rank < ours; accept from ranks > ours (a
         deterministic direction avoids double links)."""
         deadline = time.monotonic() + self.connect_timeout
+        gen = self.generation
         needed = set(self._addresses)
         for peer in sorted(needed):
             if peer < self.rank:
-                sock = self._dial(peer, deadline)
                 with self._peer_lock:
-                    self._peer_socks[peer] = sock
+                    have = (peer in self._peer_socks
+                            and self._sock_gen.get(peer, -1) >= gen)
+                if not have:
+                    sock = self._dial(peer, deadline, gen)
+                    with self._peer_lock:
+                        self._peer_socks[peer] = sock
+                        self._sock_gen[peer] = gen
         # wait for inbound from higher ranks
         higher = {p for p in needed if p > self.rank}
         while True:
             with self._peer_lock:
-                missing = higher - set(self._peer_socks)
+                missing = {p for p in higher
+                           if p not in self._peer_socks
+                           or self._sock_gen.get(p, -1) < gen}
             if not missing:
                 break
             if time.monotonic() > deadline:
@@ -162,13 +254,13 @@ class RabitContext:
                                 f"never connected")
             time.sleep(0.01)
 
-    def _dial(self, peer: int, deadline: float) -> socket.socket:
+    def _dial(self, peer: int, deadline: float, gen: int) -> socket.socket:
         host, port = self._addresses[peer]
         last_err: Optional[Exception] = None
         while time.monotonic() < deadline:
             try:
                 sock = socket.create_connection((host, port), timeout=5.0)
-                sock.sendall(struct.pack("<q", self.rank))
+                sock.sendall(struct.pack("<qq", self.rank, gen))
                 return sock
             except OSError as e:
                 last_err = e
@@ -183,27 +275,80 @@ class RabitContext:
             raise DMLCError(f"rabit rank {self.rank}: no link to {peer}")
         return sock
 
+    def _ensure_links(self) -> None:
+        """Repair links when a tracker reset moved the target generation.
+        Loops: a newer reset arriving during a repair triggers another
+        round (the event is cleared BEFORE the target is read, so a
+        concurrent notification is never lost)."""
+        while True:
+            self._reset_event.clear()
+            with self._peer_lock:
+                target = self._target_gen
+            if target <= self.generation:
+                return
+            self.generation = target
+            self._connect_links()
+            log_info("rabit rank %d: links repaired at generation %d",
+                     self.rank, target)
+
+    def _with_recovery(self, fn):
+        """Run a collective; on link failure wait for the tracker's reset,
+        repair links, and retry from local inputs.  Safe because a reset
+        closes *every* worker's sockets: an aborted attempt leaves no bytes
+        behind, and no worker can have completed the op (the crashed rank's
+        contribution is required globally), so all workers re-enter the same
+        op — guarded by the frame sequence number."""
+        deadline = time.monotonic() + self.recover_timeout
+        while True:
+            try:
+                self._ensure_links()
+                return fn()
+            except (DMLCError, OSError) as e:
+                if "out of sync" in str(e):
+                    raise
+                if time.monotonic() > deadline:
+                    raise
+                log_warning("rabit rank %d: collective aborted (%s); awaiting "
+                            "link repair", self.rank, e)
+                # wait for the tracker's reset notification (the restarted
+                # worker must come back up and re-register first); poll the
+                # target generation too in case the event was consumed by a
+                # concurrent repair round
+                while time.monotonic() < deadline:
+                    if self._reset_event.wait(timeout=1.0):
+                        break
+                    with self._peer_lock:
+                        if self._target_gen > self.generation:
+                            break
+
     # -- collectives (binary tree: reduce up, broadcast down) --
     def allreduce(self, x: np.ndarray, op: str = "sum") -> np.ndarray:
         fn = _OPS.get(op)
         if fn is None:
             raise DMLCError(f"unknown op {op!r}; have {list(_OPS)}")
-        acc = np.array(x, copy=True)
-        for child in self.children:
-            contrib = np.frombuffer(_recv_blob(self._sock_to(child)),
+        seq = self._seq
+
+        def attempt() -> np.ndarray:
+            acc = np.array(x, copy=True)
+            for child in self.children:
+                contrib = np.frombuffer(_recv_blob(self._sock_to(child), seq),
+                                        dtype=acc.dtype).reshape(acc.shape)
+                acc = fn(acc, contrib)
+            if self.parent >= 0:
+                _send_blob(self._sock_to(self.parent), acc.tobytes(), seq)
+                acc = np.frombuffer(_recv_blob(self._sock_to(self.parent), seq),
                                     dtype=acc.dtype).reshape(acc.shape)
-            acc = fn(acc, contrib)
-        if self.parent >= 0:
-            _send_blob(self._sock_to(self.parent), acc.tobytes())
-            acc = np.frombuffer(_recv_blob(self._sock_to(self.parent)),
-                                dtype=acc.dtype).reshape(acc.shape)
-        for child in self.children:
-            _send_blob(self._sock_to(child), acc.tobytes())
-        if not acc.flags.writeable:
-            # frombuffer views are read-only; callers mutate results in place
-            # (the reference rabit Allreduce is in-place by contract)
-            acc = acc.copy()
-        return acc
+            for child in self.children:
+                _send_blob(self._sock_to(child), acc.tobytes(), seq)
+            if not acc.flags.writeable:
+                # frombuffer views are read-only; callers mutate results in
+                # place (the reference rabit Allreduce is in-place by contract)
+                acc = acc.copy()
+            return acc
+
+        out = self._with_recovery(attempt)
+        self._seq = seq + 1
+        return out
 
     def broadcast(self, obj: Any, root: int = 0) -> Any:
         """Tree broadcast of an arbitrary picklable object from ``root``.
@@ -213,16 +358,23 @@ class RabitContext:
         routing and every queued blob is always consumed."""
         if self.world_size == 1:
             return obj
-        payload = pickle.dumps(obj) if self.rank == root else b""
-        for child in self.children:
-            contrib = _recv_blob(self._sock_to(child))
-            if contrib and not payload:
-                payload = contrib
-        if self.parent >= 0:
-            _send_blob(self._sock_to(self.parent), payload)
-            payload = _recv_blob(self._sock_to(self.parent))
-        for child in self.children:
-            _send_blob(self._sock_to(child), payload)
+        seq = self._seq
+
+        def attempt() -> bytes:
+            payload = pickle.dumps(obj) if self.rank == root else b""
+            for child in self.children:
+                contrib = _recv_blob(self._sock_to(child), seq)
+                if contrib and not payload:
+                    payload = contrib
+            if self.parent >= 0:
+                _send_blob(self._sock_to(self.parent), payload, seq)
+                payload = _recv_blob(self._sock_to(self.parent), seq)
+            for child in self.children:
+                _send_blob(self._sock_to(child), payload, seq)
+            return payload
+
+        payload = self._with_recovery(attempt)
+        self._seq = seq + 1
         if not payload:
             raise DMLCError(f"broadcast: no payload reached rank {self.rank}")
         return pickle.loads(payload)
@@ -234,12 +386,57 @@ class RabitContext:
         stack[self.rank] = x
         return self.allreduce(stack, "sum")
 
+    # -- checkpoint API (rabit CheckPoint/LoadCheckPoint/VersionNumber) --
+    def _ckpt_path(self) -> str:
+        import tempfile
+        d = os.environ.get("DMLC_CHECKPOINT_DIR", tempfile.gettempdir())
+        # key by tracker address as well as jobid: tracker ports are
+        # ephemeral per job, so a later job with the same task ids cannot
+        # resurrect a stale checkpoint from a previous run
+        tag = f"{self.tracker_addr[0]}_{self.tracker_addr[1]}".replace(
+            os.sep, "_")
+        return os.path.join(d, f"rabit_ckpt_{tag}_{self.jobid}.pkl")
+
+    def checkpoint(self, state: Any) -> None:
+        """Persist app state + the collective sequence number, so a restarted
+        worker resumes in lock-step with survivors (rabit's ``CheckPoint``;
+        state recovery itself is local-disk here — the reference's
+        peer-to-peer ring recovery is downstream rabit, SURVEY §5)."""
+        payload = pickle.dumps({"seq": self._seq, "state": state,
+                                "version": getattr(self, "_version", 0) + 1})
+        self._version = getattr(self, "_version", 0) + 1
+        tmp = self._ckpt_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, self._ckpt_path())
+
+    def load_checkpoint(self) -> Optional[Any]:
+        """Restore state saved by :meth:`checkpoint`; fast-forwards the
+        collective sequence counter (rabit's ``LoadCheckPoint``).  Returns
+        None when no checkpoint exists (fresh start)."""
+        try:
+            with open(self._ckpt_path(), "rb") as f:
+                saved = pickle.loads(f.read())
+        except (OSError, pickle.UnpicklingError):
+            return None
+        self._seq = saved["seq"]
+        self._version = saved.get("version", 0)
+        return saved["state"]
+
+    @property
+    def version_number(self) -> int:
+        return getattr(self, "_version", 0)
+
     # -- misc rabit API --
     def tracker_print(self, msg: str) -> None:
         self._tracker_cmd({"cmd": "print", "msg": msg})
 
     def shutdown(self) -> None:
         self._tracker_cmd({"cmd": "shutdown", "jobid": self.jobid})
+        try:  # clean exit: the recovery checkpoint is no longer needed
+            os.unlink(self._ckpt_path())
+        except OSError:
+            pass
         self._accepting = False
         try:
             self._listener.close()
@@ -252,6 +449,7 @@ class RabitContext:
                 except OSError:
                     pass
             self._peer_socks.clear()
+            self._sock_gen.clear()
 
     def _tracker_cmd(self, obj: dict) -> None:
         sock = socket.create_connection(self.tracker_addr, timeout=10.0)
@@ -263,5 +461,3 @@ class RabitContext:
 
     def __exit__(self, *exc) -> None:
         self.shutdown()
-
-

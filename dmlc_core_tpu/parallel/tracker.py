@@ -112,16 +112,21 @@ class RabitTracker:
     """
 
     def __init__(self, num_workers: int, host_ip: Optional[str] = None,
-                 port: int = 9091, max_port: int = 9999):
+                 port: int = 0, max_port: int = 9999):
         self.num_workers = num_workers
         self.host_ip = host_ip or _default_host_ip()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         bound = False
-        for p in range(port, max_port + 1):  # port scan (reference :141-153)
+        # port=0 (default) = OS-assigned ephemeral port: concurrent trackers
+        # can never collide (the DMLC_TRACKER_PORT env carries the real port
+        # to workers).  An explicit port keeps the reference's scan behavior
+        # (`tracker.py:141-153`) for fixed-port deployments.
+        candidates = [0] if port == 0 else range(port, max_port + 1)
+        for p in candidates:
             try:
                 self._sock.bind((self.host_ip, p))
-                self.port = p
+                self.port = self._sock.getsockname()[1]
                 bound = True
                 break
             except OSError:
@@ -133,6 +138,7 @@ class RabitTracker:
         self._workers: Dict[str, _WorkerRecord] = {}  # jobid → record
         self._rank_of: Dict[str, int] = {}
         self._assigned = False
+        self._generation = 0  # bumped on every post-assignment recover
         self._shutdown_count = 0
         self._start_time: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
@@ -221,6 +227,7 @@ class RabitTracker:
         jobid = str(msg.get("jobid", ""))
         host = msg.get("host") or conn.getpeername()[0]
         port = int(msg["port"])
+        notify: List[Tuple[str, int]] = []
         with self._lock:
             if self._start_time is None:
                 self._start_time = time.monotonic()
@@ -231,6 +238,15 @@ class RabitTracker:
             else:
                 # restarted worker: keep rank, refresh address
                 rec.host, rec.port = host, port
+                if self._assigned and rec.rank >= 0:
+                    # MID-JOB restart: surviving peers hold sockets to the
+                    # dead incarnation — bump the link generation and push a
+                    # reset to every survivor so they drop stale links and
+                    # re-rendezvous (reference wait_conn re-linking,
+                    # `tracker.py:80-135,279-291`)
+                    self._generation += 1
+                    notify = [(w.host, w.port) for w in self._workers.values()
+                              if w.jobid != jobid and w.rank >= 0]
             if not self._assigned:
                 # a `recover` can also be the registration that COMPLETES
                 # the cohort (a worker that crashed before first rendezvous
@@ -251,7 +267,34 @@ class RabitTracker:
                                   f"assigned; job {jobid!r} is not a member"}
             else:
                 reply = self._build_assignment(rec)
+            if notify:
+                reset = {"cmd": "reset_links",
+                         "generation": self._generation,
+                         "addresses": {str(w.rank): [w.host, w.port]
+                                       for w in self._workers.values()
+                                       if w.rank >= 0}}
+        for host_port in notify:
+            self._notify_reset(host_port, reset)
         send_json(conn, reply)
+
+    def _notify_reset(self, addr: Tuple[str, int], reset: dict) -> None:
+        """Push a link-reset control message to a survivor's peer listener
+        (sentinel rank -2 handshake, then one JSON line).  Retried — a
+        dropped notify would strand that survivor waiting for a reset that
+        never comes."""
+        import struct
+        last: Optional[Exception] = None
+        for attempt in range(3):
+            try:
+                with socket.create_connection(addr, timeout=10.0) as s:
+                    s.sendall(struct.pack("<q", -2))
+                    send_json(s, reset)
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.5 * (attempt + 1))
+        logger.warning("tracker: reset notify to %s failed after retries: %s",
+                       addr, last)
 
     def _assign_ranks(self) -> None:
         # sort by host then jobid for locality (reference :294-311)
@@ -287,6 +330,7 @@ class RabitTracker:
             "tree_neighbors": tree[rec.rank],
             "ring_prev": ring_prev,
             "ring_next": ring_next,
+            "generation": self._generation,
             "addresses": {str(r): list(self._addr_of(r))
                           for r in set(tree[rec.rank] + [ring_prev, ring_next])
                           if r != rec.rank},
@@ -307,19 +351,22 @@ class PSTracker:
     """
 
     def __init__(self, host_ip: Optional[str] = None, port: int = 9100,
-                 max_port: int = 9999, pscmd: Optional[List[str]] = None):
+                 max_port: int = 9999, pscmd: Optional[List[str]] = None,
+                 extra_env: Optional[Dict[str, str]] = None):
         self.host_ip = host_ip or _default_host_ip()
         # reserve a free port and HOLD the socket (a bind-then-close probe
         # races: two trackers scanning concurrently would both pick the
-        # same port); released right before the scheduler spawns
+        # same port); released right before the scheduler spawns.
+        # port=0 asks the OS for an ephemeral port (no scan, no collisions).
         self.port = None
         self._reserve: Optional[socket.socket] = None
-        for p in range(port, max_port + 1):
+        candidates = [0] if port == 0 else range(port, max_port + 1)
+        for p in candidates:
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             try:
                 s.bind((self.host_ip, p))
-                self.port = p
+                self.port = s.getsockname()[1]
                 self._reserve = s
                 break
             except OSError:
@@ -327,6 +374,7 @@ class PSTracker:
         if self.port is None:
             raise DMLCError(f"pstracker: no free port in [{port}, {max_port}]")
         self.pscmd = pscmd
+        self.extra_env = dict(extra_env or {})
         self._proc = None
 
     def worker_envs(self) -> Dict[str, str]:
@@ -342,6 +390,7 @@ class PSTracker:
         import subprocess
         env = dict(os.environ)
         env.update(self.worker_envs())
+        env.update(self.extra_env)
         env["DMLC_ROLE"] = "scheduler"
         if self._reserve is not None:
             # hand the port to the scheduler (it binds it itself, as

@@ -282,15 +282,20 @@ class CheckpointManager:
         m["latest"] = max(s for s in m["steps"])
         if meta:
             m["meta"][str(step)] = meta
-        # prune before the single manifest write
+        # publish the updated manifest FIRST, then unlink pruned files — a
+        # crash between the two leaves orphan files (harmless, re-pruned
+        # later) rather than a manifest listing steps whose files are gone
+        dropped = []
         while len(m["steps"]) > self.max_to_keep:
             drop = m["steps"].pop(0)
             m["meta"].pop(str(drop), None)
+            dropped.append(drop)
+        self._write_manifest(m)
+        for drop in dropped:
             try:
                 os.unlink(self._path(drop))
             except OSError:
                 pass
-        self._write_manifest(m)
         log_info("checkpoint: saved step %d -> %s", step, self._path(step))
         return self._path(step)
 
@@ -306,7 +311,15 @@ class CheckpointManager:
             raise DMLCError(f"no checkpoints in {self.dir}")
         check(step in m["steps"], f"no checkpoint for step {step}; "
                                   f"have {m['steps']}")
-        with open(self._path(step), "rb") as f:
+        try:
+            f = open(self._path(step), "rb")
+        except FileNotFoundError as e:
+            raise DMLCError(
+                f"checkpoint file for step {step} is missing "
+                f"({self._path(step)}) — manifest and directory disagree "
+                f"(interrupted prune?); pick another step from {m['steps']}"
+            ) from e
+        with f:
             return step, load_pytree(f, template=template)
 
     def meta(self, step: int) -> Dict[str, Any]:

@@ -60,3 +60,47 @@ def test_failure_injection_worker_crash_and_recover(tmp_path):
     assert "INJECTED-CRASH" in out.stdout
     assert out.stdout.count("SURVIVED") == 3
     assert "attempt 1" in out.stdout          # the reborn worker
+
+
+def test_failure_injection_midjob_crash_and_second_allreduce(tmp_path):
+    """Mid-job elastic recovery (VERDICT r1 #3): a worker crashes AFTER a
+    successful allreduce.  Survivors hold sockets to the dead incarnation;
+    the tracker's reset_links push makes them drop stale links, re-link with
+    the reborn worker (which fast-forwards via the rabit checkpoint), and the
+    cohort completes a SECOND allreduce (reference link re-brokering,
+    `tracker/dmlc_tracker/tracker.py:80-135,279-291`)."""
+    script = tmp_path / "midjob_worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "import numpy as np\n"
+        "from dmlc_core_tpu.parallel import RabitContext\n"
+        "tid = os.environ['DMLC_TASK_ID']\n"
+        "att = int(os.environ.get('DMLC_NUM_ATTEMPT', '0'))\n"
+        "ctx = RabitContext.from_env()\n"
+        "state = ctx.load_checkpoint() if att > 0 else None\n"
+        "if state is None:\n"
+        "    out1 = ctx.allreduce(np.array([float(ctx.rank + 1)]))\n"
+        "    assert out1[0] == sum(range(1, ctx.world_size + 1)), out1\n"
+        "    ctx.checkpoint({'out1': float(out1[0])})\n"
+        "    if tid == '1' and att == 0:\n"
+        "        print('MIDJOB-CRASH', flush=True)\n"
+        "        os._exit(1)\n"
+        "else:\n"
+        "    out1 = np.array([state['out1']])\n"
+        "out2 = ctx.allreduce(np.array([out1[0] * (ctx.rank + 1)]))\n"
+        "expected = out1[0] * sum(r + 1 for r in range(ctx.world_size))\n"
+        "assert out2[0] == expected, (out2, expected)\n"
+        "print('SECOND-OK rank', ctx.rank, 'attempt', att, flush=True)\n"
+        "ctx.shutdown()\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
+         "--cluster", "local", "-n", "3",
+         "--env", f"PYTHONPATH={REPO}",
+         "--env", f"DMLC_CHECKPOINT_DIR={tmp_path}",
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-3000:])
+    assert "MIDJOB-CRASH" in out.stdout
+    assert out.stdout.count("SECOND-OK") == 3
+    assert "attempt 1" in out.stdout          # the reborn worker finished

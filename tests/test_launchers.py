@@ -216,3 +216,47 @@ def test_ps_mode_exports_scheduler_env(tmp_path):
                  "--env", f"PYTHONPATH={os.path.dirname(os.path.dirname(os.path.abspath(__file__)))}",
                  "--", sys.executable, str(probe)])
     assert rc == 0
+
+
+def test_ps_mode_end_to_end_rendezvous(tmp_path):
+    """-s N launches the user command as the SCHEDULER (DMLC_ROLE=scheduler,
+    ADVICE r1): server+worker connect to DMLC_PS_ROOT_URI/PORT and the
+    scheduler actually listens there (reference local.py:72 passes the job
+    command as pscmd; tracker.py:410-425 spawns it)."""
+    import sys
+    from dmlc_core_tpu.parallel.launcher.submit import submit
+    prog = tmp_path / "ps_prog.py"
+    marker = tmp_path / "sched_done.txt"
+    prog.write_text(
+        "import os, socket, time, sys\n"
+        "role = os.environ['DMLC_ROLE']\n"
+        "uri = os.environ['DMLC_PS_ROOT_URI']\n"
+        "port = int(os.environ['DMLC_PS_ROOT_PORT'])\n"
+        "if role == 'scheduler':\n"
+        "    s = socket.socket()\n"
+        "    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+        "    s.bind((uri, port)); s.listen(8)\n"
+        "    n = int(os.environ['DMLC_NUM_WORKER']) + int(os.environ['DMLC_NUM_SERVER'])\n"
+        "    for _ in range(n):\n"
+        "        c, _ = s.accept(); c.sendall(b'ok'); c.close()\n"
+        f"    open({str(marker)!r}, 'w').write('done')\n"
+        "else:\n"
+        "    deadline = time.time() + 30\n"
+        "    while True:\n"
+        "        try:\n"
+        "            c = socket.create_connection((uri, port), timeout=5)\n"
+        "            break\n"
+        "        except OSError:\n"
+        "            if time.time() > deadline: raise\n"
+        "            time.sleep(0.2)\n"
+        "    assert c.recv(2) == b'ok'\n")
+    rc = submit(["--cluster", "local", "-n", "1", "-s", "1",
+                 "--host-ip", "127.0.0.1",
+                 "--env", f"PYTHONPATH={os.path.dirname(os.path.dirname(os.path.abspath(__file__)))}",
+                 "--", sys.executable, str(prog)])
+    assert rc == 0
+    # scheduler saw both role processes connect before workers exited
+    deadline = __import__('time').time() + 10
+    while not marker.exists() and __import__('time').time() < deadline:
+        __import__('time').sleep(0.1)
+    assert marker.exists()
