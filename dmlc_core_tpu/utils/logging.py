@@ -37,6 +37,7 @@ __all__ = [
     "log_fatal",
     "set_log_sink",
     "get_logger",
+    "IdOverflowError",
 ]
 
 
@@ -46,6 +47,13 @@ class DMLCError(RuntimeError):
 
 class ParamError(DMLCError, ValueError):
     """Raised when parameter initialization fails (reference `parameter.h:62`)."""
+
+
+class IdOverflowError(DMLCError, ValueError):
+    """A feature id exceeds int32 range on the device path and no feature
+    hashing (``id_mod``) is configured.  The reference keeps uint64 ids
+    first-class (`src/data.cc:131-147`); the TPU batch layout is int32, so
+    wide ids must be hashed or the layout widened — never silently wrapped."""
 
 
 _logger = _pylogging.getLogger("dmlc_core_tpu")

@@ -164,3 +164,23 @@ def test_bad_lines_counted():
         d2 = native.parse_libsvm(b"1 3:1\nnot_a_label x\n0 5:2\n")
         assert d2["bad_lines"] >= 1
         np.testing.assert_array_equal(d2["labels"], [1, 0])
+
+
+def test_native_float_leading_zeros_and_line_endings():
+    """Regression: integer-mantissa float parse must not count leading zeros
+    as significant digits, and lone-CR / CRLF line endings must split
+    records exactly like the pure-python kernels."""
+    import numpy as np
+    from dmlc_core_tpu import native
+    if not native.available():
+        import pytest
+        pytest.skip("native lib unavailable")
+    d = native.parse_libsvm(
+        b"1 1:0.0000000000000000001 2:00000000000000000012 3:0.0005 4:007\n", 1)
+    for got, want in zip(d["values"], [1e-19, 12.0, 0.0005, 7.0]):
+        assert abs(got - np.float32(want)) <= abs(np.float32(want)) * 1e-6
+    d = native.parse_libsvm(b"1 1:2\r0 2:3\r", 1)
+    assert list(d["labels"]) == [1.0, 0.0] and list(d["indices"]) == [1, 2]
+    d = native.parse_csv(b"1,2.5,3\r\n0,1.5,4\r\n", 0, ",", 1)
+    assert list(d["labels"]) == [1.0, 0.0]
+    assert list(d["values"]) == [2.5, 3.0, 1.5, 4.0]

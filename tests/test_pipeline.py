@@ -130,3 +130,93 @@ def test_fused_h2d_matches_per_array(tmp_path):
     for k, v in host.items():
         np.testing.assert_array_equal(np.asarray(fused[k]), v, err_msg=k)
         assert fused[k].dtype == v.dtype, k
+
+
+def test_ids_overflow_raises_and_id_mod_hashes():
+    """VERDICT r1 #5: ids past int32 must raise, not wrap; id_mod gives the
+    documented feature-hashing remap (reference keeps uint64 ids first-class,
+    src/data.cc:131-147)."""
+    from dmlc_core_tpu.utils import IdOverflowError
+    big = np.uint64(2**33 + 5)
+    blk = block_of([(1.0, np.array([1, big], np.uint64), [0.5, 1.5])])
+    with pytest.raises(IdOverflowError):
+        pack_flat(blk, batch_rows=2, nnz_cap=8)
+    with pytest.raises(IdOverflowError):
+        pack_rowmajor(blk, batch_rows=2, k_cap=8)
+    out = pack_flat(blk, batch_rows=2, nnz_cap=8, id_mod=1000)
+    np.testing.assert_array_equal(out["ids"][:2], [1, int(big) % 1000])
+
+
+def test_native_packer_overflow_and_id_mod():
+    from dmlc_core_tpu import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    from dmlc_core_tpu.utils import IdOverflowError
+    big = np.uint64(2**33 + 5)
+    blk = block_of([(1.0, np.array([1, big], np.uint64), [0.5, 1.5])])
+    p = native.Packer(2, 8)
+    with pytest.raises(IdOverflowError):
+        list(p.feed(blk))
+    p.close()
+    p = native.Packer(2, 8, id_mod=1000)
+    assert list(p.feed(blk)) == []          # one row: stays in carry
+    buf = p.flush()
+    ids = buf[:8]
+    np.testing.assert_array_equal(ids[:2], [1, int(big) % 1000])
+    p.close()
+
+
+def test_native_packer_matches_python_pack(libsvm_file):
+    """The native fused packer and the python pack path must produce
+    identical device batches when no early-close pressure exists."""
+    from dmlc_core_tpu import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    parser = create_parser(libsvm_file, threaded=False)
+    blocks = [c.get_block() for c in parser]
+    parser.close()
+    rows_cap, nnz_cap = 256, 8192
+    p = native.Packer(rows_cap, nnz_cap)
+    fused = []
+    for blk in blocks:
+        fused.extend(p.feed(blk))
+    tail = p.flush()
+    if tail is not None:
+        fused.append(tail)
+    # python reference: accumulate blocks then pack slice by slice
+    acc = RowBlockContainer()
+    for blk in blocks:
+        acc.push_block(blk)
+    whole = acc.get_block()
+    expect = []
+    for s in batch_slices(whole, rows_cap):
+        expect.append(pack_flat(s, rows_cap, nnz_cap))
+    assert len(fused) == len(expect)
+    for buf, host in zip(fused, expect):
+        np.testing.assert_array_equal(buf[:nnz_cap], host["ids"])
+        np.testing.assert_array_equal(
+            buf[nnz_cap:2 * nnz_cap].view(np.float32), host["vals"])
+        np.testing.assert_array_equal(
+            buf[2 * nnz_cap:3 * nnz_cap], host["segments"])
+        np.testing.assert_array_equal(
+            buf[3 * nnz_cap:3 * nnz_cap + rows_cap].view(np.float32),
+            host["labels"])
+        np.testing.assert_array_equal(
+            buf[3 * nnz_cap + rows_cap:].view(np.float32), host["weights"])
+
+
+def test_packer_early_close_on_nnz_pressure():
+    """A batch closes early (padded) when the next row would overflow
+    nnz_cap — no values are lost, unlike per-slice truncation."""
+    from dmlc_core_tpu import native
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    rows = [(float(i), np.arange(5, dtype=np.uint64), None) for i in range(4)]
+    blk = block_of(rows)
+    p = native.Packer(4, 12)            # 2 rows of 5 fit per batch (10 <= 12)
+    bufs = list(p.feed(blk))
+    tail = p.flush()
+    assert len(bufs) == 1 and tail is not None
+    st = p.stats()
+    assert st["rows"] == 4 and st["truncated_values"] == 0
+    p.close()
