@@ -23,7 +23,7 @@ def test_distributed_logreg_example(tmp_path):
          "--env", f"PYTHONPATH={REPO}",
          "--", sys.executable,
          os.path.join(REPO, "examples", "distributed_logreg.py"), str(data)],
-        capture_output=True, text=True, timeout=120,
+        capture_output=True, text=True, timeout=300,
         env={**os.environ, "PYTHONPATH": REPO, "EPOCHS": "2"})
     assert out.returncode == 0, out.stderr[-3000:]
     assert out.stderr.count("all workers agree") == 3
@@ -54,7 +54,7 @@ def test_failure_injection_worker_crash_and_recover(tmp_path):
          "--cluster", "local", "-n", "3",
          "--env", f"PYTHONPATH={REPO}",
          "--", sys.executable, str(script)],
-        capture_output=True, text=True, timeout=120,
+        capture_output=True, text=True, timeout=300,
         env={**os.environ, "PYTHONPATH": REPO})
     assert out.returncode == 0, out.stderr[-3000:]
     assert "INJECTED-CRASH" in out.stdout
@@ -98,7 +98,7 @@ def test_failure_injection_midjob_crash_and_second_allreduce(tmp_path):
          "--env", f"PYTHONPATH={REPO}",
          "--env", f"DMLC_CHECKPOINT_DIR={tmp_path}",
          "--", sys.executable, str(script)],
-        capture_output=True, text=True, timeout=180,
+        capture_output=True, text=True, timeout=300,
         env={**os.environ, "PYTHONPATH": REPO})
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-3000:])
     assert "MIDJOB-CRASH" in out.stdout
