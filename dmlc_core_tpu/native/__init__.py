@@ -71,24 +71,28 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dmlc_num_threads.restype = ctypes.c_int
         # packer symbols are newer than the parse ABI: a stale-but-loadable
         # .so (no compiler to rebuild) must still serve the parse fallback
-        if hasattr(lib, "dmlc_packer_create"):
-            lib.dmlc_packer_create.argtypes = [ctypes.c_int64, ctypes.c_int64,
-                                               ctypes.c_uint64]
-            lib.dmlc_packer_create.restype = ctypes.c_void_p
-            lib.dmlc_packer_destroy.argtypes = [ctypes.c_void_p]
-            lib.dmlc_packer_destroy.restype = None
-            lib.dmlc_packer_feed.argtypes = [
+        if hasattr(lib, "dmlc_packer2_create"):
+            lib.dmlc_packer2_create.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_uint64]
+            lib.dmlc_packer2_create.restype = ctypes.c_void_p
+            lib.dmlc_packer2_destroy.argtypes = [ctypes.c_void_p]
+            lib.dmlc_packer2_destroy.restype = None
+            lib.dmlc_packer2_feed.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_int64)]
-            lib.dmlc_packer_feed.restype = ctypes.c_int64
-            lib.dmlc_packer_flush.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-            lib.dmlc_packer_flush.restype = ctypes.c_int64
-            lib.dmlc_packer_stats.argtypes = [ctypes.c_void_p] + \
+            lib.dmlc_packer2_feed.restype = ctypes.c_int64
+            lib.dmlc_packer2_flush.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.dmlc_packer2_flush.restype = ctypes.c_int64
+            lib.dmlc_packer2_stats.argtypes = [ctypes.c_void_p] + \
                 [ctypes.POINTER(ctypes.c_int64)] * 4
-            lib.dmlc_packer_stats.restype = None
+            lib.dmlc_packer2_stats.restype = None
         _lib = lib
         return _lib
 
@@ -96,7 +100,7 @@ def _load() -> Optional[ctypes.CDLL]:
 def has_packer() -> bool:
     """True when the loaded library carries the fused-packer ABI."""
     lib = _load()
-    return lib is not None and hasattr(lib, "dmlc_packer_create")
+    return lib is not None and hasattr(lib, "dmlc_packer2_create")
 
 
 def available() -> bool:
@@ -202,27 +206,40 @@ def parse_csv(data: bytes, label_col: int = -1, delim: str = ",",
 from ..utils.logging import IdOverflowError  # noqa: E402  (shared error type)
 
 
+def fused_words(batch_rows: int, nnz_bucket: int) -> int:
+    """int32 words of a v2 fused batch: ids|vals|row_ptr|labels|weights."""
+    return 2 * nnz_bucket + 3 * batch_rows + 1
+
+
 class Packer:
     """Native CSR→fused-device-batch packer (see ``PackerC`` in
-    dmlc_native.cpp).  Streams RowBlocks into fixed-shape int32 buffers
-    matching the pipeline's one-transfer layout; a partial batch carries
-    across blocks until :meth:`flush`."""
+    dmlc_native.cpp).  Streams RowBlocks into v2 fused int32 buffers
+    (``ids[B]|vals[B]|row_ptr|labels|weights`` with B the actual nnz rounded
+    up to ``quantum``); a partial batch carries across blocks until
+    :meth:`flush`.  Emitted items are ``(buffer, B)`` pairs — the buffer's
+    first ``fused_words(batch_rows, B)`` words are the batch."""
 
-    def __init__(self, batch_rows: int, nnz_cap: int, id_mod: int = 0):
+    def __init__(self, batch_rows: int, nnz_cap: int, id_mod: int = 0,
+                 quantum: int = 0):
         lib = _load()
-        if lib is None or not hasattr(lib, "dmlc_packer_create"):
+        if lib is None or not hasattr(lib, "dmlc_packer2_create"):
             raise RuntimeError("native packer unavailable (stale library?)")
         self._lib = lib
-        self._p = lib.dmlc_packer_create(batch_rows, nnz_cap, id_mod)
+        if quantum <= 0:
+            # ≤8 device-side jit specialisations per (rows, cap) config
+            quantum = max(1, nnz_cap // 8)
+        self._p = lib.dmlc_packer2_create(batch_rows, nnz_cap, quantum,
+                                          id_mod)
         if not self._p:
-            raise MemoryError("dmlc_packer_create failed")
+            raise MemoryError("dmlc_packer2_create failed")
         self.batch_rows = batch_rows
         self.nnz_cap = nnz_cap
-        self.words = 3 * nnz_cap + 2 * batch_rows  # int32 words per batch
+        self.quantum = min(quantum, nnz_cap)
+        self.words_max = fused_words(batch_rows, nnz_cap)
 
     def close(self) -> None:
         if self._p:
-            self._lib.dmlc_packer_destroy(self._p)
+            self._lib.dmlc_packer2_destroy(self._p)
             self._p = None
 
     def __del__(self):
@@ -235,11 +252,15 @@ class Packer:
     def _addr(arr: Optional[np.ndarray]) -> Optional[int]:
         return None if arr is None else arr.ctypes.data
 
-    def feed(self, block, max_out: int = 8):
-        """Yield fused int32 batch buffers for ``block`` (a RowBlock with
-        int64 offsets / f32 labels / u64 indices / optional f32
-        values+weights).  Allocates a fresh buffer per emitted batch, so
-        buffers can go straight to an async ``device_put``."""
+    def feed(self, block, max_out: int = 8, get_buf=None, put_buf=None):
+        """Yield ``(buf, nnz_bucket)`` fused batches for ``block`` (a
+        RowBlock with int64 offsets / f32 labels / u64 indices / optional
+        f32 values+weights).  ``get_buf(words)`` supplies transfer buffers
+        (default fresh ``np.empty``) and ``put_buf(buf)`` takes unused ones
+        back — wiring both to a pool keeps the steady-state pipeline at
+        zero allocation."""
+        if get_buf is None:
+            get_buf = lambda words: np.empty(words, np.int32)  # noqa: E731
         offsets = np.ascontiguousarray(block.offsets, np.int64)
         labels = np.ascontiguousarray(block.labels, np.float32)
         indices = np.ascontiguousarray(block.indices, np.uint64)
@@ -250,37 +271,56 @@ class Packer:
         n_rows = len(offsets) - 1
         row = 0
         consumed = ctypes.c_int64(0)
-        while row < n_rows:
-            # size the scratch list to the work actually left (an nnz-based
-            # bound): idle full-size buffers are multi-MB dead allocations
-            remaining_nnz = int(offsets[-1] - offsets[row])
-            est = max(1, min(max_out, remaining_nnz // self.nnz_cap + 1))
-            bufs = [np.empty(self.words, np.int32) for _ in range(est)]
-            ptrs = (ctypes.c_void_p * est)(*[b.ctypes.data for b in bufs])
-            emitted = self._lib.dmlc_packer_feed(
-                self._p, n_rows, offsets.ctypes.data, labels.ctypes.data,
-                self._addr(weights), indices.ctypes.data, self._addr(values),
-                row, ptrs, est, ctypes.byref(consumed))
-            if emitted == -2:
-                raise IdOverflowError(
-                    f"feature id > 2^31-1 at row {consumed.value} — pass "
-                    f"id_mod (feature hashing) or keep ids below int32 range")
-            if emitted < 0:
-                raise RuntimeError(f"dmlc_packer_feed error {emitted}")
-            for i in range(emitted):
-                yield bufs[i]
-            row = consumed.value
-            if emitted == 0 and row < n_rows:
-                raise RuntimeError("packer made no progress")
+        spare: list = []
+        try:
+            while row < n_rows:
+                # size the scratch list to the work actually left (an
+                # nnz-based bound): idle full-size buffers are multi-MB
+                # dead allocations
+                remaining_nnz = int(offsets[-1] - offsets[row])
+                est = max(1, min(max_out, remaining_nnz // self.nnz_cap + 1))
+                bufs = spare[:est]
+                del spare[:len(bufs)]
+                bufs += [get_buf(self.words_max)
+                         for _ in range(est - len(bufs))]
+                ptrs = (ctypes.c_void_p * est)(*[b.ctypes.data for b in bufs])
+                nnz_out = (ctypes.c_int64 * est)()
+                emitted = self._lib.dmlc_packer2_feed(
+                    self._p, n_rows, offsets.ctypes.data, labels.ctypes.data,
+                    self._addr(weights), indices.ctypes.data,
+                    self._addr(values), row, ptrs, nnz_out, est,
+                    ctypes.byref(consumed))
+                if emitted == -2:
+                    raise IdOverflowError(
+                        f"feature id > 2^31-1 at row {consumed.value} — pass "
+                        f"id_mod (feature hashing) or keep ids below int32 "
+                        f"range")
+                if emitted < 0:
+                    raise RuntimeError(f"dmlc_packer2_feed error {emitted}")
+                spare.extend(bufs[emitted:])  # untouched: reuse next round
+                for i in range(emitted):
+                    yield bufs[i], int(nnz_out[i])
+                row = consumed.value
+                if emitted == 0 and row < n_rows:
+                    raise RuntimeError("packer made no progress")
+        finally:
+            if put_buf is not None:
+                for b in spare:
+                    put_buf(b)
 
-    def flush(self) -> Optional[np.ndarray]:
-        """Emit the final partial batch (padded), or None when empty."""
-        buf = np.empty(self.words, np.int32)
-        rows = self._lib.dmlc_packer_flush(self._p, buf.ctypes.data)
-        return buf if rows > 0 else None
+    def flush(self, get_buf=None):
+        """Emit the final partial batch as ``(buf, nnz_bucket)`` (padded),
+        or None when empty."""
+        if get_buf is None:
+            get_buf = lambda words: np.empty(words, np.int32)  # noqa: E731
+        buf = get_buf(self.words_max)
+        nnz = ctypes.c_int64(0)
+        rows = self._lib.dmlc_packer2_flush(self._p, buf.ctypes.data,
+                                            ctypes.byref(nnz))
+        return (buf, int(nnz.value)) if rows > 0 else None
 
     def stats(self) -> Dict[str, int]:
         vals = [ctypes.c_int64(0) for _ in range(4)]
-        self._lib.dmlc_packer_stats(self._p, *[ctypes.byref(v) for v in vals])
+        self._lib.dmlc_packer2_stats(self._p, *[ctypes.byref(v) for v in vals])
         return {"rows": vals[0].value, "padded_rows": vals[1].value,
                 "truncated_values": vals[2].value, "batches": vals[3].value}

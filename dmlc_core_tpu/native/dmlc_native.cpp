@@ -556,12 +556,17 @@ int parse_parallel(const char* data, int64_t len, bool want_fields, int nthreads
 //
 // Packs CSR rows into the pipeline's fused device buffer layout (one int32
 // buffer per batch, one h2d transfer: see pipeline/device_loader.py
-// _fused_put):
-//   [0,          nnz)          ids        int32
-//   [nnz,        2*nnz)        vals       f32 bits
-//   [2*nnz,      3*nnz)        segments   int32 (padding -> batch_rows)
-//   [3*nnz,      3*nnz+rows)   labels     f32 bits
-//   [3*nnz+rows, 3*nnz+2rows)  weights    f32 bits (padding rows weigh 0)
+// _put_fused_buf).  v2 layout — row_ptr instead of per-value segments, and
+// the nnz region sized to the *actual* values rounded up to `quantum`
+// (bucket B), so a rows-limited batch ships ~half the bytes of the padded
+// v1 layout and the per-value segment ids are reconstructed on device with
+// one searchsorted (free next to the transfer):
+//   [0,        B)            ids      int32   (pad 0)
+//   [B,        2B)           vals     f32 bits (pad 0.0 -> scratch row)
+//   [2B,       2B+rows+1)    row_ptr  int32   (pad rows repeat nnz)
+//   [...,      +rows)        labels   f32 bits
+//   [...,      +rows)        weights  f32 bits (padding rows weigh 0)
+// words(B) = 2*B + 3*rows + 1.
 //
 // Replaces the per-batch numpy pack path (reference equivalent: the consumer
 // loop materialising RowBlocks, basic_row_iter.h:61-82 — here rows stream
@@ -575,9 +580,12 @@ int parse_parallel(const char* data, int64_t len, bool want_fields, int nthreads
 struct PackerC {
   int64_t batch_rows;
   int64_t nnz_cap;
+  int64_t quantum;       // nnz bucket granularity (<= nnz_cap)
   uint64_t id_mod;       // 0 = no hashing; ids must be < 2^31
-  // staging batch
-  std::vector<int32_t> stage;
+  // staging batch (separate regions: the emitted offsets depend on B)
+  std::vector<int32_t> ids_s, vals_s;   // nnz_cap
+  std::vector<int32_t> rp_s;            // batch_rows + 1
+  std::vector<int32_t> labs_s, wgts_s;  // batch_rows
   int64_t row_count = 0;
   int64_t nnz_count = 0;
   // aggregate stats
@@ -586,30 +594,44 @@ struct PackerC {
   int64_t truncated_values = 0;
   int64_t batches = 0;
 
-  PackerC(int64_t rows, int64_t nnz, uint64_t mod)
-      : batch_rows(rows), nnz_cap(nnz), id_mod(mod),
-        stage(3 * nnz + 2 * rows) {}
+  PackerC(int64_t rows, int64_t nnz, int64_t quant, uint64_t mod)
+      : batch_rows(rows), nnz_cap(nnz),
+        quantum(quant <= 0 ? nnz : (quant > nnz ? nnz : quant)),
+        id_mod(mod), ids_s(nnz), vals_s(nnz), rp_s(rows + 1),
+        labs_s(rows), wgts_s(rows) {
+    rp_s[0] = 0;
+  }
 
-  int32_t* ids() { return stage.data(); }
-  int32_t* vals() { return stage.data() + nnz_cap; }
-  int32_t* segs() { return stage.data() + 2 * nnz_cap; }
-  int32_t* labs() { return stage.data() + 3 * nnz_cap; }
-  int32_t* wgts() { return stage.data() + 3 * nnz_cap + batch_rows; }
+  // round nnz_count up to the bucket the device-side jit cache is keyed on
+  int64_t bucket() const {
+    int64_t b = (nnz_count + quantum - 1) / quantum * quantum;
+    if (b < quantum) b = quantum;
+    return b > nnz_cap ? nnz_cap : b;
+  }
 
-  void emit(int32_t* out) {
-    // pad the open regions, then one memcpy to the caller's buffer
-    std::memset(ids() + nnz_count, 0, (nnz_cap - nnz_count) * 4);
-    std::memset(vals() + nnz_count, 0, (nnz_cap - nnz_count) * 4);
-    for (int64_t i = nnz_count; i < nnz_cap; ++i)
-      segs()[i] = static_cast<int32_t>(batch_rows);
-    std::memset(labs() + row_count, 0, (batch_rows - row_count) * 4);
-    std::memset(wgts() + row_count, 0, (batch_rows - row_count) * 4);
-    std::memcpy(out, stage.data(), stage.size() * 4);
+  // write the staged batch into out (layout v2); returns B (the nnz bucket)
+  int64_t emit(int32_t* out) {
+    const int64_t B = bucket();
+    std::memcpy(out, ids_s.data(), nnz_count * 4);
+    std::memset(out + nnz_count, 0, (B - nnz_count) * 4);
+    std::memcpy(out + B, vals_s.data(), nnz_count * 4);
+    std::memset(out + B + nnz_count, 0, (B - nnz_count) * 4);
+    int32_t* rp = out + 2 * B;
+    std::memcpy(rp, rp_s.data(), (row_count + 1) * 4);
+    for (int64_t r = row_count + 1; r <= batch_rows; ++r)
+      rp[r] = static_cast<int32_t>(nnz_count);
+    int32_t* labs = rp + batch_rows + 1;
+    std::memcpy(labs, labs_s.data(), row_count * 4);
+    std::memset(labs + row_count, 0, (batch_rows - row_count) * 4);
+    int32_t* wgts = labs + batch_rows;
+    std::memcpy(wgts, wgts_s.data(), row_count * 4);
+    std::memset(wgts + row_count, 0, (batch_rows - row_count) * 4);
     padded_rows += batch_rows - row_count;
     total_rows += row_count;
     ++batches;
     row_count = 0;
     nnz_count = 0;
+    return B;
   }
 };
 
@@ -617,25 +639,27 @@ struct PackerC {
 
 extern "C" {
 
-void* dmlc_packer_create(int64_t batch_rows, int64_t nnz_cap, uint64_t id_mod) {
+void* dmlc_packer2_create(int64_t batch_rows, int64_t nnz_cap,
+                          int64_t quantum, uint64_t id_mod) {
   if (batch_rows <= 0 || nnz_cap <= 0) return nullptr;
-  return new (std::nothrow) PackerC(batch_rows, nnz_cap, id_mod);
+  return new (std::nothrow) PackerC(batch_rows, nnz_cap, quantum, id_mod);
 }
 
-void dmlc_packer_destroy(void* p) { delete static_cast<PackerC*>(p); }
+void dmlc_packer2_destroy(void* p) { delete static_cast<PackerC*>(p); }
 
 // Feed rows [start_row, n_rows) of a CSR block; write finished batches into
-// out_bufs[0..max_out).  Returns the number of batches emitted (>= 0) and
-// sets *consumed_rows to the absolute row index reached; the caller loops
-// until consumed == n_rows.  Returns -2 when a feature id exceeds int32
-// range and no id_mod is configured.  weights/values may be null (implicit
-// 1.0).  A partial batch stays in the packer across calls (and across
-// blocks) until dmlc_packer_flush.
-int64_t dmlc_packer_feed(void* vp, int64_t n_rows, const int64_t* offsets,
-                         const float* labels, const float* weights,
-                         const uint64_t* indices, const float* values,
-                         int64_t start_row, int32_t** out_bufs,
-                         int64_t max_out, int64_t* consumed_rows) {
+// out_bufs[0..max_out) and each batch's nnz bucket B into out_nnz[i].
+// Returns the number of batches emitted (>= 0) and sets *consumed_rows to
+// the absolute row index reached; the caller loops until consumed == n_rows.
+// Returns -2 when a feature id exceeds int32 range and no id_mod is
+// configured.  weights/values may be null (implicit 1.0).  A partial batch
+// stays in the packer across calls (and across blocks) until flush.
+int64_t dmlc_packer2_feed(void* vp, int64_t n_rows, const int64_t* offsets,
+                          const float* labels, const float* weights,
+                          const uint64_t* indices, const float* values,
+                          int64_t start_row, int32_t** out_bufs,
+                          int64_t* out_nnz, int64_t max_out,
+                          int64_t* consumed_rows) {
   PackerC* p = static_cast<PackerC*>(vp);
   int64_t emitted = 0;
   const int64_t base = offsets[0];
@@ -649,12 +673,11 @@ int64_t dmlc_packer_feed(void* vp, int64_t n_rows, const int64_t* offsets,
     }
     if (p->row_count == p->batch_rows || p->nnz_count + k > p->nnz_cap) {
       if (emitted == max_out) break;  // caller must drain first
-      p->emit(out_bufs[emitted++]);
+      out_nnz[emitted] = p->emit(out_bufs[emitted]);
+      ++emitted;
     }
-    int32_t* ids = p->ids() + p->nnz_count;
-    float* vals = reinterpret_cast<float*>(p->vals()) + p->nnz_count;
-    int32_t* segs = p->segs() + p->nnz_count;
-    const int32_t seg = static_cast<int32_t>(p->row_count);
+    int32_t* ids = p->ids_s.data() + p->nnz_count;
+    float* vals = reinterpret_cast<float*>(p->vals_s.data()) + p->nnz_count;
     if (p->id_mod) {
       for (int64_t j = 0; j < k; ++j)
         ids[j] = static_cast<int32_t>(indices[b + j] % p->id_mod);
@@ -670,29 +693,29 @@ int64_t dmlc_packer_feed(void* vp, int64_t n_rows, const int64_t* offsets,
     } else {
       for (int64_t j = 0; j < k; ++j) vals[j] = 1.0f;
     }
-    for (int64_t j = 0; j < k; ++j) segs[j] = seg;
-    reinterpret_cast<float*>(p->labs())[p->row_count] = labels[r];
-    reinterpret_cast<float*>(p->wgts())[p->row_count] =
+    reinterpret_cast<float*>(p->labs_s.data())[p->row_count] = labels[r];
+    reinterpret_cast<float*>(p->wgts_s.data())[p->row_count] =
         weights ? weights[r] : 1.0f;
     ++p->row_count;
     p->nnz_count += k;
+    p->rp_s[p->row_count] = static_cast<int32_t>(p->nnz_count);
   }
   *consumed_rows = r;
   return emitted;
 }
 
 // Flush the open partial batch (padded) into out_buf; returns the number of
-// real rows flushed (0 = nothing pending).
-int64_t dmlc_packer_flush(void* vp, int32_t* out_buf) {
+// real rows flushed (0 = nothing pending) and sets *out_nnz to the bucket.
+int64_t dmlc_packer2_flush(void* vp, int32_t* out_buf, int64_t* out_nnz) {
   PackerC* p = static_cast<PackerC*>(vp);
   const int64_t rows = p->row_count;
   if (rows == 0) return 0;
-  p->emit(out_buf);
+  *out_nnz = p->emit(out_buf);
   return rows;
 }
 
-void dmlc_packer_stats(void* vp, int64_t* total_rows, int64_t* padded_rows,
-                       int64_t* truncated_values, int64_t* batches) {
+void dmlc_packer2_stats(void* vp, int64_t* total_rows, int64_t* padded_rows,
+                        int64_t* truncated_values, int64_t* batches) {
   PackerC* p = static_cast<PackerC*>(vp);
   *total_rows = p->total_rows;
   *padded_rows = p->padded_rows;

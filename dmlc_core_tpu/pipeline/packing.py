@@ -91,8 +91,14 @@ def batch_slices(block: RowBlock, batch_rows: int) -> Iterator[RowBlock]:
 
 def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
               stats: Optional[PackStats] = None,
-              id_mod: int = 0) -> Dict[str, np.ndarray]:
-    """Flat-CSR fixed-shape batch; ``block.size`` must be ≤ batch_rows."""
+              id_mod: int = 0,
+              want_segments: bool = True) -> Dict[str, np.ndarray]:
+    """Flat-CSR fixed-shape batch; ``block.size`` must be ≤ batch_rows.
+
+    ``want_segments=False`` skips materialising the per-value ``segments``
+    array (the largest write in the pack) — the fused transfer path
+    reconstructs segments on device from ``row_ptr``, so building them on
+    host would be dead work."""
     n = block.size
     assert n <= batch_rows, (n, batch_rows)
     offsets = block.offsets.astype(np.int64)
@@ -102,7 +108,9 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
 
     ids = np.zeros(nnz_cap, np.int32)
     vals = np.zeros(nnz_cap, np.float32)
-    segments = np.full(nnz_cap, batch_rows, np.int32)  # padding → scratch row
+    segments = (np.full(nnz_cap, batch_rows, np.int32)  # padding → scratch
+                if want_segments else None)
+    row_ptr = np.empty(batch_rows + 1, np.int32)
 
     truncated = 0
     if total <= nnz_cap:
@@ -113,7 +121,10 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
             vals[:take] = block.values[src_idx]
         else:
             vals[:take] = 1.0
-        segments[:take] = np.repeat(np.arange(n, dtype=np.int32), counts)
+        if want_segments:
+            segments[:take] = np.repeat(np.arange(n, dtype=np.int32), counts)
+        row_ptr[:n + 1] = rel
+        row_ptr[n + 1:] = take
     else:
         # per-row truncation by water-filling: find the largest level t such
         # that sum(min(counts, t)) <= nnz_cap, then hand the remaining slots
@@ -129,9 +140,13 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
                 vals[pos:pos + k] = block.values[b:b + k]
             else:
                 vals[pos:pos + k] = 1.0
-            segments[pos:pos + k] = r
+            if want_segments:
+                segments[pos:pos + k] = r
             pos += k
         truncated = total - pos
+        row_ptr[0] = 0
+        row_ptr[1:n + 1] = np.cumsum(keep)
+        row_ptr[n + 1:] = pos
 
     labels = np.zeros(batch_rows, np.float32)
     weights = np.zeros(batch_rows, np.float32)  # padding rows weigh 0
@@ -142,8 +157,11 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
         stats.rows += n
         stats.padded_rows += batch_rows - n
         stats.truncated_values += truncated
-    return {"ids": ids, "vals": vals, "segments": segments,
-            "labels": labels, "weights": weights}
+    out = {"ids": ids, "vals": vals, "row_ptr": row_ptr,
+           "labels": labels, "weights": weights}
+    if want_segments:
+        out["segments"] = segments
+    return out
 
 
 def pack_rowmajor(block: RowBlock, batch_rows: int, k_cap: int,

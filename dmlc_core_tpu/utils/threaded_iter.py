@@ -143,12 +143,21 @@ class ThreadedIter(Generic[T]):
 
     # -- consumer side --
     def next(self) -> Optional[T]:
-        """Pop the next item, or None at end (reference Next `threadediter.h:360-382`)."""
+        """Pop the next item, or None at end (reference Next `threadediter.h:360-382`).
+
+        Destroy-aware: a consumer blocked here returns None when
+        :meth:`destroy` fires, so chained stages (a downstream producer
+        thread consuming an upstream iter) unwind cleanly instead of
+        deadlocking on a dead producer."""
         with self._lock:
             if self._consumed_end:
                 return None
-            while not self._queue and not self._produced_end:
+            while (not self._queue and not self._produced_end
+                   and not self._destroy):
                 self._lock.wait()
+            if self._destroy and not self._queue:
+                self._consumed_end = True
+                return None
             if self._error is not None:
                 err = self._error
                 self._consumed_end = True

@@ -112,20 +112,19 @@ def test_device_loader_rowmajor_layout(libsvm_file):
 
 
 def test_fused_h2d_matches_per_array(tmp_path):
-    """The single-transfer fused path must produce bitwise-identical batch
-    contents to per-array device_put."""
-    import jax
+    """The single-transfer fused path (v2 layout: row_ptr shipped, segments
+    reconstructed on device by searchsorted) must produce bitwise-identical
+    batch contents to the packed host arrays."""
     import numpy as np
     from dmlc_core_tpu.pipeline.device_loader import _fused_put
     rows, nnz = 64, 256
     rng = np.random.default_rng(0)
-    host = {
-        "ids": rng.integers(0, 1000, nnz).astype(np.int32),
-        "vals": rng.standard_normal(nnz).astype(np.float32),
-        "segments": rng.integers(0, rows + 1, nnz).astype(np.int32),
-        "labels": rng.standard_normal(rows).astype(np.float32),
-        "weights": rng.random(rows).astype(np.float32),
-    }
+    rows_spec = []
+    for i in range(50):                      # partial batch: 50 < 64 rows
+        n = int(rng.integers(0, 6))          # includes empty rows
+        idx = sorted(rng.choice(1000, n, replace=False).tolist())
+        rows_spec.append((float(i % 2), idx, rng.random(n).astype(np.float32)))
+    host = pack_flat(block_of(rows_spec), batch_rows=rows, nnz_cap=nnz)
     fused = _fused_put(host, rows, nnz)
     for k, v in host.items():
         np.testing.assert_array_equal(np.asarray(fused[k]), v, err_msg=k)
@@ -160,9 +159,9 @@ def test_native_packer_overflow_and_id_mod():
     p.close()
     p = native.Packer(2, 8, id_mod=1000)
     assert list(p.feed(blk)) == []          # one row: stays in carry
-    buf = p.flush()
-    ids = buf[:8]
-    np.testing.assert_array_equal(ids[:2], [1, int(big) % 1000])
+    buf, nnz_b = p.flush()
+    assert nnz_b >= 2
+    np.testing.assert_array_equal(buf[:2], [1, int(big) % 1000])
     p.close()
 
 
@@ -192,17 +191,23 @@ def test_native_packer_matches_python_pack(libsvm_file):
     for s in batch_slices(whole, rows_cap):
         expect.append(pack_flat(s, rows_cap, nnz_cap))
     assert len(fused) == len(expect)
-    for buf, host in zip(fused, expect):
-        np.testing.assert_array_equal(buf[:nnz_cap], host["ids"])
+    for (buf, B), host in zip(fused, expect):
+        # v2 layout: ids[B] | vals[B] | row_ptr[rows+1] | labels | weights;
+        # B <= nnz_cap is the bucketed actual nnz, python pads to nnz_cap
+        assert B <= nnz_cap
+        np.testing.assert_array_equal(buf[:B], host["ids"][:B])
+        assert not host["ids"][B:].any()
         np.testing.assert_array_equal(
-            buf[nnz_cap:2 * nnz_cap].view(np.float32), host["vals"])
+            buf[B:2 * B].view(np.float32), host["vals"][:B])
+        assert not host["vals"][B:].any()
+        rp = buf[2 * B:2 * B + rows_cap + 1]
+        np.testing.assert_array_equal(rp, host["row_ptr"])
         np.testing.assert_array_equal(
-            buf[2 * nnz_cap:3 * nnz_cap], host["segments"])
+            buf[2 * B + rows_cap + 1:2 * B + 2 * rows_cap + 1]
+            .view(np.float32), host["labels"])
         np.testing.assert_array_equal(
-            buf[3 * nnz_cap:3 * nnz_cap + rows_cap].view(np.float32),
-            host["labels"])
-        np.testing.assert_array_equal(
-            buf[3 * nnz_cap + rows_cap:].view(np.float32), host["weights"])
+            buf[2 * B + 2 * rows_cap + 1:2 * B + 3 * rows_cap + 1]
+            .view(np.float32), host["weights"])
 
 
 def test_packer_early_close_on_nnz_pressure():
@@ -217,6 +222,7 @@ def test_packer_early_close_on_nnz_pressure():
     bufs = list(p.feed(blk))
     tail = p.flush()
     assert len(bufs) == 1 and tail is not None
+    assert bufs[0][1] >= 10             # bucket covers the 10 staged values
     st = p.stats()
     assert st["rows"] == 4 and st["truncated_values"] == 0
     p.close()
