@@ -682,30 +682,71 @@ class WebHDFSFileSystem(FileSystem):
             return _WebHDFSReadStream(scheme, netloc, path, info.size,
                                       self._user())
         check(mode == "w", "webhdfs supports modes 'r' and 'w' only")
-        fs = self
+        part = int(os.environ.get("DMLC_WEBHDFS_PART_SIZE", str(8 << 20)))
+        return _WebHDFSWriteStream(self, uri, max(1, part))
 
-        class _Writer(io.BytesIO):
-            def close(self) -> None:
-                if not self.closed:
-                    data = self.getvalue()
-                    # step 1: namenode CREATE (no body) → datanode Location
-                    status, hdrs, resp = fs._op(uri, "PUT", "CREATE",
-                                                {"overwrite": "true",
-                                                 "noredirect": "true"}, b"")
-                    loc = _webhdfs_location(status, hdrs, resp)
-                    if loc is not None:
-                        # step 2: stream the bytes to the datanode
-                        status, _, _ = _request_url("PUT", loc, data)
-                    elif status in (200, 201):
-                        # gateway (e.g. HttpFS) accepted data directly
-                        status, _, _ = fs._op(uri, "PUT", "CREATE",
-                                              {"overwrite": "true",
-                                               "noredirect": "true"}, data)
-                    check(status in (200, 201),
-                          f"webhdfs CREATE: HTTP {status}")
-                super().close()
 
-        return _Writer()
+class _WebHDFSWriteStream(io.BufferedIOBase):
+    """Streaming writer: CREATE carries the first part, each further part
+    goes out as an APPEND — memory stays bounded at ``part_size`` no matter
+    how large the object (the reference streams via hdfsWrite,
+    `hdfs_filesys.cc:56-75`; buffering the whole object, as v1 did, OOMs on
+    large checkpoint writes)."""
+
+    def __init__(self, fs: "WebHDFSFileSystem", uri: URI,
+                 part_size: int) -> None:
+        self._fs = fs
+        self._uri = uri
+        self._part = part_size
+        self._buf = bytearray()
+        self._created = False
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        if self.closed:
+            raise ValueError("I/O operation on closed file")
+        self._buf += b
+        while len(self._buf) >= self._part:
+            self._send(bytes(self._buf[:self._part]))
+            del self._buf[:self._part]
+        return len(b)
+
+    def _send(self, data: bytes) -> None:
+        if not self._created:
+            # step 1: namenode CREATE (no body) → datanode Location
+            status, hdrs, resp = self._fs._op(
+                self._uri, "PUT", "CREATE",
+                {"overwrite": "true", "noredirect": "true"}, b"")
+            loc = _webhdfs_location(status, hdrs, resp)
+            if loc is not None:
+                # step 2: stream the first part to the datanode
+                status, _, _ = _request_url("PUT", loc, data)
+            elif status in (200, 201):
+                # gateway (e.g. HttpFS) accepted data directly
+                status, _, _ = self._fs._op(
+                    self._uri, "PUT", "CREATE",
+                    {"overwrite": "true", "noredirect": "true"}, data)
+            check(status in (200, 201), f"webhdfs CREATE: HTTP {status}")
+            self._created = True
+            return
+        status, hdrs, resp = self._fs._op(self._uri, "POST", "APPEND",
+                                          {"noredirect": "true"}, b"")
+        loc = _webhdfs_location(status, hdrs, resp)
+        if loc is not None:
+            status, _, _ = _request_url("POST", loc, data)
+        elif status in (200, 201, 204):
+            status, _, _ = self._fs._op(self._uri, "POST", "APPEND", {}, data)
+        check(status in (200, 201, 204), f"webhdfs APPEND: HTTP {status}")
+
+    def close(self) -> None:
+        if not self.closed:
+            # final short part; an empty file still needs its CREATE
+            if self._buf or not self._created:
+                self._send(bytes(self._buf))
+                self._buf = bytearray()
+            super().close()
 
 
 # ---------------------------------------------------------------------------

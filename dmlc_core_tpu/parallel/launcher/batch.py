@@ -60,6 +60,9 @@ def submit_sge(args, tracker_envs: Dict[str, str]) -> int:
     cmd = ["qsub", "-cwd", "-t", f"1-{nproc}", "-b", "y", "-sync", "y"]
     if args.sge_queue:
         cmd += ["-q", args.sge_queue]
+    if getattr(args, "sge_log_dir", None):
+        # reference opts.py:108 --sge-log-dir: qsub stdout/stderr land here
+        cmd += ["-o", args.sge_log_dir, "-e", args.sge_log_dir]
     cmd.append(script)
     return _launch(args, cmd, "sge", script)
 

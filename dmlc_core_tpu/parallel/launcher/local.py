@@ -7,8 +7,9 @@ from __future__ import annotations
 
 import os
 import subprocess
+import tempfile
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ...utils import log_info, log_warning
 
@@ -16,11 +17,12 @@ __all__ = ["submit"]
 
 
 def _run_with_retry(cmd: List[str], env: Dict[str, str], max_attempts: int,
-                    results: List[int], slot: int) -> None:
+                    results: List[int], slot: int,
+                    cwd: Optional[str] = None) -> None:
     attempt = 0
     while True:
         env_try = dict(env, DMLC_NUM_ATTEMPT=str(attempt))
-        proc = subprocess.Popen(cmd, env=env_try)
+        proc = subprocess.Popen(cmd, env=env_try, cwd=cwd)
         rc = proc.wait()
         if rc == 0:
             results[slot] = 0
@@ -36,6 +38,17 @@ def _run_with_retry(cmd: List[str], env: Dict[str, str], max_attempts: int,
 def submit(args, tracker_envs: Dict[str, str]) -> int:
     """Spawn workers+servers locally; returns first nonzero exit code or 0."""
     nproc = args.num_workers + args.num_servers
+    # ship --files/--archives + auto-cached command files into a job
+    # staging dir and run the workers there (reference YARN file-cache
+    # semantics, yarn.py:35-42, expressed as a local cwd)
+    stage_dir = None
+    if getattr(args, "cache_files", None) or getattr(args, "cache_archives",
+                                                     None):
+        from .filecache import stage_into
+        stage_dir = tempfile.mkdtemp(prefix="dmlc_stage_")
+        stage_into(stage_dir, args.cache_files, args.cache_archives)
+        log_info("staged %d files + %d archives into %s",
+                 len(args.cache_files), len(args.cache_archives), stage_dir)
     threads = []
     results = [0] * nproc
     for i in range(nproc):
@@ -52,7 +65,8 @@ def submit(args, tracker_envs: Dict[str, str]) -> int:
         })
         t = threading.Thread(
             target=_run_with_retry,
-            args=(args.command, env, max(1, args.max_attempts), results, i),
+            args=(args.command, env, max(1, args.max_attempts), results, i,
+                  stage_dir),
             daemon=True)
         t.start()
         threads.append(t)

@@ -8,6 +8,11 @@ contract and worker command are **inlined into the ``--command`` string**
 (``mesos-execute`` does not ship local files to agents, so a wrapper script
 on the submitting host would not exist on the agent); ``DMLC_TASK_ID`` is
 baked per task exactly as the reference builds one TaskInfo per rank.
+
+``--files``/``--archives`` on this backend assume the submit-host paths are
+reachable from the agents over a shared filesystem (same assumption as the
+slurm/sge wrappers); the inlined staging aborts the attempt loudly if the
+copy fails rather than running in an empty scratch dir.
 """
 
 from __future__ import annotations
@@ -24,13 +29,17 @@ __all__ = ["submit_mesos", "build_mesos_commands"]
 
 
 def _inline_command(args, tracker_envs: Dict[str, str], task_id: int) -> str:
+    from .filecache import stage_snippet
     env = job_env(args, tracker_envs, "mesos")
     env["DMLC_TASK_ID"] = str(task_id)
     env["DMLC_ROLE"] = ("server" if task_id < args.num_servers else "worker")
     exports = "; ".join(f"export {k}={shlex.quote(v)}"
                         for k, v in env.items())
+    staging = stage_snippet(getattr(args, "cache_files", None) or [],
+                            getattr(args, "cache_archives", None) or [])
+    staging = staging.replace("\n", "; ") + "; " if staging else ""
     cmd = " ".join(shlex.quote(c) for c in args.command)
-    return f"{exports}; {retry_loop(cmd, oneline=True)}"
+    return f"{exports}; {staging}{retry_loop(cmd, oneline=True)}"
 
 
 def build_mesos_commands(args, tracker_envs: Dict[str, str]) -> List[List[str]]:

@@ -27,7 +27,39 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of server processes (parameter-server mode)")
     p.add_argument("--worker-cores", type=int, default=1)
     p.add_argument("--worker-memory-mb", type=int, default=1024)
+    p.add_argument("--worker-memory", default=None, metavar="Ng|Nm",
+                   help="worker memory as '4g'/'512m' (reference form; "
+                        "overrides --worker-memory-mb)")
+    p.add_argument("--server-cores", type=int, default=1,
+                   help="cores per server process (PS mode)")
+    p.add_argument("--server-memory-mb", type=int, default=1024)
+    p.add_argument("--server-memory", default=None, metavar="Ng|Nm",
+                   help="server memory as '4g'/'512m' (overrides "
+                        "--server-memory-mb)")
     p.add_argument("--jobname", default=None)
+    p.add_argument("--log-file", default=None,
+                   help="also write launcher logs to this file "
+                        "(stderr logging stays on)")
+    p.add_argument("--hdfs-tempdir", default="/tmp",
+                   help="HDFS temp dir, exported to workers as "
+                        "DMLC_HDFS_TEMPDIR (reference opts.py:104; its "
+                        "yarn client staged job files through it)")
+    p.add_argument("--sge-log-dir", default=None,
+                   help="sge: directory for qsub stdout/stderr logs")
+    p.add_argument("--files", action="append", default=[], metavar="PATH",
+                   help="ship this file into each worker's cwd "
+                        "(repeatable)")
+    p.add_argument("--archives", action="append", default=[], metavar="PATH",
+                   help="ship and extract this zip/tar into each worker's "
+                        "cwd (repeatable)")
+    p.add_argument("--auto-file-cache", default=None,
+                   type=lambda s: s.lower() not in ("0", "false", "no"),
+                   help="auto-ship command-line tokens that name local "
+                        "files under the cwd, rewriting them to ./<name>. "
+                        "Default: on for yarn (the executable must ship, "
+                        "as the reference does) and whenever "
+                        "--files/--archives are given; off otherwise, so "
+                        "in-place jobs keep their cwd-relative paths")
     p.add_argument("--host-file", default=None,
                    help="ssh/mpi: file listing one host per line")
     p.add_argument("--host-ip", default=None,
@@ -53,6 +85,16 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def memory_mb(mem: str) -> int:
+    """'4g'/'512m' → MB (reference ``opts.py:get_memory_mb``)."""
+    m = mem.lower()
+    if m.endswith("g"):
+        return int(float(m[:-1]) * 1024)
+    if m.endswith("m"):
+        return int(float(m[:-1]))
+    raise ValueError(f"memory spec {mem!r} must end with 'g' or 'm'")
+
+
 def get_opts(argv: Optional[List[str]] = None) -> argparse.Namespace:
     args = build_parser().parse_args(argv)
     if not args.command:
@@ -66,4 +108,29 @@ def get_opts(argv: Optional[List[str]] = None) -> argparse.Namespace:
             build_parser().error(f"--env expects K=V, got {kv!r}")
         k, v = kv.split("=", 1)
         args.extra_env[k] = v
+    for which in ("worker", "server"):
+        spec = getattr(args, f"{which}_memory")
+        if spec is not None:
+            try:
+                setattr(args, f"{which}_memory_mb", memory_mb(spec))
+            except ValueError as e:
+                build_parser().error(str(e))
+    # --files/--archives must exist NOW: a typo'd path should fail the
+    # submit, not surface as FileNotFoundError inside a worker later
+    for f in args.files + args.archives:
+        if not os.path.exists(f):
+            build_parser().error(f"--files/--archives path not found: {f!r}")
+    # file cache: auto-ship command files + --files/--archives, rewrite the
+    # command to staged names (reference get_cache_file_set, opts.py:6-36).
+    # The rewrite moves the worker cwd to a staging dir, so it only engages
+    # when shipping is actually in play — explicitly shipped files, yarn
+    # (whose containers never share the submit cwd), or an explicit
+    # --auto-file-cache true
+    if args.auto_file_cache is None:
+        args.auto_file_cache = bool(args.files or args.archives
+                                    or args.cluster == "yarn")
+    from .filecache import resolve
+    args.command_raw = list(args.command)
+    args.cache_files, args.cache_archives, args.command = resolve(
+        args.command, args.files, args.archives, args.auto_file_cache)
     return args

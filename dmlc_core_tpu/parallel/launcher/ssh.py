@@ -58,6 +58,34 @@ def submit(args, tracker_envs: Dict[str, str]) -> int:
                  f"{host}:{args.sync_dst_dir}/"], check=True)
         workdir = args.sync_dst_dir
 
+    # --files/--archives + auto-cached command files: rsync to a staging
+    # dir on every host and run the job there (no shared-FS assumption;
+    # reference ships via the YARN file cache, yarn.py:35-42 — ssh's
+    # equivalent is explicit per-host transfer)
+    cache = (getattr(args, "cache_files", None) or []) + \
+            (getattr(args, "cache_archives", None) or [])
+    if cache:
+        from uuid import uuid4
+        from .filecache import unpack_command
+        # per-submit unique dir: concurrent jobs (or two users) sharing a
+        # host must not overwrite each other's shipped files
+        stage = args.sync_dst_dir or (
+            f"/tmp/dmlc_{args.jobname or 'job'}_{uuid4().hex[:8]}")
+        ssh_base = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        for host, port in set(hosts):
+            subprocess.run(ssh_base + ["-p", str(port), host,
+                                       f"mkdir -p {_shquote(stage)}"],
+                           check=True)
+            log_info("ship %d cached files -> %s:%s", len(cache), host, stage)
+            subprocess.run(["rsync", "-az", "-e", f"ssh -p {port}"] + cache
+                           + [f"{host}:{stage}/"], check=True)
+            for a in (getattr(args, "cache_archives", None) or []):
+                unpack = unpack_command(os.path.basename(a))
+                subprocess.run(ssh_base + ["-p", str(port), host,
+                                           f"cd {_shquote(stage)} && {unpack}"],
+                               check=True)
+        workdir = stage
+
     results = [0] * nproc
     threads = []
     for i in range(nproc):

@@ -18,6 +18,27 @@ __all__ = ["main", "submit"]
 
 def submit(argv: Optional[List[str]] = None) -> int:
     args = get_opts(argv)
+    fh = None
+    if args.log_file:
+        # mirror launcher logs to a file, stderr stays on (reference
+        # opts.py:98-100 --log-file); detached in the finally below so
+        # repeated submit() calls don't accumulate handlers/fds
+        import logging as _pylogging
+        from ...utils.logging import get_logger
+        fh = _pylogging.FileHandler(args.log_file)
+        fh.setFormatter(_pylogging.Formatter(
+            "[%(asctime)s] %(levelname)s %(message)s", "%H:%M:%S"))
+        get_logger().addHandler(fh)
+    try:
+        return _submit_job(args)
+    finally:
+        if fh is not None:
+            from ...utils.logging import get_logger
+            get_logger().removeHandler(fh)
+            fh.close()
+
+
+def _submit_job(args) -> int:
     # a single-host job must rendezvous over loopback: the auto-detected
     # "routable" address may not be reachable from inside sandboxes/netns
     host_ip = args.host_ip or ("127.0.0.1" if args.cluster == "local"

@@ -26,6 +26,14 @@ def job_env(args, tracker_envs: Dict[str, str], cluster: str) -> Dict[str, str]:
         "DMLC_NUM_SERVER": str(args.num_servers),
         "DMLC_JOB_CLUSTER": cluster,
         "DMLC_MAX_ATTEMPT": str(args.max_attempts),
+        # resource asks ride the env so role-aware runtimes can see them
+        # (reference forwards worker/server cores+memory per role,
+        # opts.py:85-90 → yarn AM container requests)
+        "DMLC_WORKER_CORES": str(getattr(args, "worker_cores", 1)),
+        "DMLC_WORKER_MEMORY_MB": str(getattr(args, "worker_memory_mb", 1024)),
+        "DMLC_SERVER_CORES": str(getattr(args, "server_cores", 1)),
+        "DMLC_SERVER_MEMORY_MB": str(getattr(args, "server_memory_mb", 1024)),
+        "DMLC_HDFS_TEMPDIR": str(getattr(args, "hdfs_tempdir", "/tmp")),
     })
     return env
 
@@ -58,23 +66,31 @@ def retry_loop(cmd: str, *, oneline: bool = False) -> str:
 
 
 def wrapper_body(args, tracker_envs: Dict[str, str], cluster: str,
-                 rank_snippet: str) -> str:
+                 rank_snippet: str, stage_mode: str = "copy") -> str:
     """Wrapper shell body: export the env contract, run ``rank_snippet``
-    (shell lines that must set ``DMLC_TASK_ID``), derive ``DMLC_ROLE`` from
-    the server split, then run the worker under :func:`retry_loop`.
+    (shell lines that must set ``DMLC_TASK_ID``), stage cached
+    files/archives (``filecache.stage_snippet``; ``stage_mode='cwd'`` when
+    the scheduler's own file cache already delivered them), derive
+    ``DMLC_ROLE`` from the server split, then run the worker under
+    :func:`retry_loop`.
 
     A missing, non-numeric, or out-of-range id fails fast with a clear
     message rather than joining the tracker with a bogus rank (in-place
     retry covers worker-process death; a scheduler that reschedules the
     whole task re-runs this wrapper and recovers through the same
     stable-id path)."""
+    from .filecache import stage_snippet
     exports = render_exports(job_env(args, tracker_envs, cluster))
     cmd = " ".join(shlex.quote(c) for c in args.command)
+    staging = stage_snippet(getattr(args, "cache_files", None) or [],
+                            getattr(args, "cache_archives", None) or [],
+                            mode=stage_mode)
     ns = args.num_servers
     nproc = args.num_workers + args.num_servers
     return f"""#!/bin/bash
 {exports}
 {rank_snippet}
+{staging}
 case "${{DMLC_TASK_ID}}" in
   (''|*[!0-9]*)
     echo "dmlc wrapper: task id '${{DMLC_TASK_ID}}' is not a number" >&2
@@ -94,9 +110,9 @@ fi
 
 
 def write_wrapper_script(args, tracker_envs: Dict[str, str], cluster: str,
-                         rank_snippet: str) -> str:
+                         rank_snippet: str, stage_mode: str = "copy") -> str:
     """Write :func:`wrapper_body` to an executable temp file."""
-    body = wrapper_body(args, tracker_envs, cluster, rank_snippet)
+    body = wrapper_body(args, tracker_envs, cluster, rank_snippet, stage_mode)
     fd, path = tempfile.mkstemp(prefix=f"dmlc_{cluster}_", suffix=".sh")
     with os.fdopen(fd, "w") as f:
         f.write(body)

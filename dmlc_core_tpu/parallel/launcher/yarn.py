@@ -40,7 +40,12 @@ export DMLC_TASK_ID="$((cid - 2))"'''
 
 def build_yarn_command(args, tracker_envs: Dict[str, str]) -> List[str]:
     """Generate the DistributedShell submission (one container per task)."""
-    script = write_wrapper_script(args, tracker_envs, "yarn", _RANK_SNIPPET)
+    # stage_mode='cwd': DistributedShell's own file cache (-shell_files)
+    # delivers cached files into the container cwd, so the wrapper only
+    # extracts archives (reference ships through the YARN file cache the
+    # same way, yarn.py:35-42)
+    script = write_wrapper_script(args, tracker_envs, "yarn", _RANK_SNIPPET,
+                                  stage_mode="cwd")
     nproc = args.num_workers + args.num_servers
     hadoop = os.environ.get("HADOOP_HOME", "")
     hadoop_bin = os.path.join(hadoop, "bin", "hadoop") if hadoop else "hadoop"
@@ -56,6 +61,10 @@ def build_yarn_command(args, tracker_envs: Dict[str, str]) -> List[str]:
         "-container_memory", str(args.worker_memory_mb),
         "-container_vcores", str(args.worker_cores),
     ]
+    cache = ((getattr(args, "cache_files", None) or [])
+             + (getattr(args, "cache_archives", None) or []))
+    if cache:
+        cmd += ["-shell_files", ",".join(cache)]
     if args.jobname:
         cmd += ["-appname", args.jobname]
     if args.yarn_queue:

@@ -211,9 +211,11 @@ class _FakeS3Handler(BaseHTTPRequestHandler):
 
 
 class _FakeWebHDFSHandler(BaseHTTPRequestHandler):
-    """Namenode that answers OPEN/CREATE with a datanode Location JSON (the
-    real two-step WebHDFS protocol); /data/ paths play the datanode role."""
-    files = {}    # "/path" -> bytes
+    """Namenode that answers OPEN/CREATE/APPEND with a datanode Location
+    JSON (the real two-step WebHDFS protocol); /data/ paths play the
+    datanode role."""
+    files = {}       # "/path" -> bytes
+    data_requests = []  # (method, path) seen by the fake datanode
 
     def log_message(self, *a):
         pass
@@ -276,12 +278,35 @@ class _FakeWebHDFSHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         if parsed.path.startswith("/data"):     # datanode write
+            self.data_requests.append(("PUT", parsed.path[len("/data"):]))
             self.files[parsed.path[len("/data"):]] = body
             self.send_response(201)
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
         # namenode CREATE: ignore any body, point at the datanode
+        path = parsed.path[len("/webhdfs/v1"):]
+        loc = f"http://127.0.0.1:{self._port()}/data{path}"
+        resp = json.dumps({"Location": loc}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(resp)))
+        self.end_headers()
+        self.wfile.write(resp)
+
+    def do_POST(self):
+        parsed = urllib.parse.urlparse(self.path)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if parsed.path.startswith("/data"):     # datanode append
+            path = parsed.path[len("/data"):]
+            self.data_requests.append(("POST", path))
+            self.files[path] = self.files.get(path, b"") + body
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        # namenode APPEND: point at the datanode
         path = parsed.path[len("/webhdfs/v1"):]
         loc = f"http://127.0.0.1:{self._port()}/data{path}"
         resp = json.dumps({"Location": loc}).encode()
@@ -323,6 +348,7 @@ def s3_server(monkeypatch):
 @pytest.fixture
 def hdfs_server():
     _FakeWebHDFSHandler.files = {}
+    _FakeWebHDFSHandler.data_requests = []
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeWebHDFSHandler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -544,6 +570,25 @@ def test_s3_endpoint_without_scheme(monkeypatch):
     monkeypatch.setenv("DMLC_S3_ENDPOINT", "localhost:9000")
     scheme, netloc, prefix = _S3Config().resolve("bkt")
     assert (scheme, netloc, prefix) == ("http", "localhost:9000", "/bkt")
+
+
+def test_webhdfs_streaming_write_appends(hdfs_server, monkeypatch):
+    """A write of 2.5 parts must stream as CREATE + APPENDs (>1 datanode
+    data request), never buffering the whole object (hdfs_filesys.cc:56-75
+    streams via hdfsWrite)."""
+    srv, h = hdfs_server
+    host = f"127.0.0.1:{srv.server_address[1]}"
+    monkeypatch.setenv("DMLC_WEBHDFS_PART_SIZE", "1024")
+    from dmlc_core_tpu.io import open_stream
+    payload = bytes(range(256)) * 10  # 2560 bytes = 2.5 parts
+    with open_stream(f"hdfs://{host}/out/big.bin", "w") as w:
+        mv = memoryview(payload)
+        for off in range(0, len(payload), 700):  # odd-sized writes
+            w.write(mv[off:off + 700])
+    assert h.files["/out/big.bin"] == payload
+    reqs = [r for r in h.data_requests if r[1] == "/out/big.bin"]
+    assert len(reqs) == 3                      # 1024 + 1024 + 512
+    assert reqs[0][0] == "PUT" and {r[0] for r in reqs[1:]} == {"POST"}
 
 
 def test_webhdfs_write(hdfs_server):
