@@ -66,6 +66,13 @@ class FileSystem:
         except (DMLCError, OSError):
             return False
 
+    def delete(self, uri: URI) -> None:
+        """Remove an object/file.  Net-new vs the reference FS contract
+        (`filesys.h:75-125` has no Delete) — object-store checkpoint
+        retention needs it; backends without it raise."""
+        raise DMLCError(f"delete not supported for scheme "
+                        f"{uri.protocol or 'local'!r}")
+
 
 def list_directory_recursive(fs: FileSystem, uri: URI) -> List[FileInfo]:
     """BFS recursive listing (reference ``ListDirectoryRecursive`` `filesys.cc:9-25`)."""
@@ -123,6 +130,12 @@ class LocalFileSystem(FileSystem):
             return open(path, mode + "b")
         except OSError as e:
             raise DMLCError(f"LocalFileSystem.open({path!r}, {mode!r}): {e}") from e
+
+    def delete(self, uri: URI) -> None:
+        try:
+            os.unlink(self._path(uri))
+        except OSError as e:
+            raise DMLCError(f"LocalFileSystem.delete: {e}") from e
 
     def glob(self, pattern: str) -> List[str]:
         """Wildcard expansion used by InputSplit URI handling

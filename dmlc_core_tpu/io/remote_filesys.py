@@ -449,6 +449,22 @@ class _S3WriteStream(io.RawIOBase):
             check(status == 200, f"CompleteMultipartUpload: HTTP {status}")
         super().close()
 
+    def abort(self) -> None:
+        """Abandon the write WITHOUT publishing: callers that hit an error
+        mid-write call this instead of close(), so a partial buffer never
+        becomes the object (AbortMultipartUpload when one is open)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._buf.clear()
+        if self._upload_id is not None:
+            try:
+                self._fs._request("DELETE", self._bucket, self._key,
+                                  {"uploadId": self._upload_id}, b"")
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        super().close()
+
 
 class S3FileSystem(FileSystem):
     """``s3://bucket/key`` object store (reference `s3_filesys.cc`)."""
@@ -508,6 +524,13 @@ class S3FileSystem(FileSystem):
         if infos:
             return FileInfo(path=uri.raw, size=0, type="dir")
         raise DMLCError(f"s3: no such object {uri.raw} (HTTP {status})")
+
+    def delete(self, uri: URI) -> None:
+        bucket, key = self._split(uri)
+        status, _, _ = self._request("DELETE", bucket, key, {}, b"")
+        # S3 DeleteObject: 204 on success (idempotent — deleting a missing
+        # key also returns 204)
+        check(status in (200, 204), f"s3 DELETE {uri.raw}: HTTP {status}")
 
     def list_directory(self, uri: URI) -> List[FileInfo]:
         bucket, key = self._split(uri)
@@ -675,6 +698,18 @@ class WebHDFSFileSystem(FileSystem):
         return [self._info_from_status(uri.raw, st.get("pathSuffix", ""), st)
                 for st in sts]
 
+    def rename(self, src: URI, dst: URI) -> None:
+        """``op=RENAME`` — atomic within HDFS (`FileSystem.rename`); the
+        publish step for write-to-temp checkpoint objects."""
+        status, _, _ = self._op(
+            src, "PUT", "RENAME",
+            {"destination": "/" + dst.name.lstrip("/")})
+        check(status == 200, f"webhdfs RENAME {src.raw}: HTTP {status}")
+
+    def delete(self, uri: URI) -> None:
+        status, _, _ = self._op(uri, "DELETE", "DELETE")
+        check(status == 200, f"webhdfs DELETE {uri.raw}: HTTP {status}")
+
     def open(self, uri: URI, mode: str) -> BinaryIO:
         if mode == "r":
             info = self.get_path_info(uri)
@@ -739,6 +774,17 @@ class _WebHDFSWriteStream(io.BufferedIOBase):
         elif status in (200, 201, 204):
             status, _, _ = self._fs._op(self._uri, "POST", "APPEND", {}, data)
         check(status in (200, 201, 204), f"webhdfs APPEND: HTTP {status}")
+
+    def abort(self) -> None:
+        """Drop buffered bytes and close without flushing.  NOTE: parts
+        already APPENDed are visible at the target path (WebHDFS has no
+        upload session) — atomic publish over hdfs:// therefore needs
+        write-to-temp + :meth:`WebHDFSFileSystem.rename`, which is what
+        the checkpoint layer does."""
+        if not self.closed:
+            self._buf = bytearray()
+            self._created = True    # suppress the empty-file CREATE
+            super().close()
 
     def close(self) -> None:
         if not self.closed:

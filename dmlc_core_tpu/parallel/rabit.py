@@ -115,11 +115,17 @@ class RabitContext:
 
     @classmethod
     def from_env(cls, **kw) -> "RabitContext":
-        """Bootstrap from the DMLC_* env contract (reference `local.py:21-27`)."""
+        """Bootstrap from the DMLC_* env contract (reference `local.py:21-27`).
+        ``DMLC_CONNECT_TIMEOUT``/``DMLC_RECOVER_TIMEOUT`` (seconds) tune the
+        link/recovery deadlines without code changes."""
         uri = get_env("DMLC_TRACKER_URI", "127.0.0.1")
         port = get_env("DMLC_TRACKER_PORT", 9091)
         jobid = os.environ.get("DMLC_TASK_ID")
         attempt = get_env("DMLC_NUM_ATTEMPT", 0)
+        kw.setdefault("connect_timeout",
+                      get_env("DMLC_CONNECT_TIMEOUT", 60.0))
+        kw.setdefault("recover_timeout",
+                      get_env("DMLC_RECOVER_TIMEOUT", 120.0))
         return cls(uri, port, jobid=jobid, recover=attempt > 0, **kw)
 
     # -- rendezvous --
@@ -426,6 +432,28 @@ class RabitContext:
     @property
     def version_number(self) -> int:
         return getattr(self, "_version", 0)
+
+    @property
+    def seq(self) -> int:
+        """Collective sequence counter — persist it with externally-stored
+        state (CheckpointManager over s3://…) so a worker reborn on a
+        DIFFERENT host (node replacement: local disk gone, so
+        :meth:`load_checkpoint` has nothing) can :meth:`resume_seq` into
+        lock-step with survivors."""
+        return self._seq
+
+    def resume_seq(self, seq: int) -> None:
+        """Fast-forward the sequence counter after restoring app state from
+        a durable checkpoint — the external-store analog of
+        :meth:`load_checkpoint`'s seq restore.  Only valid before the first
+        post-restart collective; without it a reborn worker's first frame
+        trips the survivors' out-of-sync guard and the whole cohort falls
+        back to checkpoint-restart (safe, but a full-job bounce)."""
+        if self._seq != 0:
+            raise DMLCError(
+                f"resume_seq after {self._seq} collectives — call it "
+                f"immediately after restore, before any allreduce")
+        self._seq = int(seq)
 
     # -- misc rabit API --
     def tracker_print(self, msg: str) -> None:

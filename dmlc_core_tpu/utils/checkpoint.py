@@ -200,43 +200,192 @@ def load_pytree(stream, template: Any = None) -> Any:
 # checkpoint manager
 # ---------------------------------------------------------------------------
 
+class _LocalStore:
+    """POSIX directory backend: temp file + fsync + rename = atomic publish."""
+
+    def __init__(self, directory: str) -> None:
+        self.base = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def url(self, name: str) -> str:
+        return os.path.join(self.base, name)
+
+    def names(self) -> List[str]:
+        return os.listdir(self.base)
+
+    def read_bytes(self, name: str) -> Optional[bytes]:
+        try:
+            with open(self.url(name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def open_read(self, name: str):
+        try:
+            return open(self.url(name), "rb")
+        except FileNotFoundError as e:
+            raise DMLCError(f"checkpoint object missing: {self.url(name)}"
+                            ) from e
+
+    def write_stream(self, name: str, write_fn) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.base, prefix=f".{name}-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                write_fn(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.url(name))       # atomic publish
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def delete(self, name: str) -> None:
+        try:
+            os.unlink(self.url(name))
+        except OSError:
+            pass
+
+
+class _RemoteStore:
+    """Object-store backend over the filesystem layer (s3://, hdfs://, …).
+
+    Atomicity comes from the store itself: a PUT (or the multipart
+    complete) publishes the whole object at close or not at all, so no
+    temp+rename dance is needed (reference gets the same property from
+    `s3_filesys.cc` CompleteMultipartUpload)."""
+
+    def __init__(self, base_uri: str) -> None:
+        self.base = base_uri.rstrip("/")
+
+    def url(self, name: str) -> str:
+        return f"{self.base}/{name}"
+
+    def _fs(self):
+        from ..io.filesys import get_filesystem
+        from ..io.uri import URI
+        return get_filesystem(URI(self.base)), URI
+
+    @staticmethod
+    def _is_missing(e: DMLCError) -> bool:
+        """'object not found' vs transient backend error.  Only a definite
+        not-found may be treated as an empty slot — a 500/timeout must
+        propagate, otherwise one S3 blip during save() would rebuild the
+        manifest as empty and orphan every prior checkpoint."""
+        msg = str(e)
+        return "404" in msg or "no such" in msg.lower()
+
+    def names(self) -> List[str]:
+        fs, URI = self._fs()
+        try:
+            infos = fs.list_directory(URI(self.base))
+        except DMLCError as e:
+            if self._is_missing(e):
+                return []           # prefix not created yet: empty store
+            raise
+        return [i.path.rstrip("/").rsplit("/", 1)[-1] for i in infos]
+
+    def read_bytes(self, name: str) -> Optional[bytes]:
+        fs, URI = self._fs()
+        uri = URI(self.url(name))
+        try:
+            fs.get_path_info(uri)
+        except DMLCError as e:
+            if self._is_missing(e):
+                return None
+            raise
+        with fs.open(uri, "r") as f:
+            return f.read()
+
+    def open_read(self, name: str):
+        fs, URI = self._fs()
+        return fs.open(URI(self.url(name)), "r")
+
+    def write_stream(self, name: str, write_fn) -> None:
+        """Atomic publish on an object store: stores whose PUT/multipart-
+        complete lands whole-object-or-nothing at close write the final
+        name directly; a mid-write failure skips close so nothing is
+        published (plus best-effort abort).  Stores with rename (WebHDFS)
+        write a temp name and rename, since their appends are visible
+        immediately."""
+        fs, URI = self._fs()
+        rename = getattr(fs, "rename", None)
+        target = self.url(name)
+        from uuid import uuid4
+        wire = (f"{target}.tmp-{uuid4().hex[:8]}" if rename else target)
+        f = fs.open(URI(wire), "w")
+        try:
+            write_fn(f)
+        except BaseException:
+            abort = getattr(f, "abort", None)
+            if abort is not None:
+                abort()             # no close → nothing published
+            if rename:
+                try:
+                    f.close()
+                    fs.delete(URI(wire))
+                except DMLCError:
+                    pass
+            raise
+        f.close()
+        if rename:
+            rename(URI(wire), URI(target))
+
+    def delete(self, name: str) -> None:
+        fs, URI = self._fs()
+        try:
+            fs.delete(URI(self.url(name)))
+        except DMLCError as e:
+            # backend without delete: retention leaves orphans (logged) —
+            # the manifest no longer references them so restores are safe
+            log_info("checkpoint: could not prune %s (%s)", name, e)
+
+
 class CheckpointManager:
     """Versioned checkpoints with atomic publish and bounded retention.
 
-    Directory layout::
+    ``directory`` may be a local path or any URI the filesystem layer can
+    write (``s3://bucket/run1``, ``hdfs://nn:9870/ckpt``, …) — distributed
+    jobs checkpoint straight to the object store, the TPU-native analog of
+    the reference pushing rabit checkpoints over hdfs.
+
+    Layout::
 
         <dir>/ckpt-<step>.bin     one pytree per step
         <dir>/MANIFEST.json       {"latest": step, "steps": [...], "meta": {}}
 
-    ``save`` writes to a temp file in the same directory then ``os.rename``s
-    (atomic on POSIX), then rewrites the manifest — a crash mid-save leaves
-    the previous checkpoint fully intact (the property the reference gets
-    from rebuildable cache files, `disk_row_iter.h:95-108`).
+    ``save`` publishes atomically (temp+fsync+rename locally; whole-object
+    PUT on object stores), then rewrites the manifest — a crash mid-save
+    leaves the previous checkpoint fully intact (the property the reference
+    gets from rebuildable cache files, `disk_row_iter.h:95-108`).
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3) -> None:
         self.dir = directory
         self.max_to_keep = max_to_keep
-        os.makedirs(directory, exist_ok=True)
+        self._store = (_RemoteStore(directory) if "://" in directory
+                       else _LocalStore(directory))
+
+    def _name(self, step: int) -> str:
+        return f"ckpt-{step}.bin"
 
     def _path(self, step: int) -> str:
-        return os.path.join(self.dir, f"ckpt-{step}.bin")
-
-    def _manifest_path(self) -> str:
-        return os.path.join(self.dir, "MANIFEST.json")
+        return self._store.url(self._name(step))
 
     def _read_manifest(self) -> Dict[str, Any]:
-        try:
-            with open(self._manifest_path()) as f:
-                return json_loads(f.read())
-        except FileNotFoundError:
+        raw = self._store.read_bytes("MANIFEST.json")
+        if raw is None:
             return {"latest": None, "steps": [], "meta": {}}
+        try:
+            return json_loads(raw.decode())
         except ValueError:
-            # truncated/corrupt manifest (crash mid-publish): the fsynced
+            # truncated/corrupt manifest (crash mid-publish): the published
             # ckpt files are the source of truth — rebuild from them
             steps = sorted(
                 int(f[len("ckpt-"):-len(".bin")])
-                for f in os.listdir(self.dir)
+                for f in self._store.names()
                 if f.startswith("ckpt-") and f.endswith(".bin")
                 and f[len("ckpt-"):-len(".bin")].isdigit())
             log_info("checkpoint: manifest corrupt, rebuilt from %d files",
@@ -245,12 +394,8 @@ class CheckpointManager:
                     "steps": steps, "meta": {}}
 
     def _write_manifest(self, m: Dict[str, Any]) -> None:
-        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".manifest-")
-        with os.fdopen(fd, "w") as f:
-            f.write(json_dumps(m))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._manifest_path())
+        blob = json_dumps(m).encode()
+        self._store.write_stream("MANIFEST.json", lambda f: f.write(blob))
 
     @property
     def steps(self) -> List[int]:
@@ -263,19 +408,8 @@ class CheckpointManager:
     def save(self, step: int, state: Any,
              meta: Optional[Dict[str, Any]] = None) -> str:
         check(step >= 0, "checkpoint step must be >= 0")
-        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=f".ckpt-{step}-")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                save_pytree(f, state)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._path(step))       # atomic publish
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        self._store.write_stream(self._name(step),
+                                 lambda f: save_pytree(f, state))
         m = self._read_manifest()
         if step not in m["steps"]:
             m["steps"] = sorted(m["steps"] + [step])
@@ -292,10 +426,7 @@ class CheckpointManager:
             dropped.append(drop)
         self._write_manifest(m)
         for drop in dropped:
-            try:
-                os.unlink(self._path(drop))
-            except OSError:
-                pass
+            self._store.delete(self._name(drop))
         log_info("checkpoint: saved step %d -> %s", step, self._path(step))
         return self._path(step)
 
@@ -312,8 +443,8 @@ class CheckpointManager:
         check(step in m["steps"], f"no checkpoint for step {step}; "
                                   f"have {m['steps']}")
         try:
-            f = open(self._path(step), "rb")
-        except FileNotFoundError as e:
+            f = self._store.open_read(self._name(step))
+        except DMLCError as e:
             raise DMLCError(
                 f"checkpoint file for step {step} is missing "
                 f"({self._path(step)}) — manifest and directory disagree "

@@ -104,3 +104,52 @@ def test_failure_injection_midjob_crash_and_second_allreduce(tmp_path):
     assert "MIDJOB-CRASH" in out.stdout
     assert out.stdout.count("SECOND-OK") == 3
     assert "attempt 1" in out.stdout          # the reborn worker finished
+
+
+def test_checkpoint_resume_after_midjob_kill_converges(tmp_path):
+    """VERDICT r2 #9 e2e: a worker is killed mid-job (survivors are already
+    blocked inside the next allreduce), the launcher restarts it, it resumes
+    from its durable CheckpointManager state (not from step 0), and the
+    cohort converges to the optimum."""
+    script = tmp_path / "train_resume.py"
+    script.write_text(
+        "import os, sys\n"
+        "import numpy as np\n"
+        "from dmlc_core_tpu.parallel import RabitContext\n"
+        "from dmlc_core_tpu.utils.checkpoint import CheckpointManager\n"
+        "ctx = RabitContext.from_env()\n"
+        "att = int(os.environ.get('DMLC_NUM_ATTEMPT', '0'))\n"
+        "mgr = CheckpointManager(\n"
+        "    os.environ['CKPT_DIR'] + f'/rank{ctx.rank}', max_to_keep=2)\n"
+        "start, w = 0, np.zeros(1)\n"
+        "if att > 0 and mgr.latest_step is not None:\n"
+        "    s, state = mgr.restore()\n"
+        "    start, w = s + 1, state['w']\n"
+        "    ctx.resume_seq(state['seq'])\n"
+        "    print('RESUMED rank', ctx.rank, 'from step', s, flush=True)\n"
+        "target = 3.0\n"
+        "for step in range(start, 10):\n"
+        "    g = ctx.allreduce(w - target) / ctx.world_size\n"
+        "    w = w - 0.5 * g\n"
+        "    mgr.save(step, {'w': w, 'seq': ctx.seq})\n"
+        "    if ctx.rank == 1 and att == 0 and step == 5:\n"
+        "        print('KILLED-MIDJOB', flush=True)\n"
+        "        os._exit(1)\n"
+        "final = ctx.allreduce(w) / ctx.world_size\n"
+        "assert abs(final[0] - target) < 0.1, final\n"
+        "print('CONVERGED rank', ctx.rank, 'attempt', att,\n"
+        "      float(final[0]), flush=True)\n"
+        "ctx.shutdown()\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
+         "--cluster", "local", "-n", "3", "--max-attempts", "4",
+         "--env", f"PYTHONPATH={REPO}",
+         "--env", f"CKPT_DIR={tmp_path}",
+         "--env", "DMLC_RECOVER_TIMEOUT=30",
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-3000:])
+    assert "KILLED-MIDJOB" in out.stdout
+    assert out.stdout.count("CONVERGED") == 3
+    assert "RESUMED rank 1" in out.stdout

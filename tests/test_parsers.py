@@ -184,3 +184,38 @@ def test_native_float_leading_zeros_and_line_endings():
     d = native.parse_csv(b"1,2.5,3\r\n0,1.5,4\r\n", 0, ",", 1)
     assert list(d["labels"]) == [1.0, 0.0]
     assert list(d["values"]) == [2.5, 3.0, 1.5, 4.0]
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib not built")
+@pytest.mark.parametrize("fmt,gen", [
+    ("libsvm", lambda i, rng: " ".join(
+        f"{j}:{rng.random()*10:.6f}"
+        for j in sorted(rng.choice(10000, size=int(rng.integers(0, 15)),
+                                   replace=False).tolist()))),
+    ("libfm", lambda i, rng: " ".join(
+        f"{int(rng.integers(0, 30))}:{j}:{rng.random():.4f}"
+        for j in sorted(rng.choice(10000, size=int(rng.integers(0, 10)),
+                                   replace=False).tolist()))),
+])
+def test_multithread_parse_equivalence(fmt, gen):
+    """VERDICT r2 #7: the OpenMP chunk-cut + merge path (nthreads=4) must
+    produce output identical to the sequential path (nthreads=1) — row
+    order, offsets, values, per-value fields — on data large enough that
+    every thread really owns a chunk (reference `text_parser.h:100-115`)."""
+    rng = np.random.default_rng(42)
+    lines = []
+    for i in range(4000):
+        label = int(rng.integers(0, 2))
+        feats = gen(i, rng)
+        lines.append(f"{label} {feats}" if feats else f"{label}")
+    data = ("\n".join(lines) + "\n").encode()
+    kernel = getattr(native, f"parse_{fmt}")
+    a = kernel(data, nthreads=1)
+    b = kernel(data, nthreads=4)
+    for key in ("offsets", "indices", "labels"):
+        np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+    np.testing.assert_allclose(a["values"], b["values"], rtol=0)
+    if fmt == "libfm":
+        np.testing.assert_array_equal(a["fields"], b["fields"])
+    assert a["bad_lines"] == b["bad_lines"]
+    assert len(a["offsets"]) == 4001

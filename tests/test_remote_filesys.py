@@ -181,6 +181,17 @@ class _FakeS3Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def do_DELETE(self):
+        self._record_auth()
+        q = self._query()
+        if "uploadId" in q:             # AbortMultipartUpload
+            self.uploads.pop(q["uploadId"], None)
+        else:
+            self.objects.pop(self._obj_key(), None)
+        self.send_response(204)         # S3 DeleteObject is idempotent
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def do_POST(self):
         self._record_auth()
         q = self._query()
@@ -284,12 +295,34 @@ class _FakeWebHDFSHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
-        # namenode CREATE: ignore any body, point at the datanode
+        # namenode: RENAME is answered inline; CREATE points at the datanode
         path = parsed.path[len("/webhdfs/v1"):]
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        if q.get("op") == "RENAME":
+            dest = q.get("destination", "")
+            ok = path in self.files
+            if ok:
+                self.files[dest] = self.files.pop(path)
+            resp = json.dumps({"boolean": ok}).encode()
+            self.send_response(200 if ok else 404)
+            self.send_header("Content-Length", str(len(resp)))
+            self.end_headers()
+            self.wfile.write(resp)
+            return
         loc = f"http://127.0.0.1:{self._port()}/data{path}"
         resp = json.dumps({"Location": loc}).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(resp)))
+        self.end_headers()
+        self.wfile.write(resp)
+
+    def do_DELETE(self):
+        parsed = urllib.parse.urlparse(self.path)
+        path = parsed.path[len("/webhdfs/v1"):]
+        existed = self.files.pop(path, None) is not None
+        resp = json.dumps({"boolean": existed}).encode()
+        self.send_response(200)
         self.send_header("Content-Length", str(len(resp)))
         self.end_headers()
         self.wfile.write(resp)
@@ -622,3 +655,45 @@ def test_fscli_ls_cat_cp_stat(tmp_path, capsys, s3_server):
 
     # bad URI → rc 1, no traceback
     assert main(["stat", "file:///definitely/not/there"]) == 1
+
+
+def test_checkpoint_manager_over_s3(s3_server):
+    """VERDICT r2 #9: CheckpointManager against an object store — save,
+    retention pruning via DELETE, manifest round-trip, restore latest."""
+    import numpy as np
+    from dmlc_core_tpu.utils.checkpoint import CheckpointManager
+    srv, h = s3_server
+    mgr = CheckpointManager("s3://ckpts/run1", max_to_keep=2)
+    for step in range(4):
+        mgr.save(step, {"w": np.full(8, float(step), np.float32)},
+                 meta={"loss": 1.0 / (step + 1)})
+    assert mgr.steps == [2, 3]
+    assert "ckpts/run1/ckpt-0.bin" not in h.objects       # pruned via DELETE
+    assert "ckpts/run1/ckpt-3.bin" in h.objects
+    assert "ckpts/run1/MANIFEST.json" in h.objects
+    step, state = mgr.restore()
+    assert step == 3
+    np.testing.assert_array_equal(state["w"], np.full(8, 3.0, np.float32))
+    assert mgr.meta(3)["loss"] == 0.25
+    # a second manager over the same prefix sees the same history
+    mgr2 = CheckpointManager("s3://ckpts/run1", max_to_keep=2)
+    assert mgr2.latest_step == 3
+
+
+def test_checkpoint_manager_over_webhdfs_rename_publish(hdfs_server):
+    """hdfs:// checkpoints publish via write-to-temp + RENAME (appends are
+    visible mid-write on WebHDFS, so direct writes would expose partials)."""
+    import numpy as np
+    from dmlc_core_tpu.utils.checkpoint import CheckpointManager
+    srv, h = hdfs_server
+    host = f"127.0.0.1:{srv.server_address[1]}"
+    mgr = CheckpointManager(f"hdfs://{host}/ck/run", max_to_keep=2)
+    for step in range(3):
+        mgr.save(step, {"w": np.full(4, float(step), np.float32)})
+    assert mgr.steps == [1, 2]
+    step, state = mgr.restore()
+    assert step == 2
+    np.testing.assert_array_equal(state["w"], np.full(4, 2.0, np.float32))
+    # no temp objects left behind, pruned step deleted
+    assert set(h.files) == {"/ck/run/ckpt-1.bin", "/ck/run/ckpt-2.bin",
+                            "/ck/run/MANIFEST.json"}
