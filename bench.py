@@ -236,13 +236,15 @@ def main() -> None:
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.join(REPO, ".jax_cache"))
     gen_data()
-    base1 = measure_reference()
-    if not probe_tpu():
-        if os.environ.get("DMLC_REQUIRE_TPU") == "1":
-            # retry-loop mode: don't burn the host on a CPU fallback run,
-            # let the caller try again when the tunnel frees up
+    require_tpu = os.environ.get("DMLC_REQUIRE_TPU") == "1"
+    if require_tpu:
+        # retry-loop mode: probe FIRST so a busy tunnel costs no CPU (the
+        # baseline build+run is a minute of single-core time per attempt)
+        if not probe_tpu():
             log("DMLC_REQUIRE_TPU=1 and no TPU → exiting 9")
             sys.exit(9)
+    base1 = measure_reference()
+    if not require_tpu and not probe_tpu():
         force_cpu()
     value = measure_ours()
     # the shared host's speed drifts minute-to-minute: re-measure the

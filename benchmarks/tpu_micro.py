@@ -94,10 +94,15 @@ def main() -> int:
             ids = jax.random.randint(key, (rows, k), 0, vocab, jnp.int32)
             vals = jnp.ones((rows, k), jnp.float32)
             ref = jax.jit(embed_bag_reference)
-            t_ref = timed(ref, table, ids, vals)
+            t_ref = timed(ref, ids, vals, table)
             try:
                 pal = jax.jit(embed_bag_pallas)
-                t_pal = timed(pal, table, ids, vals)
+                # correctness before speed: the kernel must match XLA on
+                # the same inputs before its timing means anything
+                np.testing.assert_allclose(
+                    np.asarray(pal(ids, vals, table)),
+                    np.asarray(ref(ids, vals, table)), rtol=2e-4, atol=2e-4)
+                t_pal = timed(pal, ids, vals, table)
             except Exception as e:  # mosaic compile failure etc.
                 t_pal = None
                 log(f"pallas K={k} failed: {type(e).__name__}: {e}")

@@ -19,11 +19,26 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.csr import csr_dense_matvec, csr_embed_sum, fm_pairwise
+from ..ops.pallas_embed import embed_bag
 
 __all__ = ["SparseLogReg", "FactorizationMachine", "weighted_bce",
            "weighted_mse"]
 
 Params = Dict[str, jax.Array]
+
+
+def _is_rowmajor(batch: Dict[str, jax.Array]) -> bool:
+    """Both batch layouts are first-class: flat CSR (``ids[nnz]`` +
+    ``segments``) feeds the XLA segment-sum ops; row-padded ``ids[B,K]``
+    (``DeviceLoader(layout='rowmajor')``) feeds the Pallas embedding-bag
+    kernel."""
+    return batch["ids"].ndim == 2
+
+
+def _rowmajor_matvec(batch: Dict[str, jax.Array], w: jax.Array) -> jax.Array:
+    # per-row sparse dot with a 1-D weight vector: the gather is [B,K] —
+    # tiny next to the factor table — so XLA handles it on every engine
+    return jnp.einsum("bk,bk->b", batch["vals"], w[batch["ids"]])
 
 
 def weighted_bce(logits: jax.Array, labels: jax.Array,
@@ -45,8 +60,9 @@ def weighted_mse(pred: jax.Array, labels: jax.Array,
 
 
 class SparseLogReg:
-    """w·x + b over flat-CSR batches (the reference ecosystem's canonical
-    linear-model consumer — xgboost/mxnet read RowBlocks the same way)."""
+    """w·x + b over flat-CSR or rowmajor batches (the reference ecosystem's
+    canonical linear-model consumer — xgboost/mxnet read RowBlocks the same
+    way)."""
 
     def __init__(self, num_features: int, l2: float = 0.0):
         self.num_features = num_features
@@ -59,6 +75,8 @@ class SparseLogReg:
         }
 
     def forward(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
+        if _is_rowmajor(batch):
+            return _rowmajor_matvec(batch, params["w"]) + params["b"]
         num_rows = batch["labels"].shape[0]
         z = csr_dense_matvec(batch["ids"], batch["vals"], batch["segments"],
                              params["w"], num_rows)
@@ -79,12 +97,14 @@ class FactorizationMachine:
     """
 
     def __init__(self, num_features: int, dim: int = 16, l2: float = 0.0,
-                 init_scale: float = 0.01, task: str = "binary"):
+                 init_scale: float = 0.01, task: str = "binary",
+                 engine: str = "auto"):
         self.num_features = num_features
         self.dim = dim
         self.l2 = l2
         self.init_scale = init_scale
         self.task = task
+        self.engine = engine
 
     def init(self, rng: jax.Array) -> Params:
         return {
@@ -95,6 +115,16 @@ class FactorizationMachine:
         }
 
     def forward(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
+        if _is_rowmajor(batch):
+            # the factor-table gathers are the hot op: route them through
+            # the engine-dispatching embedding bag (pallas kernel on TPU)
+            linear = _rowmajor_matvec(batch, params["w"])
+            s1 = embed_bag(batch["ids"], batch["vals"], params["v"],
+                           engine=self.engine)
+            s2 = embed_bag(batch["ids"], batch["vals"] * batch["vals"],
+                           params["v"], engine=self.engine, square=True)
+            pair = 0.5 * jnp.sum(s1 * s1 - s2, axis=-1)
+            return params["w0"] + linear + pair
         num_rows = batch["labels"].shape[0]
         linear = csr_dense_matvec(batch["ids"], batch["vals"],
                                   batch["segments"], params["w"], num_rows)

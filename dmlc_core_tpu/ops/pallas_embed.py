@@ -25,16 +25,80 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["embed_bag_pallas", "embed_bag_reference"]
+__all__ = ["embed_bag", "embed_bag_pallas", "embed_bag_reference"]
 
 
-def embed_bag_reference(ids: jax.Array, vals: jax.Array,
-                        table: jax.Array) -> jax.Array:
-    """XLA reference semantics: out[b] = Σ_k vals[b,k] · table[ids[b,k]]."""
-    return jnp.einsum("bk,bkd->bd", vals, table[ids])
+def embed_bag(ids: jax.Array, vals: jax.Array, table: jax.Array,
+              engine: str = "auto", square: bool = False) -> jax.Array:
+    """Engine-dispatching weighted embedding bag over row-padded [B,K]
+    batches (``pipeline.packing.pack_rowmajor``):
+    ``out[b] = Σ_k vals[b,k] · f(table[ids[b,k]])`` with ``f = x²`` when
+    ``square`` (the FM second-order term needs Σ v²x² — squaring the
+    *gathered* rows inside the kernel, never the whole [F,D] table).
+
+    ``engine``:
+      * ``"xla"``     — gather + einsum (reference semantics, any backend)
+      * ``"pallas"``  — the DMA double-buffered kernel; on non-TPU backends
+        runs ``interpret=True`` (slow, for tests)
+      * ``"auto"``    — pallas on TPU, xla elsewhere
+
+    Differentiable w.r.t. ``vals`` and ``table`` on every engine: the
+    pallas forward carries a custom VJP whose backward is plain XLA
+    (gather + scatter-add), since Mosaic kernels have no autodiff rules.
+    """
+    if engine == "auto":
+        engine = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if engine == "xla":
+        return embed_bag_reference(ids, vals, table, square=square)
+    if engine == "pallas":
+        return _embed_bag_pallas_diff(
+            ids, vals, table, square,
+            interpret=jax.default_backend() != "tpu")
+    raise ValueError(f"unknown embed engine {engine!r}")
 
 
-def _kernel(ids_ref, vals_ref, table_ref, out_ref, buf, sems, *, K: int, D: int):
+def _embed_bag_pallas_diff(ids: jax.Array, vals: jax.Array, table: jax.Array,
+                           square: bool, interpret: bool) -> jax.Array:
+    """Pallas forward + XLA backward.  The custom_vjp closes over ``ids``
+    (integer — no tangent), so the differentiable surface is exactly
+    (vals, table)."""
+
+    @jax.custom_vjp
+    def f(vals, table):
+        return embed_bag_pallas(ids, vals, table, square=square,
+                                interpret=interpret)
+
+    def fwd(vals, table):
+        return f(vals, table), (vals, table)
+
+    def bwd(res, g):                       # g: [B, D]
+        vals, table = res
+        gathered = table[ids]              # [B, K, D] — backward-only
+        t = gathered * gathered if square else gathered
+        dvals = jnp.einsum("bd,bkd->bk", g, t)
+        coeff = (2.0 * vals[..., None] * gathered if square
+                 else vals[..., None])
+        drows = coeff * g[:, None, :]      # [B, K, D]
+        dtable = jnp.zeros_like(table).at[ids.reshape(-1)].add(
+            drows.reshape(-1, table.shape[1]))
+        return dvals, dtable
+
+    f.defvjp(fwd, bwd)
+    return f(vals, table)
+
+
+def embed_bag_reference(ids: jax.Array, vals: jax.Array, table: jax.Array,
+                        square: bool = False) -> jax.Array:
+    """XLA reference semantics: out[b] = Σ_k vals[b,k] · f(table[ids[b,k]])
+    with f = x² when ``square`` (squares the GATHERED [B,K,D] rows only)."""
+    g = table[ids]
+    if square:
+        g = g * g
+    return jnp.einsum("bk,bkd->bd", vals, g)
+
+
+def _kernel(ids_ref, vals_ref, table_ref, out_ref, buf, sems, *, K: int,
+            D: int, square: bool):
     b = pl.program_id(0)
 
     def row_copy(k, slot):
@@ -54,14 +118,18 @@ def _kernel(ids_ref, vals_ref, table_ref, out_ref, buf, sems, *, K: int, D: int)
             row_copy(k + 1, nxt_slot).start()
 
         row_copy(k, slot).wait()
-        return acc + buf[slot, 0, :] * vals_ref[0, k]
+        row = buf[slot, 0, :]
+        if square:                      # static: traced once per variant
+            row = row * row
+        return acc + row * vals_ref[0, k]
 
     acc = jax.lax.fori_loop(0, K, body, jnp.zeros((D,), jnp.float32))
     out_ref[0, :] = acc
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("square", "interpret"))
 def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
+                     square: bool = False,
                      interpret: bool = False) -> jax.Array:
     """Double-buffered DMA embedding bag.  ids,vals: [B,K]; table: [F,D] → [B,D]."""
     B, K = ids.shape
@@ -79,7 +147,7 @@ def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    kernel = functools.partial(_kernel, K=K, D=D)
+    kernel = functools.partial(_kernel, K=K, D=D, square=square)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
