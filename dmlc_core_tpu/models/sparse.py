@@ -19,7 +19,6 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.csr import csr_dense_matvec, csr_embed_sum, fm_pairwise
-from ..ops.pallas_embed import embed_bag
 
 __all__ = ["SparseLogReg", "FactorizationMachine", "weighted_bce",
            "weighted_mse"]
@@ -116,13 +115,13 @@ class FactorizationMachine:
 
     def forward(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
         if _is_rowmajor(batch):
-            # the factor-table gathers are the hot op: route them through
-            # the engine-dispatching embedding bag (pallas kernel on TPU)
+            # the factor-table gathers are the hot op: one fused kernel
+            # yields BOTH FM reductions per gathered row (pallas on TPU);
+            # imported lazily so flat-CSR users never touch pallas machinery
+            from ..ops.pallas_embed import fm_embed_terms
             linear = _rowmajor_matvec(batch, params["w"])
-            s1 = embed_bag(batch["ids"], batch["vals"], params["v"],
-                           engine=self.engine)
-            s2 = embed_bag(batch["ids"], batch["vals"] * batch["vals"],
-                           params["v"], engine=self.engine, square=True)
+            s1, s2 = fm_embed_terms(batch["ids"], batch["vals"],
+                                    params["v"], engine=self.engine)
             pair = 0.5 * jnp.sum(s1 * s1 - s2, axis=-1)
             return params["w0"] + linear + pair
         num_rows = batch["labels"].shape[0]

@@ -38,6 +38,7 @@ class _CSRBlockC(ctypes.Structure):
         ("max_index", ctypes.c_uint64),
         ("max_field", ctypes.c_uint32),
         ("bad_lines", ctypes.c_int64),
+        ("owner", ctypes.c_void_p),   # nt=1 zero-copy adoption handle
     ]
 
 

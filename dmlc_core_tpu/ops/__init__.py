@@ -8,7 +8,8 @@ from .csr import csr_dense_matvec, csr_embed_sum, fm_pairwise  # noqa: F401
 # would shadow the function). Import them from the submodule:
 #   from dmlc_core_tpu.ops.ring_attention import ring_attention
 __all__ = ["csr_dense_matvec", "csr_embed_sum", "fm_pairwise",
-           "embed_bag_pallas", "embed_bag_reference",
+           "embed_bag", "embed_bag_pallas", "embed_bag_reference",
+           "fm_embed_terms",
            "make_ring_attention", "reference_attention",
            "make_ulysses_attention"]
 
@@ -18,7 +19,9 @@ def __getattr__(name):
     # needed for the pure-XLA paths
     import importlib
     lazy = {
+        "embed_bag": "pallas_embed",
         "embed_bag_pallas": "pallas_embed",
+        "fm_embed_terms": "pallas_embed",
         "embed_bag_reference": "pallas_embed",
         "make_ring_attention": "ring_attention",
         "reference_attention": "ring_attention",

@@ -25,7 +25,41 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["embed_bag", "embed_bag_pallas", "embed_bag_reference"]
+__all__ = ["embed_bag", "embed_bag_pallas", "embed_bag_reference",
+           "fm_embed_terms"]
+
+_pallas_ok_cache: dict = {}
+
+
+def _pallas_supported(D: int) -> bool:
+    """One tiny eager compile per embedding width: if Mosaic rejects this
+    lowering (un-validated D, driver quirks), dispatch falls back to XLA
+    instead of aborting the whole jitted train step at compile time."""
+    ok = _pallas_ok_cache.get(D)
+    if ok is None:
+        try:
+            ids = jnp.zeros((2, 2), jnp.int32)
+            vals = jnp.ones((2, 2), jnp.float32)
+            table = jnp.ones((4, D), jnp.float32)
+            jax.block_until_ready(embed_bag_pallas(ids, vals, table))
+            ok = True
+        except Exception as e:  # noqa: BLE001 — mosaic compile failure etc.
+            import warnings
+            warnings.warn(f"pallas embed_bag unavailable for D={D} "
+                          f"({type(e).__name__}: {e}); using XLA path")
+            ok = False
+        _pallas_ok_cache[D] = ok
+    return ok
+
+
+def _resolve_engine(engine: str, D: int) -> str:
+    if engine == "auto":
+        if jax.default_backend() == "tpu" and _pallas_supported(D):
+            return "pallas"
+        return "xla"
+    if engine not in ("xla", "pallas"):
+        raise ValueError(f"unknown embed engine {engine!r}")
+    return engine
 
 
 def embed_bag(ids: jax.Array, vals: jax.Array, table: jax.Array,
@@ -46,15 +80,52 @@ def embed_bag(ids: jax.Array, vals: jax.Array, table: jax.Array,
     pallas forward carries a custom VJP whose backward is plain XLA
     (gather + scatter-add), since Mosaic kernels have no autodiff rules.
     """
-    if engine == "auto":
-        engine = "pallas" if jax.default_backend() == "tpu" else "xla"
+    engine = _resolve_engine(engine, table.shape[1])
     if engine == "xla":
         return embed_bag_reference(ids, vals, table, square=square)
-    if engine == "pallas":
-        return _embed_bag_pallas_diff(
-            ids, vals, table, square,
-            interpret=jax.default_backend() != "tpu")
-    raise ValueError(f"unknown embed engine {engine!r}")
+    return _embed_bag_pallas_diff(
+        ids, vals, table, square,
+        interpret=jax.default_backend() != "tpu")
+
+
+def fm_embed_terms(ids: jax.Array, vals: jax.Array, table: jax.Array,
+                   engine: str = "auto"):
+    """The FM pair ``(Σ_k v·x, Σ_k v²·x²)`` from ONE pass over the gathered
+    rows — the factorization-machine second-order term needs both, and
+    separate embed_bag calls would DMA every table row from HBM twice.
+
+    Returns ``(s1[B,D], s2[B,D])``; differentiable w.r.t. (vals, table).
+    """
+    engine = _resolve_engine(engine, table.shape[1])
+    if engine == "xla":
+        g = table[ids]                       # [B,K,D], one gather
+        s1 = jnp.einsum("bk,bkd->bd", vals, g)
+        s2 = jnp.einsum("bk,bkd->bd", vals * vals, g * g)
+        return s1, s2
+
+    interpret = jax.default_backend() != "tpu"
+
+    @jax.custom_vjp
+    def f(vals, table):
+        return fm_terms_pallas(ids, vals, table, interpret=interpret)
+
+    def fwd(vals, table):
+        return f(vals, table), (vals, table)
+
+    def bwd(res, gs):                        # gs = (g1[B,D], g2[B,D])
+        vals, table = res
+        g1, g2 = gs
+        x = table[ids]                       # [B,K,D] — backward-only
+        v = vals[..., None]
+        dvals = (jnp.einsum("bd,bkd->bk", g1, x)
+                 + 2.0 * vals * jnp.einsum("bd,bkd->bk", g2, x * x))
+        drows = v * g1[:, None, :] + 2.0 * v * v * x * g2[:, None, :]
+        dtable = jnp.zeros_like(table).at[ids.reshape(-1)].add(
+            drows.reshape(-1, table.shape[1]))
+        return dvals, dtable
+
+    f.defvjp(fwd, bwd)
+    return f(vals, table)
 
 
 def _embed_bag_pallas_diff(ids: jax.Array, vals: jax.Array, table: jax.Array,
@@ -125,6 +196,67 @@ def _kernel(ids_ref, vals_ref, table_ref, out_ref, buf, sems, *, K: int,
 
     acc = jax.lax.fori_loop(0, K, body, jnp.zeros((D,), jnp.float32))
     out_ref[0, :] = acc
+
+
+def _fm_kernel(ids_ref, vals_ref, table_ref, out1_ref, out2_ref, buf, sems,
+               *, K: int, D: int):
+    b = pl.program_id(0)
+
+    def row_copy(k, slot):
+        idx = ids_ref[b * K + k]
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(idx, 1), :], buf.at[slot], sems.at[slot])
+
+    row_copy(0, 0).start()
+
+    def body(k, accs):
+        a1, a2 = accs
+        slot = jax.lax.rem(k, 2)
+        nxt_slot = jax.lax.rem(k + 1, 2)
+
+        @pl.when(k + 1 < K)
+        def _start_next():
+            row_copy(k + 1, nxt_slot).start()
+
+        row_copy(k, slot).wait()
+        row = buf[slot, 0, :]
+        v = vals_ref[0, k]
+        return a1 + row * v, a2 + (row * row) * (v * v)
+
+    zero = jnp.zeros((D,), jnp.float32)
+    a1, a2 = jax.lax.fori_loop(0, K, body, (zero, zero))
+    out1_ref[0, :] = a1
+    out2_ref[0, :] = a2
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fm_terms_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
+                    interpret: bool = False):
+    """One DMA pass per row, BOTH FM reductions: (Σ v·x, Σ v²·x²)."""
+    B, K = ids.shape
+    F, D = table.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, K), lambda b, ids: (b, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec((1, D), lambda b, ids: (b, 0)),
+                   pl.BlockSpec((1, D), lambda b, ids: (b, 0))],
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, D), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(_fm_kernel, K=K, D=D)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B, D), jnp.float32),
+                   jax.ShapeDtypeStruct((B, D), jnp.float32)],
+        interpret=interpret,
+    )(ids.reshape(-1).astype(jnp.int32), vals.astype(jnp.float32), table)
 
 
 @functools.partial(jax.jit, static_argnames=("square", "interpret"))
