@@ -152,7 +152,8 @@ def force_cpu() -> None:
         pass
 
 
-def measure_ours() -> float:
+def measure_ours():
+    """Returns (mean_mbps, per_run_mbps, put_threads, platform)."""
     sys.path.insert(0, REPO)
     from dmlc_core_tpu import native
     if not native.available():
@@ -178,13 +179,16 @@ def measure_ours() -> float:
     log(f"parser config: nthreads={nthreads} threaded={threaded} "
         f"({cores} cores)")
 
-    def run_once() -> float:
+    prefetch = int(os.environ.get("DMLC_BENCH_PREFETCH", "4"))
+
+    def run_once(put_threads: int = 1) -> float:
         import resource
         metrics.reset()
         parser = create_parser(DATA, 0, 1, "libsvm", nthreads=nthreads,
                                threaded=threaded)
         loader = DeviceLoader(parser, batch_rows=batch_rows,
-                              nnz_cap=nnz_cap, prefetch=4)
+                              nnz_cap=nnz_cap, prefetch=prefetch,
+                              put_threads=put_threads)
         nbatches = 0
         last = None
         t0 = time.perf_counter()
@@ -203,8 +207,10 @@ def measure_ours() -> float:
         # (VERDICT r2 weak#1: live-buffer counts per run)
         try:
             parts = []
+            # h2d_pool: concurrent workers' overlapping seconds (pt>1)
             for name in ("parser.chunk", "parser.parse",
-                         "device_loader.pack", "device_loader.h2d"):
+                         "device_loader.pack", "device_loader.h2d",
+                         "device_loader.h2d_pool"):
                 st = metrics.stage(name)
                 parts.append(f"{name}={st.total_sec:.2f}s")
             log("  stages: " + " ".join(parts))
@@ -227,7 +233,24 @@ def measure_ours() -> float:
             log(f"  parse scaling: nt={nt} → "
                 f"{len(blob) / (1 << 20) / dt:.1f} MB/s")
     run_once()  # warm-up: compile/caches
-    return max(run_once(), run_once())
+    override = os.environ.get("DMLC_BENCH_PUT_THREADS")
+    if override:
+        pt = int(override)
+    elif platform == "cpu":
+        pt = 1  # no tunnel: extra put threads only time-slice the host core
+    else:
+        # the tunnel decides: probe single-stream async vs 4 concurrent
+        # transfer streams once each, keep the winner for the timed runs
+        probe = {p: run_once(p) for p in (1, 4)}
+        pt = max(probe, key=probe.get)
+        log("  transfer probe: "
+            + " ".join(f"pt={k}:{v:.1f}MB/s" for k, v in probe.items())
+            + f" → put_threads={pt}")
+    runs = [run_once(pt) for _ in range(3)]
+    spread = (max(runs) - min(runs)) / max(runs)
+    log(f"  timed runs (put_threads={pt}): "
+        + ", ".join(f"{r:.1f}" for r in runs) + f" MB/s, spread {spread:.0%}")
+    return sum(runs) / len(runs), runs, pt, platform
 
 
 def main() -> None:
@@ -246,7 +269,7 @@ def main() -> None:
     base1 = measure_reference()
     if not require_tpu and not probe_tpu():
         force_cpu()
-    value = measure_ours()
+    value, runs, put_threads, platform = measure_ours()
     # the shared host's speed drifts minute-to-minute: re-measure the
     # reference AFTER our runs and compare against the mean, so a drift
     # between the two measurements doesn't masquerade as a speed delta
@@ -260,6 +283,10 @@ def main() -> None:
         "value": round(value, 2),
         "unit": "MB/s",
         "vs_baseline": round(value / baseline, 3),
+        "platform": platform,
+        "runs": [round(r, 2) for r in runs],
+        "put_threads": put_threads,
+        "baseline_before_after": [round(base1, 1), round(base2, 1)],
     }))
 
 

@@ -1,0 +1,153 @@
+"""Host→device transfer diagnostics for the axon-tunnelled TPU.
+
+The ingest pipeline's narrowest link is ``jax.device_put`` over the tunnel
+(BENCH_r02: h2d 0.85→1.41s across repeats while wall degraded 113→63 MB/s).
+This script characterizes that link so the bench config (batch size, transfer
+streams, prefetch depth) is chosen from measurement, not guesswork:
+
+* ``put_bw``      — bandwidth + per-put latency vs payload size (the knee
+                    tells us how big a fused batch must be to amortize RPC
+                    overhead).
+* ``put_streams`` — aggregate bandwidth with K concurrent transfer threads
+                    (whether parallel RPC streams pipeline the tunnel; feeds
+                    DeviceLoader ``put_threads``).
+* ``put_drift``   — N consecutive equal puts, first/last-quartile ratio
+                    (the run-over-run degradation telemetry, VERDICT r2
+                    weak#1).
+* ``unpack``      — cost of the jitted fused-buffer unpack (slices + bitcast
+                    + searchsorted) relative to the raw put.
+
+Usage: ``python benchmarks/tpu_diag.py [out.json]`` — prints one JSON doc,
+optionally writes it to the given path.  Safe on CPU (labels the platform).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _bw(nbytes: int, sec: float) -> float:
+    return nbytes / max(sec, 1e-9) / (1 << 20)
+
+
+def bench_put_bw(jax, np) -> list:
+    out = []
+    for mb in (1, 4, 16, 64):
+        words = mb * (1 << 20) // 4
+        host = np.arange(words, dtype=np.int32)
+        # one warm put (allocator/tunnel setup), then timed reps
+        jax.block_until_ready(jax.device_put(host))
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jax.device_put(host))
+            times.append(time.perf_counter() - t0)
+        med = statistics.median(times)
+        out.append({"mb": mb, "median_s": round(med, 4),
+                    "min_s": round(min(times), 4),
+                    "mbps": round(_bw(words * 4, med), 1)})
+    return out
+
+
+def bench_put_streams(jax, np) -> list:
+    mb = 16
+    words = mb * (1 << 20) // 4
+    out = []
+    for k in (1, 2, 4):
+        hosts = [np.arange(words, dtype=np.int32) + i for i in range(k)]
+        for h in hosts:  # warm
+            jax.block_until_ready(jax.device_put(h))
+        reps = 3
+        t0 = time.perf_counter()
+
+        def run(h):
+            for _ in range(reps):
+                jax.block_until_ready(jax.device_put(h))
+
+        threads = [threading.Thread(target=run, args=(h,)) for h in hosts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        out.append({"streams": k,
+                    "agg_mbps": round(_bw(k * reps * words * 4, dt), 1)})
+    return out
+
+
+def bench_put_drift(jax, np, n: int = 20) -> dict:
+    words = 16 * (1 << 20) // 4
+    host = np.arange(words, dtype=np.int32)
+    jax.block_until_ready(jax.device_put(host))
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(host))
+        times.append(time.perf_counter() - t0)
+    q = max(1, n // 4)
+    first, last = statistics.mean(times[:q]), statistics.mean(times[-q:])
+    return {"n": n, "first_quartile_s": round(first, 4),
+            "last_quartile_s": round(last, 4),
+            "drift_ratio": round(last / first, 3),
+            "all_s": [round(t, 4) for t in times]}
+
+
+def bench_unpack(jax, np) -> dict:
+    from dmlc_core_tpu.pipeline.device_loader import (_get_unpack, _host_fused,
+                                                      fused_words)
+    rows, nnz = 16384, 360448
+    rng = np.random.default_rng(0)
+    host = {
+        "ids": rng.integers(0, 1 << 20, nnz).astype(np.int32),
+        "vals": rng.random(nnz).astype(np.float32),
+        "row_ptr": np.linspace(0, nnz, rows + 1).astype(np.int32),
+        "labels": rng.random(rows).astype(np.float32),
+        "weights": np.ones(rows, np.float32),
+    }
+    buf = _host_fused(host, rows, nnz)
+    unpack = _get_unpack(rows, nnz)
+    # warm: compile
+    jax.block_until_ready(unpack(jax.device_put(buf))["vals"])
+    t_put, t_both = [], []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        dev = jax.device_put(buf)
+        jax.block_until_ready(dev)
+        t_put.append(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        jax.block_until_ready(unpack(dev)["vals"])
+        t_both.append(time.perf_counter() - t1)
+    return {"rows": rows, "nnz": nnz,
+            "buf_mb": round(fused_words(rows, nnz) * 4 / (1 << 20), 1),
+            "put_median_s": round(statistics.median(t_put), 4),
+            "unpack_median_s": round(statistics.median(t_both), 4)}
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(REPO, ".jax_cache"))
+    import jax
+    import numpy as np
+
+    doc = {"platform": jax.devices()[0].platform,
+           "put_bw": bench_put_bw(jax, np),
+           "put_streams": bench_put_streams(jax, np),
+           "put_drift": bench_put_drift(jax, np),
+           "unpack": bench_unpack(jax, np)}
+    text = json.dumps(doc, indent=1)
+    print(text)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -535,7 +535,16 @@ int parse_parallel(const char* data, int64_t len, bool want_fields, int nthreads
   out->bad_lines = bad;
   out->owner = nullptr;
   if (nt == 1) {
-    // single range: adopt the ThreadBlock buffers instead of merging
+    // single range: adopt the ThreadBlock buffers instead of merging.
+    // The range parsers pre-size per-value scratch to a worst-case bound
+    // (~len/2 entries); release that capacity before adoption or every
+    // queued block pins hundreds of MB of dead heap through the pipeline
+    blocks[0].indices.shrink_to_fit();
+    blocks[0].values.shrink_to_fit();
+    blocks[0].fields.shrink_to_fit();
+    blocks[0].labels.shrink_to_fit();
+    blocks[0].weights.shrink_to_fit();
+    blocks[0].offsets.shrink_to_fit();
     auto* own = new (std::nothrow) BlockOwner{std::move(blocks[0]), {}};
     if (!own) return -1;
     own->cum.resize(n_rows + 1);

@@ -31,30 +31,38 @@ __all__ = ["embed_bag", "embed_bag_pallas", "embed_bag_reference",
 _pallas_ok_cache: dict = {}
 
 
-def _pallas_supported(D: int) -> bool:
-    """One tiny eager compile per embedding width: if Mosaic rejects this
-    lowering (un-validated D, driver quirks), dispatch falls back to XLA
-    instead of aborting the whole jitted train step at compile time."""
-    ok = _pallas_ok_cache.get(D)
+def _pallas_supported(D: int, fused: bool = False) -> bool:
+    """One tiny eager compile per (embedding width, kernel): if Mosaic
+    rejects this lowering (un-validated D, driver quirks), dispatch falls
+    back to XLA instead of aborting the whole jitted train step at compile
+    time.  The single-output ``embed_bag`` and the fused two-output FM
+    kernel lower with different out_specs/scratch, so each is probed with
+    the kernel that will actually run."""
+    key = (D, fused)
+    ok = _pallas_ok_cache.get(key)
     if ok is None:
         try:
             ids = jnp.zeros((2, 2), jnp.int32)
             vals = jnp.ones((2, 2), jnp.float32)
             table = jnp.ones((4, D), jnp.float32)
-            jax.block_until_ready(embed_bag_pallas(ids, vals, table))
+            if fused:
+                jax.block_until_ready(fm_terms_pallas(ids, vals, table))
+            else:
+                jax.block_until_ready(embed_bag_pallas(ids, vals, table))
             ok = True
         except Exception as e:  # noqa: BLE001 — mosaic compile failure etc.
             import warnings
-            warnings.warn(f"pallas embed_bag unavailable for D={D} "
-                          f"({type(e).__name__}: {e}); using XLA path")
+            warnings.warn(
+                f"pallas {'fm_terms' if fused else 'embed_bag'} unavailable "
+                f"for D={D} ({type(e).__name__}: {e}); using XLA path")
             ok = False
-        _pallas_ok_cache[D] = ok
+        _pallas_ok_cache[key] = ok
     return ok
 
 
-def _resolve_engine(engine: str, D: int) -> str:
+def _resolve_engine(engine: str, D: int, fused: bool = False) -> str:
     if engine == "auto":
-        if jax.default_backend() == "tpu" and _pallas_supported(D):
+        if jax.default_backend() == "tpu" and _pallas_supported(D, fused):
             return "pallas"
         return "xla"
     if engine not in ("xla", "pallas"):
@@ -96,7 +104,7 @@ def fm_embed_terms(ids: jax.Array, vals: jax.Array, table: jax.Array,
 
     Returns ``(s1[B,D], s2[B,D])``; differentiable w.r.t. (vals, table).
     """
-    engine = _resolve_engine(engine, table.shape[1])
+    engine = _resolve_engine(engine, table.shape[1], fused=True)
     if engine == "xla":
         g = table[ids]                       # [B,K,D], one gather
         s1 = jnp.einsum("bk,bkd->bd", vals, g)

@@ -144,6 +144,127 @@ class _BufPool:
             self._bufs.clear()
 
 
+class _TransferPool:
+    """K ordered transfer workers over the pack queue (stage-2 alternative).
+
+    Over a high-latency host→device link (the axon tunnel is a network hop,
+    not a PCIe bus) a single transfer thread serializes RPC round-trips; K
+    workers keep K transfers in flight while the consumer still sees batches
+    in pack order.  Items are pulled from the pack queue under ``_pull_lock``
+    so sequence assignment matches pull order; completed batches land in a
+    reorder map keyed by sequence and are emitted strictly in order.  Same
+    consumer contract as :class:`ThreadedIter` (next/before_first/destroy,
+    producer-exception propagation in stream order).
+    """
+
+    def __init__(self, pack_iter: ThreadedIter, do_transfer, n_threads: int,
+                 window: int):
+        self._pack = pack_iter
+        self._do = do_transfer          # host item -> device batch (blocking)
+        self._window = max(int(n_threads), int(window))
+        self._cv = threading.Condition()
+        self._pull_lock = threading.Lock()
+        self._done: Dict[int, tuple] = {}   # seq -> (batch, error)
+        self._next_seq = 0                  # next seq a worker will pull
+        self._emit_seq = 0                  # next seq the consumer takes
+        self._end_seq: Optional[int] = None
+        self._epoch = 0
+        self._stop = False
+        self._threads = [threading.Thread(target=self._worker, daemon=True)
+                         for _ in range(int(n_threads))]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cv:
+                # park at end-of-epoch / flow-control limit
+                while not self._stop and (
+                        self._end_seq is not None
+                        or self._next_seq - self._emit_seq >= self._window):
+                    self._cv.wait()
+                if self._stop:
+                    return
+            with self._pull_lock:
+                # epoch can't change while we hold _pull_lock (before_first
+                # takes it), so seq/epoch read below is consistent
+                with self._cv:
+                    if self._stop:
+                        return
+                    if self._end_seq is not None:
+                        continue
+                    epoch = self._epoch
+                    seq = self._next_seq
+                try:
+                    item = self._pack.next()
+                except BaseException as e:  # pack/parse producer failed:
+                    # surface it at this stream position (put_threads=1
+                    # raises the same error through ThreadedIter)
+                    with self._cv:
+                        if self._epoch == epoch:
+                            self._done[seq] = (None, e)
+                            self._next_seq = seq + 1
+                            self._end_seq = seq + 1
+                            self._cv.notify_all()
+                    continue
+                with self._cv:
+                    if item is None:
+                        self._end_seq = seq
+                        self._cv.notify_all()
+                    else:
+                        self._next_seq = seq + 1
+            if item is None:
+                continue
+            try:
+                result = (self._do(item), None)
+            except BaseException as e:  # noqa: BLE001
+                result = (None, e)
+            with self._cv:
+                if self._epoch == epoch:
+                    self._done[seq] = result
+                    self._cv.notify_all()
+
+    def next(self):
+        with self._cv:
+            while True:
+                if self._emit_seq in self._done:
+                    out, err = self._done.pop(self._emit_seq)
+                    self._emit_seq += 1
+                    self._cv.notify_all()
+                    if err is not None:
+                        from ..utils.logging import DMLCError
+                        raise DMLCError(
+                            f"transfer worker failed: {err!r}") from err
+                    return out
+                if (self._end_seq is not None
+                        and self._emit_seq >= self._end_seq):
+                    return None
+                if self._stop:
+                    return None
+                self._cv.wait()
+
+    def before_first(self) -> None:
+        # _pull_lock serializes against a worker mid-pull, so no item from
+        # the reset stream can be tagged with a pre-reset sequence number
+        with self._pull_lock:
+            with self._cv:
+                self._epoch += 1
+                self._done.clear()
+                self._next_seq = 0
+                self._emit_seq = 0
+                self._end_seq = None
+                self._cv.notify_all()
+            self._pack.before_first()
+
+    def destroy(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads = []
+
+
 class DeviceLoader:
     """Stream fixed-shape device batches from a parser or RowBlockIter.
 
@@ -158,13 +279,18 @@ class DeviceLoader:
                    arrays (batch axis over 'dp' typically).
     prefetch:      device batches to keep in flight (double buffer = 2).
     drop_remainder: drop the final partial batch instead of padding it.
+    put_threads:   transfer streams.  1 (default) = single async transfer
+                   thread with an in-flight ring; >1 = ``_TransferPool`` of
+                   ordered workers, each completing its transfer
+                   synchronously — K concurrent h2d RPCs, which pipelines a
+                   high-latency tunnel link that one stream can't saturate.
     """
 
     def __init__(self, source, batch_rows: int, nnz_cap: int,
                  layout: str = "flat",
                  sharding: Optional[jax.sharding.Sharding] = None,
                  prefetch: int = 2, drop_remainder: bool = False,
-                 id_mod: int = 0):
+                 id_mod: int = 0, put_threads: int = 1):
         check(layout in ("flat", "rowmajor"), f"bad layout {layout!r}")
         self.source = source
         self.batch_rows = batch_rows
@@ -174,16 +300,24 @@ class DeviceLoader:
         self.drop_remainder = drop_remainder
         self.id_mod = id_mod
         self.stats = PackStats()
-        depth = max(2, int(prefetch))
+        put_threads = max(1, int(put_threads))
+        depth = max(2, int(prefetch), put_threads)
         self._pool = _BufPool(cap=2 * depth + 2)
         self._inflight: deque = deque()
         self._inflight_depth = depth
         # stage 1: parse+pack in its own thread → bounded host-buffer queue
         self._pack_iter: ThreadedIter = ThreadedIter(max_capacity=depth)
         self._pack_iter.init(self._pack_factory(), self._reset_source)
-        # stage 2: device transfer in its own thread → bounded device queue
-        self._iter: ThreadedIter = ThreadedIter(max_capacity=max(1, int(prefetch)))
-        self._iter.init(self._transfer_next, self._reset_transfer)
+        # stage 2: device transfer → bounded device queue
+        if put_threads > 1:
+            self._iter = _TransferPool(
+                self._pack_iter,
+                lambda item: self._transfer_item(item, sync=True),
+                n_threads=put_threads,
+                window=max(int(prefetch), put_threads))
+        else:
+            self._iter = ThreadedIter(max_capacity=max(1, int(prefetch)))
+            self._iter.init(self._transfer_next, self._reset_transfer)
 
     # ---------------- stage 1: pack ----------------
     def _blocks(self) -> Iterator:
@@ -298,12 +432,28 @@ class DeviceLoader:
         if item is None:
             self._drain_inflight()
             return None
+        return self._transfer_item(item, sync=False)
+
+    def _transfer_item(self, item, sync: bool):
+        """Move one packed host item to device.
+
+        ``sync=False`` (single transfer thread): async put; the in-flight
+        ring recycles host buffers once transfers land.  ``sync=True``
+        (transfer pool): block until this batch is on device, then recycle
+        immediately — concurrency comes from the pool's threads, and the
+        ring (not thread-safe) stays unused."""
         self._maybe_bind()
-        with self._m_h2d.time():
+        # pool mode times under its own stage: K workers accumulate
+        # overlapping seconds, which must not be read as serial h2d time
+        with (self._m_h2d_pool if sync else self._m_h2d).time():
             if item[0] == "fused":
                 _, buf, nnz, rows_real = item
                 out = _put_fused_buf(buf, self.batch_rows, nnz)
-                self._ring_push(out["vals"], buf)
+                if sync:
+                    jax.block_until_ready(out["vals"])
+                    self._pool.put(buf)
+                else:
+                    self._ring_push(out["vals"], buf)
             else:
                 host = item[1]
                 rows_real = host.pop("_rows", self.batch_rows)
@@ -314,6 +464,8 @@ class DeviceLoader:
                 # fits each; fusing would mix axes, so transfer per-array
                 out = {k: jax.device_put(v, self.sharding)
                        for k, v in host.items()}
+                if sync:
+                    jax.block_until_ready(out)
         self._m_batches.add(1)
         if rows_real is not None:
             self._m_rows.add(rows_real)
@@ -354,6 +506,7 @@ class DeviceLoader:
         self._m_gen = metrics.generation
         self._m_pack = metrics.stage("device_loader.pack")
         self._m_h2d = metrics.stage("device_loader.h2d")
+        self._m_h2d_pool = metrics.stage("device_loader.h2d_pool")
         self._m_batches = metrics.counter("device_loader.batches")
         self._m_rows = metrics.throughput("device_loader.rows")
 

@@ -94,6 +94,42 @@ def test_device_loader_row_conservation(libsvm_file):
     assert loader.stats.rows >= 1037
 
 
+def test_device_loader_transfer_pool_ordered(libsvm_file):
+    """put_threads>1 (the multi-stream transfer pool for high-latency h2d
+    links) must yield the exact same batch sequence as the single-thread
+    path: same order, same contents, same epoch-reset behavior."""
+    def collect(pt):
+        with DeviceLoader(create_parser(libsvm_file), batch_rows=128,
+                          nnz_cap=1024, put_threads=pt) as loader:
+            first = [np.asarray(b["labels"]) for b in loader]
+            loader.before_first()
+            second = [np.asarray(b["labels"]) for b in loader]
+        return first, second
+
+    ref1, ref2 = collect(1)
+    pool1, pool2 = collect(3)
+    assert len(pool1) == len(ref1)
+    for a, b in zip(ref1, pool1):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(ref2, pool2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_transfer_pool_error_propagates(libsvm_file, monkeypatch):
+    from dmlc_core_tpu.utils.logging import DMLCError
+
+    def failing(self, item, sync=True):
+        raise RuntimeError("injected transfer failure")
+
+    monkeypatch.setattr(DeviceLoader, "_transfer_item", failing)
+    loader = DeviceLoader(create_parser(libsvm_file), batch_rows=128,
+                          nnz_cap=1024, put_threads=2)
+    with pytest.raises(DMLCError, match="injected transfer failure"):
+        for _ in loader:
+            pass
+    loader.close()
+
+
 def test_device_loader_drop_remainder(libsvm_file):
     with DeviceLoader(create_parser(libsvm_file), batch_rows=128,
                       nnz_cap=1024, drop_remainder=True) as loader:
