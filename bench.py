@@ -153,7 +153,7 @@ def force_cpu() -> None:
 
 
 def measure_ours():
-    """Returns (mean_mbps, per_run_mbps, put_threads, platform)."""
+    """Returns (mean_mbps, per_run_mbps, (put_threads, compact), platform)."""
     sys.path.insert(0, REPO)
     from dmlc_core_tpu import native
     if not native.available():
@@ -181,14 +181,14 @@ def measure_ours():
 
     prefetch = int(os.environ.get("DMLC_BENCH_PREFETCH", "4"))
 
-    def run_once(put_threads: int = 1) -> float:
+    def run_once(put_threads: int = 1, compact: bool = False) -> float:
         import resource
         metrics.reset()
         parser = create_parser(DATA, 0, 1, "libsvm", nthreads=nthreads,
                                threaded=threaded)
         loader = DeviceLoader(parser, batch_rows=batch_rows,
                               nnz_cap=nnz_cap, prefetch=prefetch,
-                              put_threads=put_threads)
+                              put_threads=put_threads, wire_compact=compact)
         nbatches = 0
         last = None
         t0 = time.perf_counter()
@@ -232,25 +232,34 @@ def measure_ours():
             dt = time.perf_counter() - t0
             log(f"  parse scaling: nt={nt} → "
                 f"{len(blob) / (1 << 20) / dt:.1f} MB/s")
-    run_once()  # warm-up: compile/caches
-    override = os.environ.get("DMLC_BENCH_PUT_THREADS")
-    if override:
-        pt = int(override)
-    elif platform == "cpu":
-        pt = 1  # no tunnel: extra put threads only time-slice the host core
+    pt_env = os.environ.get("DMLC_BENCH_PUT_THREADS")
+    cm_env = os.environ.get("DMLC_BENCH_COMPACT")
+    pts = [int(pt_env)] if pt_env else [1, 4]
+    cms = [cm_env != "0"] if cm_env is not None else [True, False]
+    if platform == "cpu":
+        # no tunnel: extra put threads only time-slice the host core, and
+        # compact wire spends host cycles to save a link that isn't there
+        if not pt_env:
+            pts = [1]
+        if cm_env is None:
+            cms = [False]
+    combos = [(p, c) for c in cms for p in pts]
+    run_once(*combos[0])  # warm-up: compile/caches
+    if len(combos) > 1:
+        # the tunnel decides: probe transfer streams × wire compaction,
+        # keep the winning config for the timed runs
+        probe = {c: run_once(*c) for c in combos}
+        pt, cm = max(probe, key=probe.get)
+        log("  config probe: " + " ".join(
+            f"pt={k[0]},compact={int(k[1])}:{v:.1f}MB/s"
+            for k, v in probe.items()) + f" → pt={pt} compact={int(cm)}")
     else:
-        # the tunnel decides: probe single-stream async vs 4 concurrent
-        # transfer streams once each, keep the winner for the timed runs
-        probe = {p: run_once(p) for p in (1, 4)}
-        pt = max(probe, key=probe.get)
-        log("  transfer probe: "
-            + " ".join(f"pt={k}:{v:.1f}MB/s" for k, v in probe.items())
-            + f" → put_threads={pt}")
-    runs = [run_once(pt) for _ in range(3)]
+        pt, cm = combos[0]
+    runs = [run_once(pt, cm) for _ in range(3)]
     spread = (max(runs) - min(runs)) / max(runs)
-    log(f"  timed runs (put_threads={pt}): "
+    log(f"  timed runs (pt={pt}, compact={int(cm)}): "
         + ", ".join(f"{r:.1f}" for r in runs) + f" MB/s, spread {spread:.0%}")
-    return sum(runs) / len(runs), runs, pt, platform
+    return sum(runs) / len(runs), runs, (pt, cm), platform
 
 
 def main() -> None:
@@ -269,7 +278,7 @@ def main() -> None:
     base1 = measure_reference()
     if not require_tpu and not probe_tpu():
         force_cpu()
-    value, runs, put_threads, platform = measure_ours()
+    value, runs, (put_threads, compact), platform = measure_ours()
     # the shared host's speed drifts minute-to-minute: re-measure the
     # reference AFTER our runs and compare against the mean, so a drift
     # between the two measurements doesn't masquerade as a speed delta
@@ -286,6 +295,7 @@ def main() -> None:
         "platform": platform,
         "runs": [round(r, 2) for r in runs],
         "put_threads": put_threads,
+        "wire_compact": compact,
         "baseline_before_after": [round(base1, 1), round(base2, 1)],
     }))
 

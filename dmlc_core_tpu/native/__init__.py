@@ -94,6 +94,10 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.dmlc_packer2_stats.argtypes = [ctypes.c_void_p] + \
                 [ctypes.POINTER(ctypes.c_int64)] * 4
             lib.dmlc_packer2_stats.restype = None
+        if hasattr(lib, "dmlc_packer2_set_compact"):
+            lib.dmlc_packer2_set_compact.argtypes = [ctypes.c_void_p,
+                                                     ctypes.c_int32]
+            lib.dmlc_packer2_set_compact.restype = None
         _lib = lib
         return _lib
 
@@ -102,6 +106,12 @@ def has_packer() -> bool:
     """True when the loaded library carries the fused-packer ABI."""
     lib = _load()
     return lib is not None and hasattr(lib, "dmlc_packer2_create")
+
+
+def has_compact() -> bool:
+    """True when the loaded library supports the v3 compact wire layout."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "dmlc_packer2_set_compact")
 
 
 def available() -> bool:
@@ -214,14 +224,16 @@ def fused_words(batch_rows: int, nnz_bucket: int) -> int:
 
 class Packer:
     """Native CSR→fused-device-batch packer (see ``PackerC`` in
-    dmlc_native.cpp).  Streams RowBlocks into v2 fused int32 buffers
+    dmlc_native.cpp).  Streams RowBlocks into fused int32 buffers
     (``ids[B]|vals[B]|row_ptr|labels|weights`` with B the actual nnz rounded
     up to ``quantum``); a partial batch carries across blocks until
-    :meth:`flush`.  Emitted items are ``(buffer, B)`` pairs — the buffer's
-    first ``fused_words(batch_rows, B)`` words are the batch."""
+    :meth:`flush`.  Emitted items are ``(buffer, meta)`` pairs where meta =
+    ``B | id_width<<32 | dict_bits<<40`` (id_width 0 ⇒ plain v2 layout;
+    with ``compact=True`` the v3 wire layout bit-packs ids and
+    dictionary-codes values — losslessly, ~half the transfer bytes)."""
 
     def __init__(self, batch_rows: int, nnz_cap: int, id_mod: int = 0,
-                 quantum: int = 0):
+                 quantum: int = 0, compact: bool = False):
         lib = _load()
         if lib is None or not hasattr(lib, "dmlc_packer2_create"):
             raise RuntimeError("native packer unavailable (stale library?)")
@@ -233,6 +245,10 @@ class Packer:
                                           id_mod)
         if not self._p:
             raise MemoryError("dmlc_packer2_create failed")
+        if compact:
+            if not hasattr(lib, "dmlc_packer2_set_compact"):
+                raise RuntimeError("native library lacks compact-wire ABI")
+            lib.dmlc_packer2_set_compact(self._p, 1)
         self.batch_rows = batch_rows
         self.nnz_cap = nnz_cap
         self.quantum = min(quantum, nnz_cap)
@@ -254,9 +270,11 @@ class Packer:
         return None if arr is None else arr.ctypes.data
 
     def feed(self, block, max_out: int = 8, get_buf=None, put_buf=None):
-        """Yield ``(buf, nnz_bucket)`` fused batches for ``block`` (a
-        RowBlock with int64 offsets / f32 labels / u64 indices / optional
-        f32 values+weights).  ``get_buf(words)`` supplies transfer buffers
+        """Yield ``(buf, meta)`` fused batches for ``block`` (a RowBlock
+        with int64 offsets / f32 labels / u64 indices / optional f32
+        values+weights); decode meta with
+        ``pipeline.device_loader._decode_meta`` — it is the raw nnz bucket
+        only in non-compact mode.  ``get_buf(words)`` supplies transfer buffers
         (default fresh ``np.empty``) and ``put_buf(buf)`` takes unused ones
         back — wiring both to a pool keeps the steady-state pipeline at
         zero allocation."""
@@ -310,8 +328,8 @@ class Packer:
                     put_buf(b)
 
     def flush(self, get_buf=None):
-        """Emit the final partial batch as ``(buf, nnz_bucket)`` (padded),
-        or None when empty."""
+        """Emit the final partial batch as ``(buf, meta)`` (padded), or
+        None when empty (same meta contract as :meth:`feed`)."""
         if get_buf is None:
             get_buf = lambda words: np.empty(words, np.int32)  # noqa: E731
         buf = get_buf(self.words_max)

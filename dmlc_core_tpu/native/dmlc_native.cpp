@@ -614,6 +614,22 @@ int parse_parallel(const char* data, int64_t len, bool want_fields, int nthreads
 // than nnz_cap are truncated (counted).  Feature ids must fit int32 unless
 // id_mod (feature hashing) is set: overflow returns an error instead of
 // silently wrapping (VERDICT r1 #5).
+//
+// v3 "compact wire" mode (dmlc_packer2_set_compact): host→device bandwidth
+// is the pipeline's narrowest link (the TPU sits behind a network tunnel),
+// so the wire format spends host cycles to cut wire bytes — LOSSLESSLY:
+//   * ids are bit-packed at the batch's actual width (bucketed to nibble
+//     multiples, e.g. a 1M-feature space ships 20-bit ids: -37%);
+//   * values are dictionary-coded (u16 codes + f32 dict) when the batch's
+//     distinct-value count is small — real-world libsvm values are
+//     few-distinct (binary features, 4-decimal quantized floats) — chosen
+//     per batch only when codes+dict < raw f32, else raw fallback.
+// Layout v3: [ids packed w-bit][codes u16 | raw vals][dict][row_ptr][labels]
+// [weights]; decode on device is shifts+gathers (see device_loader
+// _get_unpack v3).  Reconstruction is bit-exact; code 0 is reserved for
+// 0.0f so nnz padding decodes to 0.0 exactly like v2.  The emit meta is
+// B | (id_width << 32) | (log2(dict_words) << 40); id_width 0 = v2 layout,
+// dict_bits 0 = raw values.
 
 struct PackerC {
   int64_t batch_rows;
@@ -626,6 +642,55 @@ struct PackerC {
   std::vector<int32_t> labs_s, wgts_s;  // batch_rows
   int64_t row_count = 0;
   int64_t nnz_count = 0;
+  // v3 compact wire state.  The value dictionary persists across batches:
+  // real datasets repeat the same value set (binary features, quantized
+  // floats), so after the first batch lookups are pure hits in a small
+  // table instead of a rebuild per batch.  It starts tiny and grows 4x on
+  // load; after two consecutive overflowing batches (genuinely
+  // high-cardinality values) dictionary coding is disabled for good.
+  bool compact = false;
+  uint32_t ormask = 0;                  // OR of staged ids → bit width
+  // open-addressing slots: key | code<<32 in ONE uint64 (one cache line
+  // per probe); slot 0 = empty (key 0 ⇒ reserved code 0, never stored)
+  std::vector<uint64_t> dslots;
+  std::vector<uint32_t> dvals;          // value bit patterns by code
+  int64_t dict_tsize = 0;
+  int dict_strikes = 0;                 // consecutive overflowing batches
+  bool dict_disabled = false;
+
+  void dict_rebuild(int64_t tsize) {
+    dict_tsize = tsize;
+    dslots.assign(tsize, 0);
+    for (size_t c = 1; c < dvals.size(); ++c) {  // code 0 (=0.0f) not stored
+      const uint32_t key = dvals[c];
+      int64_t h = static_cast<int64_t>(key * 2654435761u) & (tsize - 1);
+      while (dslots[h] != 0) h = (h + 1) & (tsize - 1);
+      dslots[h] = key | (static_cast<uint64_t>(c) << 32);
+    }
+  }
+
+  // code for a value bit pattern, inserting if new; -1 when the dict would
+  // exceed `cap` entries (caller falls back to raw values for this batch)
+  int32_t val_code(uint32_t key, int64_t cap) {
+    if (key == 0) return 0;
+    const int64_t tmask = dict_tsize - 1;
+    int64_t h = static_cast<int64_t>(key * 2654435761u) & tmask;
+    for (;;) {
+      const uint64_t s = dslots[h];
+      if (static_cast<uint32_t>(s) == key)
+        return static_cast<int32_t>(s >> 32);
+      if (s == 0) {
+        if (static_cast<int64_t>(dvals.size()) > cap) return -1;
+        const int32_t code = static_cast<int32_t>(dvals.size());
+        dvals.push_back(key);
+        dslots[h] = key | (static_cast<uint64_t>(code) << 32);
+        if (static_cast<int64_t>(dvals.size()) * 2 > dict_tsize)
+          dict_rebuild(dict_tsize * 4);
+        return code;
+      }
+      h = (h + 1) & tmask;
+    }
+  }
   // aggregate stats
   int64_t total_rows = 0;
   int64_t padded_rows = 0;
@@ -647,14 +712,8 @@ struct PackerC {
     return b > nnz_cap ? nnz_cap : b;
   }
 
-  // write the staged batch into out (layout v2); returns B (the nnz bucket)
-  int64_t emit(int32_t* out) {
-    const int64_t B = bucket();
-    std::memcpy(out, ids_s.data(), nnz_count * 4);
-    std::memset(out + nnz_count, 0, (B - nnz_count) * 4);
-    std::memcpy(out + B, vals_s.data(), nnz_count * 4);
-    std::memset(out + B + nnz_count, 0, (B - nnz_count) * 4);
-    int32_t* rp = out + 2 * B;
+  // row_ptr|labels|weights tail shared by both layouts, then reset staging
+  void write_tail(int32_t* rp) {
     std::memcpy(rp, rp_s.data(), (row_count + 1) * 4);
     for (int64_t r = row_count + 1; r <= batch_rows; ++r)
       rp[r] = static_cast<int32_t>(nnz_count);
@@ -669,7 +728,114 @@ struct PackerC {
     ++batches;
     row_count = 0;
     nnz_count = 0;
+    ormask = 0;
+  }
+
+  // write the staged batch into out; returns the emit meta
+  // (B | id_width<<32 | dict_bits<<40; id_width 0 = v2 layout)
+  int64_t emit(int32_t* out) {
+    if (compact) return emit_v3(out);
+    const int64_t B = bucket();
+    std::memcpy(out, ids_s.data(), nnz_count * 4);
+    std::memset(out + nnz_count, 0, (B - nnz_count) * 4);
+    std::memcpy(out + B, vals_s.data(), nnz_count * 4);
+    std::memset(out + B + nnz_count, 0, (B - nnz_count) * 4);
+    write_tail(out + 2 * B);
     return B;
+  }
+
+  static int64_t next_pow2(int64_t v) {
+    int64_t p = 2;
+    while (p < v) p <<= 1;
+    return p;
+  }
+
+  int64_t emit_v3(int32_t* out) {
+    const int64_t B = bucket();
+    // id bit width from the staged OR-mask (same top bit as the max),
+    // bucketed to nibble multiples so the device-side jit cache stays small
+    int w = 1;
+    while (w < 32 && (ormask >> w) != 0) ++w;
+    w = (w + 3) & ~3;
+    if (w < 8) w = 8;
+    const int64_t IW = (B * static_cast<int64_t>(w) + 31) / 32;
+    std::memset(out, 0, IW * 4);
+    {
+      uint64_t acc = 0;
+      int bits = 0;
+      int32_t* dst = out;
+      for (int64_t i = 0; i < nnz_count; ++i) {
+        acc |= static_cast<uint64_t>(static_cast<uint32_t>(ids_s[i])) << bits;
+        bits += w;
+        while (bits >= 32) {
+          *dst++ = static_cast<int32_t>(static_cast<uint32_t>(acc));
+          acc >>= 32;
+          bits -= 32;
+        }
+      }
+      if (bits > 0)
+        *dst = static_cast<int32_t>(static_cast<uint32_t>(acc));
+    }
+    // values: dictionary attempt (code 0 reserved for 0.0f = nnz padding);
+    // codes are u16, so the dict never exceeds 65536 entries (cap 65535 +
+    // the reserved zero)
+    const int64_t CW = (B + 1) / 2;
+    const int64_t cap = std::min<int64_t>(65535, B / 2);
+    bool dict_ok = cap >= 2 && !dict_disabled;
+    int dbits = 0;
+    int64_t vw = 0;
+    if (dict_ok) {
+      if (dict_tsize == 0) {
+        dvals.clear();
+        dvals.push_back(0);  // code 0 → 0.0f
+        dict_rebuild(4096);
+      }
+      uint16_t* codes16 = reinterpret_cast<uint16_t*>(out + IW);
+      std::memset(codes16, 0, CW * 4);
+      const uint32_t* vb = reinterpret_cast<const uint32_t*>(vals_s.data());
+      for (int64_t i = 0; i < nnz_count; ++i) {
+        const int32_t code = val_code(vb[i], cap);
+        if (code < 0) {  // value cardinality blew the cap: raw this batch
+          dict_ok = false;
+          if (++dict_strikes >= 2) dict_disabled = true;
+          break;
+        }
+        codes16[i] = static_cast<uint16_t>(code);
+      }
+      if (dict_ok) {
+        dict_strikes = 0;
+        // floor DW so a growing dict doesn't step through every pow2 and
+        // trigger a device-side jit recompile per step (dbits is part of
+        // the unpack cache key); the floor costs ≤16KB/batch on the wire.
+        // Small caps (tiny test batches) skip it — there CW+DW must stay
+        // under B for dict mode to engage at all
+        const int64_t dfloor = cap >= 4096 ? 4096 : 2;
+        const int64_t DW = next_pow2(
+            std::max<int64_t>(static_cast<int64_t>(dvals.size()), dfloor));
+        if (CW + DW > B) {
+          dict_ok = false;  // dict doesn't beat raw for this (small) batch
+        } else {
+          int32_t* dreg = out + IW + CW;
+          std::memset(dreg, 0, DW * 4);
+          std::memcpy(dreg, dvals.data(), dvals.size() * 4);
+          vw = CW + DW;
+          int64_t t = DW;
+          while (t > 1) {
+            t >>= 1;
+            ++dbits;
+          }
+        }
+      }
+    }
+    if (!dict_ok) {  // raw f32 fallback (overwrites any partial codes)
+      std::memcpy(out + IW, vals_s.data(), nnz_count * 4);
+      std::memset(out + IW + nnz_count, 0, (B - nnz_count) * 4);
+      vw = B;
+      dbits = 0;
+    }
+    write_tail(out + IW + vw);
+    return B | (static_cast<int64_t>(w) << 32)
+             | (static_cast<int64_t>(dbits) << 40);
   }
 };
 
@@ -684,6 +850,12 @@ void* dmlc_packer2_create(int64_t batch_rows, int64_t nnz_cap,
 }
 
 void dmlc_packer2_destroy(void* p) { delete static_cast<PackerC*>(p); }
+
+// Toggle the v3 compact wire layout (bit-packed ids + dict-coded values);
+// takes effect from the next emitted batch.
+void dmlc_packer2_set_compact(void* p, int32_t on) {
+  static_cast<PackerC*>(p)->compact = on != 0;
+}
 
 // Feed rows [start_row, n_rows) of a CSR block; write finished batches into
 // out_bufs[0..max_out) and each batch's nnz bucket B into out_nnz[i].
@@ -716,16 +888,22 @@ int64_t dmlc_packer2_feed(void* vp, int64_t n_rows, const int64_t* offsets,
     }
     int32_t* ids = p->ids_s.data() + p->nnz_count;
     float* vals = reinterpret_cast<float*>(p->vals_s.data()) + p->nnz_count;
+    uint32_t om = 0;
     if (p->id_mod) {
-      for (int64_t j = 0; j < k; ++j)
-        ids[j] = static_cast<int32_t>(indices[b + j] % p->id_mod);
+      for (int64_t j = 0; j < k; ++j) {
+        const uint32_t id = static_cast<uint32_t>(indices[b + j] % p->id_mod);
+        om |= id;
+        ids[j] = static_cast<int32_t>(id);
+      }
     } else {
       for (int64_t j = 0; j < k; ++j) {
         const uint64_t id = indices[b + j];
         if (id > 0x7fffffffULL) { *consumed_rows = r; return -2; }
+        om |= static_cast<uint32_t>(id);
         ids[j] = static_cast<int32_t>(id);
       }
     }
+    p->ormask |= om;
     if (values) {
       std::memcpy(vals, values + b, k * 4);
     } else {

@@ -130,6 +130,53 @@ def test_transfer_pool_error_propagates(libsvm_file, monkeypatch):
     loader.close()
 
 
+def _loader_batches(path, wire_compact, batch_rows=128, nnz_cap=1024):
+    with DeviceLoader(create_parser(path), batch_rows=batch_rows,
+                      nnz_cap=nnz_cap, wire_compact=wire_compact) as loader:
+        return [{k: np.asarray(v) for k, v in b.items()} for b in loader]
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert sorted(x) == sorted(y)
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k], err_msg=k)
+
+
+def test_wire_compact_matches_plain(libsvm_file):
+    """The v3 compact wire layout (bit-packed ids + dict-coded values) must
+    reconstruct bit-identical batches to the plain v2 layout.  This file has
+    small ids (8-bit width) and a 2-entry value dictionary (all 1.0)."""
+    from dmlc_core_tpu import native
+    if not native.has_compact():
+        pytest.skip("native compact packer unavailable")
+    _assert_batches_equal(_loader_batches(libsvm_file, False),
+                          _loader_batches(libsvm_file, True))
+
+
+def test_wire_compact_variants(tmp_path):
+    """Compact-wire regimes beyond the easy case: (a) high-cardinality
+    values forcing the raw-f32 dictionary fallback, (b) 20-bit ids, and
+    (c) a near-int32-max id forcing the 32-bit width bucket — all must
+    round-trip bit-exactly, including the flushed partial batch."""
+    from dmlc_core_tpu import native
+    if not native.has_compact():
+        pytest.skip("native compact packer unavailable")
+    rng = np.random.default_rng(7)
+    path = tmp_path / "v.libsvm"
+    with open(path, "w") as f:
+        for i in range(600):
+            n = int(rng.integers(3, 9))
+            idx = sorted(rng.choice(1 << 20, n, replace=False).tolist())
+            f.write(f"{i % 2} " + " ".join(
+                f"{j}:{rng.random():.6f}" for j in idx) + "\n")
+        # one giant id → this batch's ids bucket to the full 32-bit width
+        f.write("1 2147483646:0.5\n")
+    _assert_batches_equal(_loader_batches(str(path), False),
+                          _loader_batches(str(path), True))
+
+
 def test_device_loader_drop_remainder(libsvm_file):
     with DeviceLoader(create_parser(libsvm_file), batch_rows=128,
                       nnz_cap=1024, drop_remainder=True) as loader:
