@@ -100,23 +100,9 @@ def bench_put_drift(jax, np, n: int = 20) -> dict:
             "all_s": [round(t, 4) for t in times]}
 
 
-def bench_unpack(jax, np) -> dict:
-    from dmlc_core_tpu.pipeline.device_loader import (_get_unpack, _host_fused,
-                                                      fused_words)
-    rows, nnz = 16384, 360448
-    rng = np.random.default_rng(0)
-    host = {
-        "ids": rng.integers(0, 1 << 20, nnz).astype(np.int32),
-        "vals": rng.random(nnz).astype(np.float32),
-        "row_ptr": np.linspace(0, nnz, rows + 1).astype(np.int32),
-        "labels": rng.random(rows).astype(np.float32),
-        "weights": np.ones(rows, np.float32),
-    }
-    buf = _host_fused(host, rows, nnz)
-    unpack = _get_unpack(rows, nnz)
-    # warm: compile
-    jax.block_until_ready(unpack(jax.device_put(buf))["vals"])
-    t_put, t_both = [], []
+def _time_put_unpack(jax, buf, unpack) -> dict:
+    jax.block_until_ready(unpack(jax.device_put(buf))["vals"])  # compile
+    t_put, t_unp = [], []
     for _ in range(5):
         t0 = time.perf_counter()
         dev = jax.device_put(buf)
@@ -124,17 +110,72 @@ def bench_unpack(jax, np) -> dict:
         t_put.append(time.perf_counter() - t0)
         t1 = time.perf_counter()
         jax.block_until_ready(unpack(dev)["vals"])
-        t_both.append(time.perf_counter() - t1)
-    return {"rows": rows, "nnz": nnz,
-            "buf_mb": round(fused_words(rows, nnz) * 4 / (1 << 20), 1),
+        t_unp.append(time.perf_counter() - t1)
+    return {"buf_mb": round(len(buf) * 4 / (1 << 20), 2),
             "put_median_s": round(statistics.median(t_put), 4),
-            "unpack_median_s": round(statistics.median(t_both), 4)}
+            "unpack_median_s": round(statistics.median(t_unp), 4)}
+
+
+def bench_unpack(jax, np) -> dict:
+    """Put+decode cost for the v2 layout AND the compact v3 layout on the
+    same batch: whether the v3 wire saving survives its on-device decode
+    (shifts + gathers) is the go/no-go for wire compaction on this link."""
+    from dmlc_core_tpu import native
+    from dmlc_core_tpu.data.row_block import RowBlockContainer
+    from dmlc_core_tpu.pipeline.device_loader import (_fused_words_meta,
+                                                      _get_unpack,
+                                                      _host_fused)
+    rows, nnz = 16384, 360448
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1 << 20, nnz).astype(np.int64)
+    vals = (rng.integers(0, 10000, nnz) / 10000).astype(np.float32)
+    row_ptr = np.linspace(0, nnz, rows + 1).astype(np.int64)
+    host = {
+        "ids": ids.astype(np.int32),
+        "vals": vals,
+        "row_ptr": row_ptr.astype(np.int32),
+        "labels": rng.random(rows).astype(np.float32),
+        "weights": np.ones(rows, np.float32),
+    }
+    out = {"rows": rows, "nnz": nnz,
+           "v2": _time_put_unpack(jax, _host_fused(host, rows, nnz),
+                                  _get_unpack(rows, nnz))}
+    if native.has_compact():
+        c = RowBlockContainer()
+        blk = type("B", (), {"offsets": row_ptr, "labels": host["labels"],
+                             "weights": host["weights"],
+                             "indices": ids.astype(np.uint64),
+                             "values": vals, "size": rows})()
+        del c
+        p = native.Packer(rows, nnz, compact=True)
+        items = list(p.feed(blk)) or []
+        tail = p.flush()
+        if tail is not None:
+            items.append(tail)
+        p.close()
+        buf, meta = items[0]
+        out["v3"] = _time_put_unpack(
+            jax, buf[:_fused_words_meta(rows, meta)], _get_unpack(rows, meta))
+        out["v3"]["meta"] = int(meta)
+    return out
 
 
 def main() -> None:
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.join(REPO, ".jax_cache"))
     import jax
+
+    if os.environ.get("DMLC_FORCE_CPU") == "1":
+        # the axon plugin's client init can block on a busy tunnel even
+        # under JAX_PLATFORMS=cpu — drop its factory (same as bench.py)
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge
+            reg = getattr(xla_bridge, "_backend_factories", None)
+            if isinstance(reg, dict):
+                reg.pop("axon", None)
+        except Exception:
+            pass
     import numpy as np
 
     doc = {"platform": jax.devices()[0].platform,
