@@ -336,7 +336,10 @@ class DeviceLoader:
                    high-latency tunnel link that one stream can't saturate.
     wire_compact:  use the native packer's v3 compact wire layout
                    (bit-packed ids + dictionary-coded values, lossless,
-                   ~half the h2d bytes on typical sparse text).  Ignored
+                   ~half the h2d bytes on typical sparse text).  "auto"
+                   (default) enables it only when batches leave the host
+                   (non-CPU backend) — on CPU there is no link to save and
+                   the encode/decode would cost pure host cycles.  Ignored
                    when the native packer is unavailable.
     """
 
@@ -345,9 +348,11 @@ class DeviceLoader:
                  sharding: Optional[jax.sharding.Sharding] = None,
                  prefetch: int = 2, drop_remainder: bool = False,
                  id_mod: int = 0, put_threads: int = 1,
-                 wire_compact: bool = True):
+                 wire_compact="auto"):
         check(layout in ("flat", "rowmajor"), f"bad layout {layout!r}")
-        self.wire_compact = wire_compact
+        if wire_compact == "auto":
+            wire_compact = jax.default_backend() != "cpu"
+        self.wire_compact = bool(wire_compact)
         self.source = source
         self.batch_rows = batch_rows
         self.nnz_cap = nnz_cap
