@@ -118,6 +118,41 @@ def main() -> int:
         result["embed_bag_error"] = f"{type(e).__name__}: {e}"
         log(f"embed_bag bench failed: {e}")
 
+    # --- fused FM two-output kernel (the one FactorizationMachine uses) ---
+    try:
+        from dmlc_core_tpu.ops.pallas_embed import fm_terms_pallas
+        import jax.numpy as jnp
+
+        def fm_xla(ids, vals, table):
+            g = table[ids]
+            return (jnp.einsum("bk,bkd->bd", vals, g),
+                    jnp.einsum("bk,bkd->bd", vals * vals, g * g))
+
+        fm_vs = {}
+        for k in (8, 64):
+            ids = jax.random.randint(key, (rows, k), 0, vocab, jnp.int32)
+            vals = jnp.ones((rows, k), jnp.float32)
+            ref = jax.jit(fm_xla)
+            t_ref = timed(ref, ids, vals, table)
+            try:
+                pal = jax.jit(fm_terms_pallas)
+                r_p, r_x = pal(ids, vals, table), ref(ids, vals, table)
+                for a, b in zip(r_p, r_x):
+                    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                               rtol=2e-4, atol=2e-4)
+                t_pal = timed(pal, ids, vals, table)
+            except Exception as e:  # mosaic compile failure etc.
+                t_pal = None
+                log(f"fm_terms pallas K={k} failed: {type(e).__name__}: {e}")
+            fm_vs[str(k)] = {
+                "xla_us": round(t_ref * 1e6, 1),
+                "pallas_us": round(t_pal * 1e6, 1) if t_pal else None,
+            }
+        result["fm_terms_pallas_vs_xla"] = fm_vs
+    except Exception as e:  # noqa: BLE001
+        result["fm_terms_error"] = f"{type(e).__name__}: {e}"
+        log(f"fm_terms bench failed: {e}")
+
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
     log(f"wrote {out_path}")
