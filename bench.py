@@ -257,10 +257,12 @@ def measure_ours():
                     f"{type(e).__name__}: {e}")
                 return 0.0
 
-        # warm every config first so one-time jit compiles (seconds each on
-        # a TPU) land in the discarded pass, not in a config's score
-        for c in combos:
-            probe_once(c)
+        # warm each distinct compiled program first so one-time jit compiles
+        # (seconds each on a TPU) land in a discarded pass, not in a
+        # config's score; put_threads changes no compilation, so one warm
+        # pass per compact value suffices
+        for cmv in dict.fromkeys(c[1] for c in combos):
+            probe_once((combos[0][0], cmv))
         probe = {c: probe_once(c) for c in combos}
         viable = {c: v for c, v in probe.items() if v > 0}
         pt, cm = (max(viable, key=viable.get) if viable else (1, False))

@@ -70,6 +70,21 @@ def _fused_words_meta(rows: int, meta: int) -> int:
 _unpack_cache: Dict[tuple, object] = {}
 
 
+def _host_segments(view: np.ndarray, rows: int, nnz: int,
+                   words: int) -> np.ndarray:
+    """Per-value row ids computed host-side from the buffer's row_ptr
+    region (pad → ``rows`` scratch row, same contract as the on-device
+    searchsorted).  Used on the CPU backend, where "on-device" searchsorted
+    would run on the host core anyway — at ~50× the cost of np.repeat
+    (measured 16.9ms vs 0.3ms per 393k-value batch)."""
+    voff = words - 3 * rows - 1
+    rp = view[voff:voff + rows + 1]
+    seg = np.full(nnz, rows, np.int32)
+    n = int(rp[rows])
+    seg[:n] = np.repeat(np.arange(rows, dtype=np.int32), np.diff(rp))
+    return seg
+
+
 def _get_unpack(rows: int, meta: int):
     """Jitted on-device unpack of a fused buffer, cached per (rows, meta).
 
@@ -87,7 +102,7 @@ def _get_unpack(rows: int, meta: int):
         import jax.numpy as jnp
         nnz, w, dbits = _decode_meta(meta)
 
-        def _unpack(b):
+        def _unpack(b, segs=None):
             f32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.float32)  # noqa: E731
             u32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.uint32)  # noqa: E731
             if w == 0:  # v2: raw int32 ids, raw f32 vals
@@ -120,7 +135,7 @@ def _get_unpack(rows: int, meta: int):
                     vals = f32(b[iw:iw + nnz])
                     voff = iw + nnz
             rp = b[voff:voff + rows + 1]
-            segments = jnp.searchsorted(
+            segments = segs if segs is not None else jnp.searchsorted(
                 rp[1:], jnp.arange(nnz, dtype=jnp.int32),
                 side="right").astype(jnp.int32)
             return {
@@ -141,9 +156,15 @@ def _get_unpack(rows: int, meta: int):
 
 def _put_fused_buf(buf: np.ndarray, rows: int, meta: int) -> Dict[str, jax.Array]:
     """Transfer a fused int32 buffer in ONE device_put, then decode inside
-    a cached jitted fn (layout chosen by the emit meta)."""
+    a cached jitted fn (layout chosen by the emit meta).  On the CPU
+    backend segments are precomputed host-side (see _host_segments)."""
     words = _fused_words_meta(rows, meta)
     view = buf if len(buf) == words else buf[:words]
+    if jax.default_backend() == "cpu":
+        # same jitted wrapper, two-arg call signature (jit re-specializes)
+        segs = _host_segments(view, rows, _decode_meta(meta)[0], words)
+        return _get_unpack(rows, meta)(
+            jax.device_put(view), jax.device_put(segs))
     return _get_unpack(rows, meta)(jax.device_put(view))
 
 
