@@ -161,10 +161,30 @@ def _put_fused_buf(buf: np.ndarray, rows: int, meta: int) -> Dict[str, jax.Array
     words = _fused_words_meta(rows, meta)
     view = buf if len(buf) == words else buf[:words]
     if jax.default_backend() == "cpu":
-        # same jitted wrapper, two-arg call signature (jit re-specializes)
-        segs = _host_segments(view, rows, _decode_meta(meta)[0], words)
-        return _get_unpack(rows, meta)(
-            jax.device_put(view), jax.device_put(segs))
+        nnz, w, _ = _decode_meta(meta)
+        segs = _host_segments(view, rows, nnz, words)
+        dp = jax.device_put
+        if w == 0:
+            # v2 on CPU: slice copies + per-array puts, no jit dispatch
+            # (measured ~2x cheaper per batch than fused-put + jitted
+            # slices).  The .copy() is load-bearing: device_put of a numpy
+            # VIEW on the CPU backend may alias rather than copy, and an
+            # aliased output would be corrupted when the pooled buffer is
+            # recycled — a fresh owned temp is safe either way and costs
+            # the same single memcpy.
+            f32 = np.float32
+            return {
+                "ids": dp(view[:nnz].copy()),
+                "vals": dp(view[nnz:2 * nnz].copy().view(f32)),
+                "segments": dp(segs),
+                "row_ptr": dp(view[2 * nnz:2 * nnz + rows + 1].copy()),
+                "labels": dp(view[2 * nnz + rows + 1:
+                                  2 * nnz + 2 * rows + 1].copy().view(f32)),
+                "weights": dp(
+                    view[2 * nnz + 2 * rows + 1:words].copy().view(f32)),
+            }
+        # compact v3 on CPU (explicit opt-in): jitted decode, host segments
+        return _get_unpack(rows, meta)(dp(view), dp(segs))
     return _get_unpack(rows, meta)(jax.device_put(view))
 
 
@@ -533,11 +553,14 @@ class DeviceLoader:
             if item[0] == "fused":
                 _, buf, nnz, rows_real = item
                 out = _put_fused_buf(buf, self.batch_rows, nnz)
+                # wait on the WHOLE batch before recycling: the CPU direct
+                # path issues independent per-array puts, so readiness of
+                # one leaf doesn't imply the others have copied the buffer
                 if sync:
-                    jax.block_until_ready(out["vals"])
+                    jax.block_until_ready(out)
                     self._pool.put(buf)
                 else:
-                    self._ring_push(out["vals"], buf)
+                    self._ring_push(out, buf)
             else:
                 host = item[1]
                 rows_real = host.pop("_rows", self.batch_rows)
@@ -555,8 +578,9 @@ class DeviceLoader:
             self._m_rows.add(rows_real)
         return out
 
-    def _ring_push(self, leaf: jax.Array, buf: np.ndarray) -> None:
-        """Track an in-flight transfer; once the ring is deeper than the
+    def _ring_push(self, leaf, buf: np.ndarray) -> None:
+        """Track an in-flight transfer (``leaf`` is any pytree of device
+        arrays — the whole batch dict); once the ring is deeper than the
         pipeline depth, wait for the oldest to land and recycle its host
         buffer (steady state: zero allocation, bounded device memory)."""
         self._inflight.append((leaf, buf))
