@@ -317,6 +317,59 @@ def bench_allreduce_mesh8() -> dict:
         "note": "16MiB psum on the 8-device virtual host mesh"}
 
 
+def bench_sp_mesh8() -> dict:
+    """Sequence-parallel attention wall time on the 8-device virtual mesh:
+    ring (ppermute + online softmax) vs Ulysses (all-to-all) on the same
+    sharded QKV — the long-context analog of allreduce_mesh8, so the sp
+    layer's round-over-round movement is visible with one real chip."""
+    import subprocess
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from jax._src import xla_bridge\n"
+        "reg = getattr(xla_bridge, '_backend_factories', None)\n"
+        "isinstance(reg, dict) and reg.pop('axon', None)\n"
+        "import time, numpy as np, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "from dmlc_core_tpu.ops.ring_attention import make_ring_attention\n"
+        "from dmlc_core_tpu.ops.ulysses import make_ulysses_attention\n"
+        "devs = jax.devices(); n = len(devs)\n"
+        "mesh = Mesh(np.array(devs), ('sp',))\n"
+        "B, H, S, D = 1, 8, 2048, 64\n"
+        "rng = np.random.default_rng(0)\n"
+        "sh = NamedSharding(mesh, P(None, None, 'sp', None))\n"
+        "qkv = [jax.device_put(rng.standard_normal((B, H, S, D),\n"
+        "       dtype=np.float32), sh) for _ in range(3)]\n"
+        "out = {}\n"
+        "for name, mk in (('ring', make_ring_attention),\n"
+        "                 ('ulysses', make_ulysses_attention)):\n"
+        "    f = mk(mesh, 'sp', causal=True)\n"
+        "    f(*qkv)[0].block_until_ready()\n"
+        "    best = 1e9\n"
+        "    for _ in range(5):\n"
+        "        t0 = time.perf_counter(); f(*qkv).block_until_ready()\n"
+        "        best = min(best, time.perf_counter() - t0)\n"
+        "    out[name] = best\n"
+        "print('RESULT', n, out['ring'], out['ulysses'])\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=900, env=env)
+    if out.returncode != 0:
+        raise RuntimeError(f"sp_mesh8 child rc={out.returncode}: "
+                           f"{out.stderr[-500:]}")
+    line = next((ln for ln in out.stdout.splitlines()
+                 if ln.startswith("RESULT")), None)
+    if line is None:
+        raise RuntimeError(f"sp_mesh8 child produced no RESULT; stderr: "
+                           f"{out.stderr[-500:]}")
+    _, n, ring_s, uly_s = line.split()
+    return {"metric": "sp_mesh8_attention_wall",
+            "value": round(float(ring_s) * 1e3, 2), "unit": "ms",
+            "ulysses_ms": round(float(uly_s) * 1e3, 2), "devices": int(n),
+            "note": "B1 H8 S2048 D64 causal attention, seq sharded 8-way"}
+
+
 ALL = {
     "libsvm": bench_libsvm,
     "csv": bench_csv,
@@ -326,6 +379,7 @@ ALL = {
     "fm_train": bench_fm_train,
     "allreduce": bench_allreduce,
     "allreduce_mesh8": bench_allreduce_mesh8,
+    "sp_mesh8": bench_sp_mesh8,
 }
 
 
