@@ -47,6 +47,12 @@ def submit_slurm(args, tracker_envs: Dict[str, str]) -> int:
     cmd = ["srun", "-n", str(nproc)]
     if args.slurm_partition:
         cmd += ["-p", args.slurm_partition]
+    # reference opts.py --slurm-worker-nodes/--slurm-server-nodes: pin the
+    # node count; one srun hosts both roles here, so the counts add
+    nodes = ((args.slurm_worker_nodes or 0)
+             + (args.slurm_server_nodes or 0))
+    if nodes:
+        cmd += ["-N", str(nodes)]
     cmd.append(script)
     return _launch(args, cmd, "slurm", script)
 

@@ -66,10 +66,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tracker bind address (default: auto-detect)")
     p.add_argument("--sync-dst-dir", default=None,
                    help="ssh: rsync the working dir to this path on each host")
+    p.add_argument("--queue", default=None,
+                   help="scheduler queue (reference opts.py:96); maps to "
+                        "the backend-specific queue unless that is set "
+                        "explicitly (--sge-queue/--yarn-queue/"
+                        "--slurm-partition)")
     p.add_argument("--slurm-partition", default=None)
+    p.add_argument("--slurm-worker-nodes", type=int, default=None,
+                   help="slurm: node count for the worker srun "
+                        "(reference opts.py --slurm-worker-nodes)")
+    p.add_argument("--slurm-server-nodes", type=int, default=None,
+                   help="slurm: node count for the server srun")
     p.add_argument("--sge-queue", default=None)
     p.add_argument("--yarn-queue", default=None,
                    help="yarn: capacity-scheduler queue")
+    p.add_argument("--yarn-app-classpath", default=None,
+                   help="yarn: extra classpath exported to containers as "
+                        "DMLC_YARN_APP_CLASSPATH (reference opts.py:118)")
+    p.add_argument("--yarn-app-dir", default=None,
+                   help="yarn: staging dir for shipped job files "
+                        "(reference yarn.py jar/app dir)")
     p.add_argument("--mesos-master", default=None,
                    help="mesos: master host:port (env MESOS_MASTER)")
     p.add_argument("--dry-run", action="store_true",
@@ -108,6 +124,14 @@ def get_opts(argv: Optional[List[str]] = None) -> argparse.Namespace:
             build_parser().error(f"--env expects K=V, got {kv!r}")
         k, v = kv.split("=", 1)
         args.extra_env[k] = v
+    # generic --queue (reference name) maps onto whichever backend queue
+    # wasn't given explicitly
+    if args.queue:
+        args.sge_queue = args.sge_queue or args.queue
+        args.yarn_queue = args.yarn_queue or args.queue
+        args.slurm_partition = args.slurm_partition or args.queue
+    if args.yarn_app_dir:
+        args.extra_env.setdefault("DMLC_YARN_APP_DIR", args.yarn_app_dir)
     for which in ("worker", "server"):
         spec = getattr(args, f"{which}_memory")
         if spec is not None:

@@ -69,6 +69,10 @@ def build_yarn_command(args, tracker_envs: Dict[str, str]) -> List[str]:
         cmd += ["-appname", args.jobname]
     if args.yarn_queue:
         cmd += ["-queue", args.yarn_queue]
+    if getattr(args, "yarn_app_classpath", None):
+        # reference opts.py:118: forwarded into the container env
+        cmd += ["-shell_env",
+                f"DMLC_YARN_APP_CLASSPATH={args.yarn_app_classpath}"]
     return cmd
 
 

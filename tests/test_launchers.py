@@ -356,6 +356,32 @@ def test_opts_memory_forms_and_server_resources():
     assert env["DMLC_WORKER_MEMORY_MB"] == "2048"
 
 
+def test_opts_generic_queue_and_slurm_nodes():
+    """Reference opts parity: --queue maps onto each backend's queue
+    unless given explicitly; --slurm-worker/server-nodes pin srun -N."""
+    args = _args("slurm", ["--queue", "prod", "--slurm-worker-nodes", "3",
+                           "--slurm-server-nodes", "1", "--yarn-app-dir",
+                           "/stage/app"])
+    assert args.sge_queue == "prod"
+    assert args.yarn_queue == "prod"
+    assert args.slurm_partition == "prod"
+    assert args.extra_env["DMLC_YARN_APP_DIR"] == "/stage/app"
+    args2 = _args("sge", ["--queue", "prod", "--sge-queue", "special"])
+    assert args2.sge_queue == "special"  # explicit wins
+
+    import dmlc_core_tpu.parallel.launcher.batch as batch
+    seen = {}
+    orig = batch._launch
+    batch._launch = lambda a, cmd, label, script: seen.update(cmd=cmd) or 0
+    try:
+        batch.submit_slurm(args, dict(ENVS))
+    finally:
+        batch._launch = orig
+    cmd = seen["cmd"]
+    assert cmd[cmd.index("-N") + 1] == "4"
+    assert cmd[cmd.index("-p") + 1] == "prod"
+
+
 def test_opts_sge_log_dir_forwarded(tmp_path):
     import dmlc_core_tpu.parallel.launcher.batch as batch
     args = _args("sge", ["--sge-log-dir", str(tmp_path), "--dry-run"])
