@@ -650,6 +650,7 @@ struct PackerC {
   // high-cardinality values) dictionary coding is disabled for good.
   bool compact = false;
   uint32_t ormask = 0;                  // OR of staged ids → bit width
+  std::vector<uint16_t> codes_scratch;  // per-batch value codes (pre-pack)
   // open-addressing slots: key | code<<32 in ONE uint64 (one cache line
   // per probe); slot 0 = empty (key 0 ⇒ reserved code 0, never stored)
   std::vector<uint64_t> dslots;
@@ -750,6 +751,28 @@ struct PackerC {
     return p;
   }
 
+  // pack n w-bit values into dst (dst_words pre-sized; zeroed tail = the
+  // nnz padding, which must decode to id 0 / code 0)
+  template <typename T>
+  static void pack_bits(const T* src, int64_t n, int w, int32_t* dst,
+                        int64_t dst_words) {
+    std::memset(dst, 0, dst_words * 4);
+    uint64_t acc = 0;
+    int bits = 0;
+    int32_t* d = dst;
+    for (int64_t i = 0; i < n; ++i) {
+      acc |= static_cast<uint64_t>(static_cast<uint32_t>(src[i])) << bits;
+      bits += w;
+      while (bits >= 32) {
+        *d++ = static_cast<int32_t>(static_cast<uint32_t>(acc));
+        acc >>= 32;
+        bits -= 32;
+      }
+    }
+    if (bits > 0)
+      *d = static_cast<int32_t>(static_cast<uint32_t>(acc));
+  }
+
   int64_t emit_v3(int32_t* out) {
     const int64_t B = bucket();
     // id bit width from the staged OR-mask (same top bit as the max),
@@ -759,27 +782,10 @@ struct PackerC {
     w = (w + 3) & ~3;
     if (w < 8) w = 8;
     const int64_t IW = (B * static_cast<int64_t>(w) + 31) / 32;
-    std::memset(out, 0, IW * 4);
-    {
-      uint64_t acc = 0;
-      int bits = 0;
-      int32_t* dst = out;
-      for (int64_t i = 0; i < nnz_count; ++i) {
-        acc |= static_cast<uint64_t>(static_cast<uint32_t>(ids_s[i])) << bits;
-        bits += w;
-        while (bits >= 32) {
-          *dst++ = static_cast<int32_t>(static_cast<uint32_t>(acc));
-          acc >>= 32;
-          bits -= 32;
-        }
-      }
-      if (bits > 0)
-        *dst = static_cast<int32_t>(static_cast<uint32_t>(acc));
-    }
+    pack_bits(ids_s.data(), nnz_count, w, out, IW);
     // values: dictionary attempt (code 0 reserved for 0.0f = nnz padding);
-    // codes are u16, so the dict never exceeds 65536 entries (cap 65535 +
-    // the reserved zero)
-    const int64_t CW = (B + 1) / 2;
+    // codes bit-pack at exactly dbits = log2(dict_words) — binary-feature
+    // datasets (2-entry dict) ship 1-bit codes instead of u16
     const int64_t cap = std::min<int64_t>(65535, B / 2);
     bool dict_ok = cap >= 2 && !dict_disabled;
     int dbits = 0;
@@ -790,8 +796,8 @@ struct PackerC {
         dvals.push_back(0);  // code 0 → 0.0f
         dict_rebuild(4096);
       }
-      uint16_t* codes16 = reinterpret_cast<uint16_t*>(out + IW);
-      std::memset(codes16, 0, CW * 4);
+      if (static_cast<int64_t>(codes_scratch.size()) < nnz_cap)
+        codes_scratch.resize(nnz_cap);
       const uint32_t* vb = reinterpret_cast<const uint32_t*>(vals_s.data());
       for (int64_t i = 0; i < nnz_count; ++i) {
         const int32_t code = val_code(vb[i], cap);
@@ -800,30 +806,31 @@ struct PackerC {
           if (++dict_strikes >= 2) dict_disabled = true;
           break;
         }
-        codes16[i] = static_cast<uint16_t>(code);
+        codes_scratch[i] = static_cast<uint16_t>(code);
       }
       if (dict_ok) {
         dict_strikes = 0;
-        // floor DW so a growing dict doesn't step through every pow2 and
-        // trigger a device-side jit recompile per step (dbits is part of
-        // the unpack cache key); the floor costs ≤16KB/batch on the wire.
-        // Small caps (tiny test batches) skip it — there CW+DW must stay
-        // under B for dict mode to engage at all
-        const int64_t dfloor = cap >= 4096 ? 4096 : 2;
-        const int64_t DW = next_pow2(
-            std::max<int64_t>(static_cast<int64_t>(dvals.size()), dfloor));
+        // quantize dbits to the even ladder {2,4,...,16} so a growing
+        // dict steps through ≤8 code widths total (dbits is part of the
+        // device-side jit cache key, and each new width is a recompile) —
+        // binary-feature data still gets 2-bit codes, at most one wasted
+        // bit per code elsewhere
+        int db = 0;
+        for (int64_t t = next_pow2(static_cast<int64_t>(dvals.size()));
+             t > 1; t >>= 1) ++db;
+        db = ((db + 1) / 2) * 2;
+        if (db < 2) db = 2;
+        const int64_t DW = 1ll << db;
+        const int64_t CW = (B * static_cast<int64_t>(db) + 31) / 32;
         if (CW + DW > B) {
           dict_ok = false;  // dict doesn't beat raw for this (small) batch
         } else {
+          pack_bits(codes_scratch.data(), nnz_count, db, out + IW, CW);
           int32_t* dreg = out + IW + CW;
           std::memset(dreg, 0, DW * 4);
           std::memcpy(dreg, dvals.data(), dvals.size() * 4);
           vw = CW + DW;
-          int64_t t = DW;
-          while (t > 1) {
-            t >>= 1;
-            ++dbits;
-          }
+          dbits = db;
         }
       }
     }

@@ -63,7 +63,7 @@ def _fused_words_meta(rows: int, meta: int) -> int:
     if w == 0:
         return fused_words(rows, nnz)
     iw = (nnz * w + 31) // 32
-    vw = ((nnz + 1) // 2 + (1 << dbits)) if dbits else nnz
+    vw = ((nnz * dbits + 31) // 32 + (1 << dbits)) if dbits else nnz
     return iw + vw + 3 * rows + 1
 
 
@@ -109,26 +109,28 @@ def _get_unpack(rows: int, meta: int):
                 ids = b[:nnz]
                 vals = f32(b[nnz:2 * nnz])
                 voff = 2 * nnz
-            else:  # v3: w-bit packed ids
+            else:  # v3: bit-packed ids (and codes)
+                def unpack_bits(region, width):
+                    pu = u32(region)
+                    i = jnp.arange(nnz, dtype=jnp.uint32)
+                    bitpos = i * jnp.uint32(width)
+                    word = (bitpos >> 5).astype(jnp.int32)
+                    off = bitpos & jnp.uint32(31)
+                    lo = pu[word] >> off
+                    hi = pu[jnp.minimum(word + 1, len(region) - 1)] << (
+                        jnp.where(off > 0, jnp.uint32(32) - off,
+                                  jnp.uint32(0)))
+                    hi = jnp.where(off > 0, hi, jnp.uint32(0))
+                    mask = jnp.uint32(
+                        0xFFFFFFFF if width >= 32 else (1 << width) - 1)
+                    return ((lo | hi) & mask).astype(jnp.int32)
+
                 iw = (nnz * w + 31) // 32
-                pu = u32(b[:iw])
-                i = jnp.arange(nnz, dtype=jnp.uint32)
-                bitpos = i * jnp.uint32(w)
-                word = (bitpos >> 5).astype(jnp.int32)
-                off = bitpos & jnp.uint32(31)
-                lo = pu[word] >> off
-                hi = pu[jnp.minimum(word + 1, iw - 1)] << jnp.where(
-                    off > 0, jnp.uint32(32) - off, jnp.uint32(0))
-                hi = jnp.where(off > 0, hi, jnp.uint32(0))
-                mask = jnp.uint32(0xFFFFFFFF if w >= 32 else (1 << w) - 1)
-                ids = ((lo | hi) & mask).astype(jnp.int32)
-                if dbits:  # dict-coded values: u16 code gather
-                    cw = (nnz + 1) // 2
+                ids = unpack_bits(b[:iw], w)
+                if dbits:  # dict-coded values: dbits-wide codes + gather
+                    cw = (nnz * dbits + 31) // 32
                     dw = 1 << dbits
-                    cu = u32(b[iw:iw + cw])
-                    half = (i & jnp.uint32(1)) * jnp.uint32(16)
-                    codes = ((cu[(i >> 1).astype(jnp.int32)] >> half)
-                             & jnp.uint32(0xFFFF)).astype(jnp.int32)
+                    codes = unpack_bits(b[iw:iw + cw], dbits)
                     vals = f32(b[iw + cw:iw + cw + dw])[codes]
                     voff = iw + cw + dw
                 else:  # raw f32 fallback
