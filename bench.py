@@ -287,12 +287,17 @@ def main() -> None:
     gen_data()
     require_tpu = os.environ.get("DMLC_REQUIRE_TPU") == "1"
     if require_tpu:
-        # retry-loop mode: probe FIRST so a busy tunnel costs no CPU (the
-        # baseline build+run is a minute of single-core time per attempt)
+        # retry-loop mode: measure the baseline BEFORE the probe — once the
+        # probe wins the single-tenant tunnel, nothing may sit between it
+        # and our runs or another tenant can steal the grant back.  The
+        # binary is build-cached, so this costs one ~45s reference run per
+        # attempt against up-to-30min probe waits.
+        base1 = measure_reference()
         if not probe_tpu():
             log("DMLC_REQUIRE_TPU=1 and no TPU → exiting 9")
             sys.exit(9)
-    base1 = measure_reference()
+    else:
+        base1 = measure_reference()
     if not require_tpu and not probe_tpu():
         force_cpu()
     value, runs, (put_threads, compact), platform = measure_ours()
