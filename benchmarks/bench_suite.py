@@ -85,9 +85,18 @@ def _ingest_rate(uri: str, fmt: str, parts: int = 1) -> float:
         t0 = time.perf_counter()
         last = None
         for part in range(parts):
+            # honor the root bench's tuning knobs so a winning config found
+            # by bench.py's probe can be applied suite-wide
+            kw = {}
+            pt = int(os.environ.get("DMLC_BENCH_PUT_THREADS", "1"))
+            if pt > 1:
+                kw["put_threads"] = pt
+            cm = os.environ.get("DMLC_BENCH_COMPACT")
+            if cm is not None:
+                kw["wire_compact"] = cm != "0"
             loader = DeviceLoader(
                 create_parser(uri, part, parts, fmt),
-                batch_rows=4096, nnz_cap=131072, prefetch=4)
+                batch_rows=4096, nnz_cap=131072, prefetch=4, **kw)
             for batch in loader:
                 last = batch
             loader.close()
