@@ -140,6 +140,16 @@ def probe_tpu(timeout_s: int = 0) -> bool:
     return False
 
 
+def require_tpu_or_exit(platform: str) -> None:
+    """The DMLC_REQUIRE_TPU=1 contract, shared by every harvest script:
+    never write cpu numbers under a tpu-named artifact — exit 9 (which
+    harvest_run.sh treats as 'grant lost, abort') when the backend fell
+    back to cpu."""
+    if os.environ.get("DMLC_REQUIRE_TPU") == "1" and platform == "cpu":
+        log("DMLC_REQUIRE_TPU=1 and no TPU → exiting 9")
+        sys.exit(9)
+
+
 def force_cpu() -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -153,7 +163,8 @@ def force_cpu() -> None:
 
 
 def measure_ours():
-    """Returns (mean_mbps, per_run_mbps, (put_threads, compact), platform)."""
+    """Returns (mean_mbps, per_run_mbps, (put_threads, compact, rows),
+    platform)."""
     sys.path.insert(0, REPO)
     from dmlc_core_tpu import native
     if not native.available():
@@ -237,6 +248,7 @@ def measure_ours():
     cm_env = os.environ.get("DMLC_BENCH_COMPACT")
     pts = [int(pt_env)] if pt_env else [1, 4]
     cms = [cm_env != "0"] if cm_env is not None else [True, False]
+    shapes = [(batch_rows, nnz_cap)]
     if platform == "cpu":
         # no tunnel: extra put threads only time-slice the host core, and
         # compact wire spends host cycles to save a link that isn't there
@@ -244,47 +256,50 @@ def measure_ours():
             pts = [1]
         if cm_env is None:
             cms = [False]
-    combos = [(p, c) for c in cms for p in pts]
+    elif "DMLC_BENCH_ROWS" not in os.environ:
+        # the tunnelled device pays a per-put RPC latency that favours
+        # bigger batches; which size wins depends on the day's link, so the
+        # batch shape is part of the probed config space, not a separate
+        # afterthought stage
+        shapes.append((3 * batch_rows, 3 * nnz_cap))
+    combos = [(p, c, s) for c in cms for s in shapes for p in pts]
     if len(combos) > 1:
-        # the tunnel decides: probe transfer streams × wire compaction,
-        # keep the winning config for the timed runs; a config that fails
-        # outright (e.g. a lowering quirk on the real backend) scores 0
-        # instead of killing the bench
+        # the tunnel decides: probe transfer streams × wire compaction ×
+        # batch shape, keep the winning config for the timed runs; a config
+        # that fails outright (e.g. a lowering quirk on the real backend)
+        # scores 0 instead of killing the bench
         def probe_once(c):
             try:
-                return run_once(*c)
+                return run_once(c[0], c[1], *c[2])
             except Exception as e:  # noqa: BLE001
-                log(f"  config pt={c[0]},compact={int(c[1])} failed: "
-                    f"{type(e).__name__}: {e}")
+                log(f"  config pt={c[0]},compact={int(c[1])},"
+                    f"rows={c[2][0]} failed: {type(e).__name__}: {e}")
                 return 0.0
 
         # warm each distinct compiled program first so one-time jit compiles
         # (seconds each on a TPU) land in a discarded pass, not in a
         # config's score; put_threads changes no compilation, so one warm
-        # pass per compact value suffices
-        for cmv in dict.fromkeys(c[1] for c in combos):
-            probe_once((combos[0][0], cmv))
+        # pass per (compact, shape) pair suffices
+        for key in dict.fromkeys((c[1], c[2]) for c in combos):
+            probe_once((pts[0],) + key)
+        # screen-then-confirm: single timings on the shared host + tunnel
+        # carry one-sided noise (transient stalls), so the top screened
+        # configs get a second run and score by their BEST — a single noisy
+        # sample once mis-picked the batch shape by 1.5x (r3 harvest log)
         probe = {c: probe_once(c) for c in combos}
+        for c in sorted((c for c, v in probe.items() if v > 0),
+                        key=probe.get, reverse=True)[:3]:
+            probe[c] = max(probe[c], probe_once(c))
         viable = {c: v for c, v in probe.items() if v > 0}
-        pt, cm = (max(viable, key=viable.get) if viable else (1, False))
+        pt, cm, shape = (max(viable, key=viable.get) if viable
+                         else (1, False, shapes[0]))
         log("  config probe: " + " ".join(
-            f"pt={k[0]},compact={int(k[1])}:{v:.1f}MB/s"
-            for k, v in probe.items()) + f" → pt={pt} compact={int(cm)}")
+            f"pt={k[0]},compact={int(k[1])},rows={k[2][0]}:{v:.1f}MB/s"
+            for k, v in probe.items())
+            + f" → pt={pt} compact={int(cm)} rows={shape[0]}")
     else:
-        pt, cm = combos[0]
-        run_once(pt, cm)  # warm-up: compile/caches
-    # second stage: batch-shape probe at the winning transfer config — the
-    # per-put RPC latency of a tunnelled device favors bigger batches
-    shape = (batch_rows, nnz_cap)
-    if platform != "cpu" and "DMLC_BENCH_ROWS" not in os.environ:
-        big = (3 * batch_rows, 3 * nnz_cap)
-        run_once(pt, cm, *big)  # warm: compiles for the bigger shapes
-        cur = run_once(pt, cm)
-        alt = run_once(pt, cm, *big)
-        if alt > cur:
-            shape = big
-        log(f"  shape probe: rows={batch_rows}:{cur:.1f} "
-            f"rows={big[0]}:{alt:.1f} MB/s → rows={shape[0]}")
+        (pt, cm, shape), = combos
+        run_once(pt, cm, *shape)  # warm-up: compile/caches
     runs = [run_once(pt, cm, *shape) for _ in range(3)]
     spread = (max(runs) - min(runs)) / max(runs)
     log(f"  timed runs (pt={pt}, compact={int(cm)}, rows={shape[0]}): "

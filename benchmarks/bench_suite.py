@@ -399,9 +399,7 @@ def main() -> None:
     # register hook overrides JAX_PLATFORMS, so the pin must be config-level)
     import bench
     if not bench.probe_tpu():
-        if os.environ.get("DMLC_REQUIRE_TPU") == "1":
-            log("DMLC_REQUIRE_TPU=1 and no TPU → exiting 9")
-            sys.exit(9)
+        bench.require_tpu_or_exit("cpu")
         bench.force_cpu()
     import jax
     platform = jax.devices()[0].platform

@@ -167,16 +167,13 @@ def main() -> None:
 
     if os.environ.get("DMLC_FORCE_CPU") == "1":
         # the axon plugin's client init can block on a busy tunnel even
-        # under JAX_PLATFORMS=cpu — drop its factory (same as bench.py)
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            from jax._src import xla_bridge
-            reg = getattr(xla_bridge, "_backend_factories", None)
-            if isinstance(reg, dict):
-                reg.pop("axon", None)
-        except Exception:
-            pass
+        # under JAX_PLATFORMS=cpu — pin cpu + drop its backend factory
+        import bench
+        bench.force_cpu()
     import numpy as np
+
+    import bench as bench_mod
+    bench_mod.require_tpu_or_exit(jax.devices()[0].platform)
 
     doc = {"platform": jax.devices()[0].platform,
            "put_bw": bench_put_bw(jax, np),

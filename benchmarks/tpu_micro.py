@@ -52,10 +52,18 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    if os.environ.get("DMLC_FORCE_CPU") == "1":
+        # the axon plugin's client init can block on a busy tunnel even
+        # under JAX_PLATFORMS=cpu — pin cpu + drop its backend factory
+        import bench
+        bench.force_cpu()
+
     log("initialising backend (jax.devices()) ...")
     devs = jax.devices()
     dev = devs[0]
     log(f"backend up: {dev.platform} / {dev.device_kind} x{len(devs)}")
+    import bench as bench_mod
+    bench_mod.require_tpu_or_exit(dev.platform)
     result = {
         "platform": dev.platform,
         "device_kind": str(dev.device_kind),

@@ -11,9 +11,11 @@ Layout: ids/vals are **row-padded** ``[B, K]`` (K = max nnz/row, padding id 0
 with val 0; see ``pipeline.packing.pack_rowmajor``).  The table stays in HBM
 (``memory_space=ANY``) — F is typically far larger than VMEM.
 
-Grid: one program per row; per row a K-step ``fori_loop`` with 2-slot DMA
-double buffering (pallas_guide.md §Async DMA / §Double Buffering).  Use
-``interpret=True`` for CPU tests.
+Grid: one program per 8-row block (the f32 sublane tile — Mosaic rejects
+1-row output blocks); ids/vals ride scalar prefetch in SMEM, and each row
+runs a K-step ``fori_loop`` with 2-slot DMA double buffering
+(pallas_guide.md §Async DMA / §Double Buffering / §PrefetchScalarGridSpec).
+Use ``interpret=True`` for CPU tests.
 """
 
 from __future__ import annotations
@@ -176,65 +178,76 @@ def embed_bag_reference(ids: jax.Array, vals: jax.Array, table: jax.Array,
     return jnp.einsum("bk,bkd->bd", vals, g)
 
 
+# Rows handled per grid step.  f32 blocked operands must tile to (8, 128):
+# an 8-row output block keeps the second-minor dimension a sublane multiple
+# (Mosaic rejects (1, D) row blocks outright), and ids/vals ride scalar
+# prefetch in SMEM so they need no blocked layout at all.
+_ROWS = 8
+
+
 def _kernel(ids_ref, vals_ref, table_ref, out_ref, buf, sems, *, K: int,
-            D: int, square: bool):
+            D: int, B: int, square: bool):
     b = pl.program_id(0)
+    for r in range(_ROWS):          # static unroll: one DMA pipeline per row
+        # tail block of a non-multiple-of-8 batch: clamp to the last real
+        # row (its ids are in-range; the duplicate output rows are dropped
+        # by the block writeback mask)
+        base = jnp.minimum(b * _ROWS + r, B - 1) * K
 
-    def row_copy(k, slot):
-        idx = ids_ref[b * K + k]
-        return pltpu.make_async_copy(
-            table_ref.at[pl.ds(idx, 1), :], buf.at[slot], sems.at[slot])
+        def cp(k, slot, base=base):
+            idx = ids_ref[base + k]
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(idx, 1), :], buf.at[slot], sems.at[slot])
 
-    # prologue: fill slot 0
-    row_copy(0, 0).start()
+        cp(0, 0).start()            # prologue: fill slot 0
 
-    def body(k, acc):
-        slot = jax.lax.rem(k, 2)
-        nxt_slot = jax.lax.rem(k + 1, 2)
+        def body(k, acc, base=base, cp=cp):
+            slot = jax.lax.rem(k, 2)
 
-        @pl.when(k + 1 < K)
-        def _start_next():
-            row_copy(k + 1, nxt_slot).start()
+            @pl.when(k + 1 < K)
+            def _start_next():
+                cp(k + 1, jax.lax.rem(k + 1, 2)).start()
 
-        row_copy(k, slot).wait()
-        row = buf[slot, 0, :]
-        if square:                      # static: traced once per variant
-            row = row * row
-        return acc + row * vals_ref[0, k]
+            cp(k, slot).wait()
+            g = buf[slot]                    # (1, D)
+            if square:                       # static: traced once per variant
+                g = g * g
+            return acc + g * vals_ref[base + k]
 
-    acc = jax.lax.fori_loop(0, K, body, jnp.zeros((D,), jnp.float32))
-    out_ref[0, :] = acc
+        acc = jax.lax.fori_loop(0, K, body, jnp.zeros((1, D), jnp.float32))
+        out_ref[pl.ds(r, 1), :] = acc
 
 
 def _fm_kernel(ids_ref, vals_ref, table_ref, out1_ref, out2_ref, buf, sems,
-               *, K: int, D: int):
+               *, K: int, D: int, B: int):
     b = pl.program_id(0)
+    for r in range(_ROWS):
+        base = jnp.minimum(b * _ROWS + r, B - 1) * K
 
-    def row_copy(k, slot):
-        idx = ids_ref[b * K + k]
-        return pltpu.make_async_copy(
-            table_ref.at[pl.ds(idx, 1), :], buf.at[slot], sems.at[slot])
+        def cp(k, slot, base=base):
+            idx = ids_ref[base + k]
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(idx, 1), :], buf.at[slot], sems.at[slot])
 
-    row_copy(0, 0).start()
+        cp(0, 0).start()
 
-    def body(k, accs):
-        a1, a2 = accs
-        slot = jax.lax.rem(k, 2)
-        nxt_slot = jax.lax.rem(k + 1, 2)
+        def body(k, accs, base=base, cp=cp):
+            a1, a2 = accs
+            slot = jax.lax.rem(k, 2)
 
-        @pl.when(k + 1 < K)
-        def _start_next():
-            row_copy(k + 1, nxt_slot).start()
+            @pl.when(k + 1 < K)
+            def _start_next():
+                cp(k + 1, jax.lax.rem(k + 1, 2)).start()
 
-        row_copy(k, slot).wait()
-        row = buf[slot, 0, :]
-        v = vals_ref[0, k]
-        return a1 + row * v, a2 + (row * row) * (v * v)
+            cp(k, slot).wait()
+            g = buf[slot]                    # (1, D)
+            v = vals_ref[base + k]
+            return a1 + g * v, a2 + (g * g) * (v * v)
 
-    zero = jnp.zeros((D,), jnp.float32)
-    a1, a2 = jax.lax.fori_loop(0, K, body, (zero, zero))
-    out1_ref[0, :] = a1
-    out2_ref[0, :] = a2
+        zero = jnp.zeros((1, D), jnp.float32)
+        a1, a2 = jax.lax.fori_loop(0, K, body, (zero, zero))
+        out1_ref[pl.ds(r, 1), :] = a1
+        out2_ref[pl.ds(r, 1), :] = a2
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -244,27 +257,25 @@ def fm_terms_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
     B, K = ids.shape
     F, D = table.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, K), lambda b, ids: (b, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[pl.BlockSpec((1, D), lambda b, ids: (b, 0)),
-                   pl.BlockSpec((1, D), lambda b, ids: (b, 0))],
+        num_scalar_prefetch=2,        # flat ids + vals land in SMEM
+        grid=(pl.cdiv(B, _ROWS),),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],    # table in HBM
+        out_specs=[pl.BlockSpec((_ROWS, D), lambda b, ids, vals: (b, 0)),
+                   pl.BlockSpec((_ROWS, D), lambda b, ids, vals: (b, 0))],
         scratch_shapes=[
             pltpu.VMEM((2, 1, D), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    kernel = functools.partial(_fm_kernel, K=K, D=D)
+    kernel = functools.partial(_fm_kernel, K=K, D=D, B=B)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((B, D), jnp.float32),
                    jax.ShapeDtypeStruct((B, D), jnp.float32)],
         interpret=interpret,
-    )(ids.reshape(-1).astype(jnp.int32), vals.astype(jnp.float32), table)
+    )(ids.reshape(-1).astype(jnp.int32),
+      vals.reshape(-1).astype(jnp.float32), table)
 
 
 @functools.partial(jax.jit, static_argnames=("square", "interpret"))
@@ -275,22 +286,20 @@ def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
     B, K = ids.shape
     F, D = table.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,            # flat ids land in SMEM pre-kernel
-        grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, K), lambda b, ids: (b, 0)),        # vals row
-            pl.BlockSpec(memory_space=pl.ANY),               # table in HBM
-        ],
-        out_specs=pl.BlockSpec((1, D), lambda b, ids: (b, 0)),
+        num_scalar_prefetch=2,        # flat ids + vals land in SMEM
+        grid=(pl.cdiv(B, _ROWS),),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],    # table in HBM
+        out_specs=pl.BlockSpec((_ROWS, D), lambda b, ids, vals: (b, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, 1, D), jnp.float32),  # double-buffer slots
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    kernel = functools.partial(_kernel, K=K, D=D, square=square)
+    kernel = functools.partial(_kernel, K=K, D=D, B=B, square=square)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, D), jnp.float32),
         interpret=interpret,
-    )(ids.reshape(-1).astype(jnp.int32), vals.astype(jnp.float32), table)
+    )(ids.reshape(-1).astype(jnp.int32),
+      vals.reshape(-1).astype(jnp.float32), table)
