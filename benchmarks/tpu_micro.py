@@ -72,10 +72,22 @@ def main() -> int:
     }
 
     # --- bf16 matmul TFLOP/s (MXU) ---
-    n = 4096
-    x = jnp.ones((n, n), jnp.bfloat16)
-    mm = jax.jit(lambda a, b: a @ b)
-    dt = timed(mm, x, x)
+    # CHAINED matmuls inside one jit: r02's version timed 10 independent
+    # identical dispatches and read an impossible 6886 TFLOP/s on a v5e
+    # (~394 peak) — the tunnel runtime can overlap or outright elide
+    # duplicate (program, args) executions.  A data-dependent chain forces
+    # every multiply to actually run, and one dispatch amortises the RPC.
+    n, chain_len = 4096, 10
+    x = (jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+         * (1.0 / np.sqrt(n))).astype(jnp.bfloat16)
+
+    @jax.jit
+    def mm_chain(a):
+        def body(_, acc):
+            return ((acc @ a) * jnp.bfloat16(0.125)).astype(jnp.bfloat16)
+        return jax.lax.fori_loop(0, chain_len, body, a)
+
+    dt = timed(mm_chain, x, iters=3) / chain_len
     result["matmul_bf16_4096_tflops"] = round(2 * n**3 / dt / 1e12, 2)
     log(f"matmul: {result['matmul_bf16_4096_tflops']} TFLOP/s")
 
@@ -90,6 +102,27 @@ def main() -> int:
         result[f"h2d_{mb}mb_gbps"] = round(mb / 1024 / dt, 3)
         log(f"h2d {mb}MB: {result[f'h2d_{mb}mb_gbps']} GB/s")
 
+    # Kernel timings use the same chained discipline as the matmul: each
+    # step's vals carry a tiny dependence on the previous output, so the
+    # runtime cannot dedupe or overlap the executions (r02's independent
+    # dispatches read 19us for a 1GB gather — off by orders of magnitude).
+    chain_steps = 8
+
+    def timed_chained(f, ids, vals, table, outs=1):
+        @jax.jit
+        def run(v0, ids, table):
+            def body(_, v):
+                # ids must depend on the carry too, or XLA hoists the
+                # (loop-invariant) gather out of the chain and the timing
+                # measures only the reduction.  The predicate is never
+                # true, so the actual indices are unchanged.
+                bump = (v[:, :1] > jnp.float32(1e30)).astype(jnp.int32)
+                out = f(ids + bump, v, table)
+                lead = (out[0] if outs > 1 else out)[:, :1]
+                return v + lead * jnp.float32(1e-30)
+            return jax.lax.fori_loop(0, chain_steps, body, v0)
+        return timed(run, vals, ids, table, iters=3) / chain_steps
+
     # --- embed_bag: pallas vs XLA across K regimes (VERDICT #10) ---
     try:
         from dmlc_core_tpu.ops.pallas_embed import (embed_bag_pallas,
@@ -101,16 +134,15 @@ def main() -> int:
         for k in (8, 64, 512):
             ids = jax.random.randint(key, (rows, k), 0, vocab, jnp.int32)
             vals = jnp.ones((rows, k), jnp.float32)
-            ref = jax.jit(embed_bag_reference)
-            t_ref = timed(ref, ids, vals, table)
+            t_ref = timed_chained(embed_bag_reference, ids, vals, table)
             try:
-                pal = jax.jit(embed_bag_pallas)
                 # correctness before speed: the kernel must match XLA on
                 # the same inputs before its timing means anything
                 np.testing.assert_allclose(
-                    np.asarray(pal(ids, vals, table)),
-                    np.asarray(ref(ids, vals, table)), rtol=2e-4, atol=2e-4)
-                t_pal = timed(pal, ids, vals, table)
+                    np.asarray(embed_bag_pallas(ids, vals, table)),
+                    np.asarray(embed_bag_reference(ids, vals, table)),
+                    rtol=2e-4, atol=2e-4)
+                t_pal = timed_chained(embed_bag_pallas, ids, vals, table)
             except Exception as e:  # mosaic compile failure etc.
                 t_pal = None
                 log(f"pallas K={k} failed: {type(e).__name__}: {e}")
@@ -129,7 +161,6 @@ def main() -> int:
     # --- fused FM two-output kernel (the one FactorizationMachine uses) ---
     try:
         from dmlc_core_tpu.ops.pallas_embed import fm_terms_pallas
-        import jax.numpy as jnp
 
         def fm_xla(ids, vals, table):
             g = table[ids]
@@ -140,15 +171,15 @@ def main() -> int:
         for k in (8, 64):
             ids = jax.random.randint(key, (rows, k), 0, vocab, jnp.int32)
             vals = jnp.ones((rows, k), jnp.float32)
-            ref = jax.jit(fm_xla)
-            t_ref = timed(ref, ids, vals, table)
+            t_ref = timed_chained(fm_xla, ids, vals, table, outs=2)
             try:
-                pal = jax.jit(fm_terms_pallas)
-                r_p, r_x = pal(ids, vals, table), ref(ids, vals, table)
+                r_p = fm_terms_pallas(ids, vals, table)
+                r_x = fm_xla(ids, vals, table)
                 for a, b in zip(r_p, r_x):
                     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                                rtol=2e-4, atol=2e-4)
-                t_pal = timed(pal, ids, vals, table)
+                t_pal = timed_chained(fm_terms_pallas, ids, vals, table,
+                                      outs=2)
             except Exception as e:  # mosaic compile failure etc.
                 t_pal = None
                 log(f"fm_terms pallas K={k} failed: {type(e).__name__}: {e}")
@@ -156,6 +187,9 @@ def main() -> int:
                 "xla_us": round(t_ref * 1e6, 1),
                 "pallas_us": round(t_pal * 1e6, 1) if t_pal else None,
             }
+            log(f"fm_terms K={k}: xla {t_ref*1e6:.0f}us "
+                f"pallas {t_pal*1e6:.0f}us" if t_pal else
+                f"fm_terms K={k}: xla {t_ref*1e6:.0f}us pallas FAILED")
         result["fm_terms_pallas_vs_xla"] = fm_vs
     except Exception as e:  # noqa: BLE001
         result["fm_terms_error"] = f"{type(e).__name__}: {e}"
