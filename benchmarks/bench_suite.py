@@ -379,16 +379,20 @@ def bench_sp_mesh8() -> dict:
             "note": "B1 H8 S2048 D64 causal attention, seq sharded 8-way"}
 
 
+# Run order = dict order.  The virtual-mesh configs (subprocess CPU runs,
+# no tunnel involved) come before the long device-bound train loop: a
+# wedged tunnel grant mid-fm_train (observed r03: >1h stall inside one
+# RPC) must not cost the configs that never needed the chip.
 ALL = {
     "libsvm": bench_libsvm,
     "csv": bench_csv,
     "libfm": bench_libfm,
     "sharded": bench_sharded,
     "recordio": bench_recordio,
-    "fm_train": bench_fm_train,
-    "allreduce": bench_allreduce,
     "allreduce_mesh8": bench_allreduce_mesh8,
     "sp_mesh8": bench_sp_mesh8,
+    "allreduce": bench_allreduce,
+    "fm_train": bench_fm_train,
 }
 
 

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Poll for an axon tunnel grant all round: run harvest_run.sh until it
+# completes with artifacts, retrying on rc 9 (grant lost / never landed).
+# The single-tenant claim can queue for a long time behind other tenants,
+# so losing one attempt is normal — the loop IS the strategy (docs/perf.md).
+#
+# Stop condition: /tmp/harvest_stop exists, or all five artifacts landed.
+set -u
+cd "$(dirname "$0")/.."
+while [ ! -f /tmp/harvest_stop ]; do
+    bash benchmarks/harvest_run.sh
+    rc=$?
+    if [ -s /tmp/bench_suite_tpu.json ] && [ -s /tmp/bench_tpu.json ]; then
+        echo "harvest complete (rc=$rc)" >>/tmp/harvest_loop.log
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) harvest attempt rc=$rc — retrying in 60s" \
+        >>/tmp/harvest_loop.log
+    sleep 60
+done
+echo "stopped by /tmp/harvest_stop" >>/tmp/harvest_loop.log
