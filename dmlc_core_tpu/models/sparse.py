@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from ..ops.csr import csr_dense_matvec, csr_embed_sum, fm_pairwise
 
 __all__ = ["SparseLogReg", "FactorizationMachine", "weighted_bce",
-           "weighted_mse"]
+           "weighted_mse", "task_loss"]
 
 Params = Dict[str, jax.Array]
 
@@ -56,6 +56,19 @@ def weighted_mse(pred: jax.Array, labels: jax.Array,
                  weights: jax.Array) -> jax.Array:
     wsum = jnp.maximum(weights.sum(), 1e-9)
     return (weights * (pred - labels) ** 2).sum() / wsum
+
+
+def task_loss(out: jax.Array, batch: Dict[str, jax.Array], task: str,
+              l2: float, *regs: jax.Array) -> jax.Array:
+    """Shared loss tail of the factorization-model family: task dispatch
+    (binary BCE / regression MSE) + l2 on the given parameter arrays."""
+    if task == "binary":
+        base = weighted_bce(out, batch["labels"], batch["weights"])
+    else:
+        base = weighted_mse(out, batch["labels"], batch["weights"])
+    if l2:
+        base = base + l2 * sum(jnp.sum(r ** 2) for r in regs)
+    return base
 
 
 class SparseLogReg:
@@ -132,12 +145,5 @@ class FactorizationMachine:
         return params["w0"] + linear + pair
 
     def loss(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
-        out = self.forward(params, batch)
-        if self.task == "binary":
-            base = weighted_bce(out, batch["labels"], batch["weights"])
-        else:
-            base = weighted_mse(out, batch["labels"], batch["weights"])
-        if self.l2:
-            base = base + self.l2 * (jnp.sum(params["w"] ** 2)
-                                     + jnp.sum(params["v"] ** 2))
-        return base
+        return task_loss(self.forward(params, batch), batch, self.task,
+                         self.l2, params["w"], params["v"])

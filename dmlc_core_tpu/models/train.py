@@ -47,14 +47,17 @@ def batch_sharding(mesh: Optional[Mesh]) -> Optional[NamedSharding]:
 
 def param_shardings(model, params: Dict[str, jax.Array],
                     mesh: Optional[Mesh]) -> Optional[Dict[str, NamedSharding]]:
-    """Sharding recipe: FM factor table shards its factor dim over 'mp';
-    everything else replicates."""
+    """Sharding recipe: factor tables shard their trailing factor dim over
+    'mp' (FM ``v[F, d]`` and FFM ``v[F, nf, d]`` alike — gathers stay local,
+    only the final per-row reduction crosses chips); everything else
+    replicates."""
     if mesh is None:
         return None
     out: Dict[str, NamedSharding] = {}
     for k, v in params.items():
-        if k == "v" and v.ndim == 2 and "mp" in mesh.axis_names:
-            out[k] = NamedSharding(mesh, P(None, "mp"))
+        if k == "v" and v.ndim in (2, 3) and "mp" in mesh.axis_names:
+            out[k] = NamedSharding(
+                mesh, P(*([None] * (v.ndim - 1) + ["mp"])))
         else:
             out[k] = NamedSharding(mesh, P())
     return out

@@ -384,6 +384,11 @@ class DeviceLoader:
                    (non-CPU backend) — on CPU there is no link to save and
                    the encode/decode would cost pure host cycles.  Ignored
                    when the native packer is unavailable.
+    fields:        also ship the libfm per-value field ids (int32, padding
+                   0) in each batch — required by ``FieldAwareFM``.  Field
+                   batches take the per-array transfer path (the fused wire
+                   layouts carry no field region), so this knob trades a
+                   little transfer efficiency for the extra array.
     """
 
     def __init__(self, source, batch_rows: int, nnz_cap: int,
@@ -391,7 +396,7 @@ class DeviceLoader:
                  sharding: Optional[jax.sharding.Sharding] = None,
                  prefetch: int = 2, drop_remainder: bool = False,
                  id_mod: int = 0, put_threads: int = 1,
-                 wire_compact="auto"):
+                 wire_compact="auto", fields: bool = False):
         check(layout in ("flat", "rowmajor"), f"bad layout {layout!r}")
         if wire_compact == "auto":
             wire_compact = jax.default_backend() != "cpu"
@@ -403,6 +408,7 @@ class DeviceLoader:
         self.sharding = sharding
         self.drop_remainder = drop_remainder
         self.id_mod = id_mod
+        self.fields = bool(fields)
         self.stats = PackStats()
         put_threads = max(1, int(put_threads))
         depth = max(2, int(prefetch), put_threads)
@@ -436,7 +442,7 @@ class DeviceLoader:
     def _use_native_pack(self) -> bool:
         from .. import native
         return (self.layout == "flat" and self.sharding is None
-                and native.has_packer())
+                and not self.fields and native.has_packer())
 
     def _host_items(self) -> Iterator:
         """Yield host-side items: ('fused', buf, B, rows|None) for the
@@ -445,7 +451,8 @@ class DeviceLoader:
         if self._use_native_pack():
             yield from self._host_items_native()
             return
-        fused = self.layout == "flat" and self.sharding is None
+        fused = (self.layout == "flat" and self.sharding is None
+                 and not self.fields)
         carry = None
         for blk in self._blocks():
             for piece in batch_slices(blk, self.batch_rows):
@@ -466,10 +473,12 @@ class DeviceLoader:
             if self.layout == "flat":
                 host = pack_flat(block, self.batch_rows, self.nnz_cap,
                                  self.stats, id_mod=self.id_mod,
-                                 want_segments=not fused)
+                                 want_segments=not fused,
+                                 want_fields=self.fields)
             else:
                 host = pack_rowmajor(block, self.batch_rows, self.nnz_cap,
-                                     self.stats, id_mod=self.id_mod)
+                                     self.stats, id_mod=self.id_mod,
+                                     want_fields=self.fields)
             host["_rows"] = getattr(block, "size", self.batch_rows)
             if fused:
                 buf = _host_fused(host, self.batch_rows, self.nnz_cap,

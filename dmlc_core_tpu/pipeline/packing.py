@@ -92,15 +92,25 @@ def batch_slices(block: RowBlock, batch_rows: int) -> Iterator[RowBlock]:
 def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
               stats: Optional[PackStats] = None,
               id_mod: int = 0,
-              want_segments: bool = True) -> Dict[str, np.ndarray]:
+              want_segments: bool = True,
+              want_fields: bool = False) -> Dict[str, np.ndarray]:
     """Flat-CSR fixed-shape batch; ``block.size`` must be ≤ batch_rows.
 
     ``want_segments=False`` skips materialising the per-value ``segments``
     array (the largest write in the pack) — the fused transfer path
     reconstructs segments on device from ``row_ptr``, so building them on
-    host would be dead work."""
+    host would be dead work.
+
+    ``want_fields=True`` emits the libfm per-value field ids (int32, padding
+    0) parallel to ``ids`` — the FFM model's third batch array (reference
+    carries them the same way, `data.h:168`).  The source block must carry
+    fields (libfm format)."""
     n = block.size
     assert n <= batch_rows, (n, batch_rows)
+    if want_fields and block.fields is None:
+        raise ValueError(
+            "want_fields=True but the source RowBlock has no fields — "
+            "parse with format='libfm'")
     offsets = block.offsets.astype(np.int64)
     rel = offsets - offsets[0]
     counts = np.diff(rel)
@@ -110,6 +120,7 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
     vals = np.zeros(nnz_cap, np.float32)
     segments = (np.full(nnz_cap, batch_rows, np.int32)  # padding → scratch
                 if want_segments else None)
+    fields = np.zeros(nnz_cap, np.int32) if want_fields else None
     row_ptr = np.empty(batch_rows + 1, np.int32)
 
     truncated = 0
@@ -123,6 +134,8 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
             vals[:take] = 1.0
         if want_segments:
             segments[:take] = np.repeat(np.arange(n, dtype=np.int32), counts)
+        if want_fields:
+            fields[:take] = block.fields[src_idx]
         row_ptr[:n + 1] = rel
         row_ptr[n + 1:] = take
     else:
@@ -142,6 +155,8 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
                 vals[pos:pos + k] = 1.0
             if want_segments:
                 segments[pos:pos + k] = r
+            if want_fields:
+                fields[pos:pos + k] = block.fields[b:b + k]
             pos += k
         truncated = total - pos
         row_ptr[0] = 0
@@ -161,17 +176,28 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
            "labels": labels, "weights": weights}
     if want_segments:
         out["segments"] = segments
+    if want_fields:
+        out["fields"] = fields
     return out
 
 
 def pack_rowmajor(block: RowBlock, batch_rows: int, k_cap: int,
                   stats: Optional[PackStats] = None,
-                  id_mod: int = 0) -> Dict[str, np.ndarray]:
-    """Row-padded [batch_rows, k_cap] batch for the Pallas embedding kernel."""
+                  id_mod: int = 0,
+                  want_fields: bool = False) -> Dict[str, np.ndarray]:
+    """Row-padded [batch_rows, k_cap] batch for the Pallas embedding kernel.
+    ``want_fields=True``: also emit ``fields[batch_rows, k_cap]`` (libfm
+    field ids, int32, padding 0) for the FFM model."""
     n = block.size
     assert n <= batch_rows, (n, batch_rows)
+    if want_fields and block.fields is None:
+        raise ValueError(
+            "want_fields=True but the source RowBlock has no fields — "
+            "parse with format='libfm'")
     ids = np.zeros((batch_rows, k_cap), np.int32)
     vals = np.zeros((batch_rows, k_cap), np.float32)
+    fields = (np.zeros((batch_rows, k_cap), np.int32)
+              if want_fields else None)
     offsets = block.offsets.astype(np.int64)
     truncated = 0
     for r in range(n):
@@ -183,6 +209,8 @@ def pack_rowmajor(block: RowBlock, batch_rows: int, k_cap: int,
             vals[r, :k] = block.values[b:b + k]
         else:
             vals[r, :k] = 1.0
+        if want_fields:
+            fields[r, :k] = block.fields[b:b + k]
     labels = np.zeros(batch_rows, np.float32)
     weights = np.zeros(batch_rows, np.float32)
     labels[:n] = block.labels
@@ -192,4 +220,7 @@ def pack_rowmajor(block: RowBlock, batch_rows: int, k_cap: int,
         stats.rows += n
         stats.padded_rows += batch_rows - n
         stats.truncated_values += truncated
-    return {"ids": ids, "vals": vals, "labels": labels, "weights": weights}
+    out = {"ids": ids, "vals": vals, "labels": labels, "weights": weights}
+    if want_fields:
+        out["fields"] = fields
+    return out
