@@ -46,18 +46,43 @@ def batch_sharding(mesh: Optional[Mesh]) -> Optional[NamedSharding]:
 
 
 def param_shardings(model, params: Dict[str, jax.Array],
-                    mesh: Optional[Mesh]) -> Optional[Dict[str, NamedSharding]]:
-    """Sharding recipe: factor tables shard their trailing factor dim over
-    'mp' (FM ``v[F, d]`` and FFM ``v[F, nf, d]`` alike — gathers stay local,
-    only the final per-row reduction crosses chips); everything else
-    replicates."""
+                    mesh: Optional[Mesh],
+                    table_shard: str = "dim",
+                    ) -> Optional[Dict[str, NamedSharding]]:
+    """Sharding recipe for the sparse-model family.
+
+    ``table_shard="dim"`` (default, model parallelism): factor tables shard
+    their trailing factor dim over 'mp' (FM ``v[F, d]`` and FFM
+    ``v[F, nf, d]`` alike — gathers stay local, only the final per-row
+    reduction crosses chips); everything else replicates.
+
+    ``table_shard="rows"`` (embedding/parameter-server parallelism — the
+    TPU expression of the reference ecosystem's ps-lite sharded state,
+    SURVEY §5.8, and the DLRM-style 'ep' axis): ``v`` AND the linear ``w``
+    shard their FEATURE axis over 'mp', so each chip owns a slice of the
+    parameter state; XLA turns the batch's gathers into cross-chip
+    collectives and keeps the optimizer update local to each shard.
+    Memory per chip drops by the mesh factor — the point of ps sharding —
+    at the price of gather traffic on ICI.  Feature counts must divide by
+    the 'mp' axis size in rows mode (pad ``num_features`` up — padding
+    rows are never gathered).
+    """
     if mesh is None:
         return None
+    if table_shard not in ("dim", "rows"):
+        raise ValueError(f"table_shard must be 'dim' or 'rows', "
+                         f"got {table_shard!r}")
     out: Dict[str, NamedSharding] = {}
     for k, v in params.items():
-        if k == "v" and v.ndim in (2, 3) and "mp" in mesh.axis_names:
-            out[k] = NamedSharding(
-                mesh, P(*([None] * (v.ndim - 1) + ["mp"])))
+        if "mp" not in mesh.axis_names:
+            out[k] = NamedSharding(mesh, P())
+        elif k == "v" and v.ndim in (2, 3):
+            spec = (P("mp", *([None] * (v.ndim - 1)))
+                    if table_shard == "rows"
+                    else P(*([None] * (v.ndim - 1) + ["mp"])))
+            out[k] = NamedSharding(mesh, spec)
+        elif k == "w" and v.ndim == 1 and table_shard == "rows":
+            out[k] = NamedSharding(mesh, P("mp"))
         else:
             out[k] = NamedSharding(mesh, P())
     return out

@@ -108,6 +108,48 @@ def test_sharded_step_matches_single_device(tmp_path):
     assert v_shard.spec == P(None, "mp")
 
 
+def test_row_sharded_table_matches_single_device(tmp_path):
+    """table_shard='rows' (ps/ep-style feature sharding, SURVEY §5.8):
+    losses match the single-device run bit-for-tolerance and each chip
+    holds a feature slice of BOTH v and w."""
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual cpu devices")
+    mesh = Mesh(np.array(devices).reshape(4, 2), ("dp", "mp"))
+    rng = np.random.default_rng(4)
+    path = str(tmp_path / "r.libsvm")
+    write_linear_dataset(path, rng, n=512)
+
+    model = FactorizationMachine(num_features=64, dim=8)
+    opt = optax.sgd(0.1)
+
+    def run(mesh_arg, table_shard):
+        loader = DeviceLoader(create_parser(path), batch_rows=64,
+                              nnz_cap=1024,
+                              sharding=batch_sharding(mesh_arg))
+        params = model.init(jax.random.PRNGKey(0))
+        params = shard_params(params, param_shardings(
+            model, params, mesh_arg, table_shard=table_shard))
+        opt_state = opt.init(params)
+        step = make_train_step(model, opt, mesh_arg, donate=False)
+        losses = []
+        for batch in loader:
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+        loader.close()
+        return losses, params
+
+    losses_single, _ = run(None, "dim")
+    losses_rows, params_rows = run(mesh, "rows")
+    np.testing.assert_allclose(losses_single, losses_rows,
+                               rtol=2e-4, atol=2e-5)
+    assert params_rows["v"].sharding.spec == P("mp", None)
+    assert params_rows["w"].sharding.spec == P("mp")
+    with pytest.raises(ValueError):
+        param_shardings(model, model.init(jax.random.PRNGKey(0)), mesh,
+                        table_shard="bogus")
+
+
 @pytest.mark.parametrize("engine", ["xla", "pallas"])
 def test_rowmajor_forward_matches_flat(engine, tmp_path):
     """VERDICT r2 #3: the models consume rowmajor batches through the
