@@ -3,12 +3,13 @@
 from .sparse import (SparseLogReg, FactorizationMachine,  # noqa: F401
                      weighted_bce, weighted_mse)
 from .ffm import FieldAwareFM  # noqa: F401
+from .deep import DeepFM  # noqa: F401
 from .ftrl import ftrl, FTRLState  # noqa: F401
 from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F401
                     param_shardings, shard_params, fit_stream)
 
 __all__ = [
-    "SparseLogReg", "FactorizationMachine", "FieldAwareFM",
+    "SparseLogReg", "FactorizationMachine", "FieldAwareFM", "DeepFM",
     "weighted_bce", "weighted_mse",
     "make_train_step", "make_eval_step", "batch_sharding", "param_shardings",
     "shard_params", "fit_stream",

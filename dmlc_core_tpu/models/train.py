@@ -67,16 +67,16 @@ def param_shardings(model, params: Dict[str, jax.Array],
     the 'mp' axis size in rows mode (pad ``num_features`` up — padding
     rows are never gathered).
     """
-    if mesh is None:
-        return None
     if table_shard not in ("dim", "rows"):
         raise ValueError(f"table_shard must be 'dim' or 'rows', "
                          f"got {table_shard!r}")
+    if mesh is None:
+        return None
+    if "mp" not in mesh.axis_names:
+        return {k: NamedSharding(mesh, P()) for k in params}
     out: Dict[str, NamedSharding] = {}
     for k, v in params.items():
-        if "mp" not in mesh.axis_names:
-            out[k] = NamedSharding(mesh, P())
-        elif k == "v" and v.ndim in (2, 3):
+        if k == "v" and v.ndim in (2, 3):
             spec = (P("mp", *([None] * (v.ndim - 1)))
                     if table_shard == "rows"
                     else P(*([None] * (v.ndim - 1) + ["mp"])))
