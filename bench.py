@@ -315,12 +315,14 @@ def main() -> None:
     gen_data()
     require_tpu = os.environ.get("DMLC_REQUIRE_TPU") == "1"
     if require_tpu:
-        # retry-loop mode: measure the baseline BEFORE the probe — once the
-        # probe wins the single-tenant tunnel, nothing may sit between it
-        # and our runs or another tenant can steal the grant back.  The
-        # binary is build-cached, so this costs one ~45s reference run per
-        # attempt against up-to-30min probe waits.
-        base1 = measure_reference()
+        # retry-loop mode: skip the pre-probe baseline entirely.  A
+        # baseline measured while the probe retries for tens of minutes
+        # races whatever else the host happens to run (observed r03: a
+        # depressed pre-probe baseline flattering vs_baseline by ~2x);
+        # instead both reference runs happen inside the granted window,
+        # right after our timed runs — the grant is held, the chip is
+        # idle, the host conditions are those of the measurement itself.
+        base1 = 0.0
         if not probe_tpu():
             log("DMLC_REQUIRE_TPU=1 and no TPU → exiting 9")
             sys.exit(9)
@@ -333,6 +335,8 @@ def main() -> None:
     # reference AFTER our runs and compare against the mean, so a drift
     # between the two measurements doesn't masquerade as a speed delta
     base2 = measure_reference()
+    if require_tpu:
+        base1 = measure_reference()   # second sample, same window
     bases = [b for b in (base1, base2) if b > 0] or [FALLBACK_BASELINE_MBS]
     baseline = sum(bases) / len(bases)
     log(f"baseline before/after: {base1:.1f}/{base2:.1f} MB/s "
