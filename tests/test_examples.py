@@ -153,3 +153,24 @@ def test_checkpoint_resume_after_midjob_kill_converges(tmp_path):
     assert "KILLED-MIDJOB" in out.stdout
     assert out.stdout.count("CONVERGED") == 3
     assert "RESUMED rank 1" in out.stdout
+
+
+def test_train_ffm_example(tmp_path):
+    """The FFM example end-to-end on a small libfm file (single process)."""
+    import random
+    rnd = random.Random(0)
+    data = tmp_path / "t.libfm"
+    with open(data, "w") as f:
+        for _ in range(600):
+            k = rnd.randint(1, 5)
+            ent = " ".join(f"{rnd.randint(0, 4)}:{rnd.randint(0, 200)}:"
+                           f"{rnd.random():.3f}" for _ in range(k))
+            f.write(f"{rnd.randint(0, 1)} {ent}\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_ffm.py"),
+         f"file://{data}", "--features", "256", "--fields", "5",
+         "--batch-rows", "128", "--nnz-cap", "2048"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done:" in out.stdout
