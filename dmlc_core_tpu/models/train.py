@@ -32,8 +32,9 @@ from ..pipeline.device_loader import DeviceLoader
 from ..utils import log_info
 from ..utils.timer import Timer
 
-__all__ = ["make_train_step", "batch_sharding", "param_shardings",
-           "shard_params", "fit_stream", "TrainState"]
+__all__ = ["make_train_step", "make_eval_step", "batch_sharding",
+           "param_shardings", "shard_params", "fit_stream", "TrainState",
+           "streaming_auc", "auc_from_histograms"]
 
 TrainState = Tuple[Dict[str, jax.Array], Any]
 
@@ -122,6 +123,8 @@ def make_train_step(model, optimizer: optax.GradientTransformation,
 
 
 def make_eval_step(model, mesh: Optional[Mesh] = None):
+    """Jitted ``evaluate(params, batch) -> (correct, total)``; with a mesh
+    the batch pins to the dp sharding like the train step."""
     def evaluate(params, batch):
         out = model.forward(params, batch)
         w = batch["weights"]
@@ -129,7 +132,36 @@ def make_eval_step(model, mesh: Optional[Mesh] = None):
         y = jnp.where(batch["labels"] > 0, 1.0, 0.0)
         correct = (w * (pred == y)).sum()
         return correct, w.sum()
-    return jax.jit(evaluate)
+    if mesh is None:
+        return jax.jit(evaluate)
+    return jax.jit(evaluate, in_shardings=(None, batch_sharding(mesh)))
+
+
+def streaming_auc(scores: jax.Array, labels: jax.Array,
+                  weights: jax.Array, num_bins: int = 1024):
+    """One batch's contribution to a binned ROC-AUC: weighted positive /
+    negative score histograms (fixed [0,1] bins over sigmoid(score), so
+    accumulation across batches and ``lax.psum`` across dp ranks are both
+    plain additions).  Combine with :func:`auc_from_histograms`."""
+    p = jax.nn.sigmoid(scores)
+    idx = jnp.clip((p * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    y = jnp.where(labels > 0, 1.0, 0.0)
+    pos = jax.ops.segment_sum(weights * y, idx, num_segments=num_bins)
+    neg = jax.ops.segment_sum(weights * (1.0 - y), idx,
+                              num_segments=num_bins)
+    return pos, neg
+
+
+def auc_from_histograms(pos: jax.Array, neg: jax.Array) -> jax.Array:
+    """Exact AUC of the binned distributions (trapezoid over the ROC steps;
+    ties within a bin count half, the standard Mann-Whitney convention)."""
+    total_pos = jnp.maximum(pos.sum(), 1e-12)
+    total_neg = jnp.maximum(neg.sum(), 1e-12)
+    # P(score_pos > score_neg) + 0.5 P(equal), walking bins ascending
+    neg_below = jnp.concatenate(
+        [jnp.zeros((1,), pos.dtype), jnp.cumsum(neg)[:-1]])
+    wins = (pos * (neg_below + 0.5 * neg)).sum()
+    return wins / (total_pos * total_neg)
 
 
 def fit_stream(model, loader: DeviceLoader, *, epochs: int = 1,
