@@ -62,9 +62,57 @@ def _pallas_supported(D: int, fused: bool = False) -> bool:
     return ok
 
 
-def _resolve_engine(engine: str, D: int, fused: bool = False) -> str:
+_engine_time_cache: dict = {}
+
+
+def _pallas_faster(B: int, K: int, D: int, fused: bool) -> bool:
+    """Timed auto-tune per (K, D, fused): compiling is necessary but not
+    sufficient — a kernel that lowers can still lose to XLA at some shapes
+    (e.g. very small D makes the per-row DMAs tiny).  Times both engines
+    once on synthetic data at the call's K/D (batch clipped — relative
+    cost is per-row) and caches the verdict."""
+    key = (K, D, fused)
+    hit = _engine_time_cache.get(key)
+    if hit is not None:
+        return hit
+    import time as _time
+
+    import numpy as _np
+    b = min(B, 1024)
+    rng = _np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 4096, (b, K)), jnp.int32)
+    vals = jnp.ones((b, K), jnp.float32)
+    table = jnp.asarray(rng.standard_normal((4096, D)), jnp.float32)
+
+    def timed(fn) -> float:
+        jax.block_until_ready(fn(ids, vals, table))   # compile + warm
+        t0 = _time.perf_counter()
+        for _ in range(3):
+            out = fn(ids, vals, table)
+        jax.block_until_ready(out)
+        return _time.perf_counter() - t0
+
+    try:
+        if fused:
+            t_pal = timed(fm_terms_pallas)
+            t_xla = timed(jax.jit(lambda i, v, t: (
+                jnp.einsum("bk,bkd->bd", v, t[i]),
+                jnp.einsum("bk,bkd->bd", v * v, t[i] * t[i]))))
+        else:
+            t_pal = timed(embed_bag_pallas)
+            t_xla = timed(jax.jit(embed_bag_reference))
+        faster = t_pal < t_xla
+    except Exception:  # noqa: BLE001 — timing must never break dispatch
+        faster = False
+    _engine_time_cache[key] = faster
+    return faster
+
+
+def _resolve_engine(engine: str, D: int, fused: bool = False,
+                    B: int = 1024, K: int = 32) -> str:
     if engine == "auto":
-        if jax.default_backend() == "tpu" and _pallas_supported(D, fused):
+        if (jax.default_backend() == "tpu" and _pallas_supported(D, fused)
+                and _pallas_faster(B, K, D, fused)):
             return "pallas"
         return "xla"
     if engine not in ("xla", "pallas"):
@@ -90,7 +138,8 @@ def embed_bag(ids: jax.Array, vals: jax.Array, table: jax.Array,
     pallas forward carries a custom VJP whose backward is plain XLA
     (gather + scatter-add), since Mosaic kernels have no autodiff rules.
     """
-    engine = _resolve_engine(engine, table.shape[1])
+    engine = _resolve_engine(engine, table.shape[1],
+                             B=ids.shape[0], K=ids.shape[1])
     if engine == "xla":
         return embed_bag_reference(ids, vals, table, square=square)
     return _embed_bag_pallas_diff(
@@ -106,7 +155,8 @@ def fm_embed_terms(ids: jax.Array, vals: jax.Array, table: jax.Array,
 
     Returns ``(s1[B,D], s2[B,D])``; differentiable w.r.t. (vals, table).
     """
-    engine = _resolve_engine(engine, table.shape[1], fused=True)
+    engine = _resolve_engine(engine, table.shape[1], fused=True,
+                             B=ids.shape[0], K=ids.shape[1])
     if engine == "xla":
         g = table[ids]                       # [B,K,D], one gather
         s1 = jnp.einsum("bk,bkd->bd", vals, g)
