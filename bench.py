@@ -258,10 +258,12 @@ def measure_ours():
             cms = [False]
     elif "DMLC_BENCH_ROWS" not in os.environ:
         # the tunnelled device pays a per-put RPC latency that favours
-        # bigger batches; which size wins depends on the day's link, so the
-        # batch shape is part of the probed config space, not a separate
-        # afterthought stage
+        # bigger batches (TPU_DIAG: 64MB puts sustain the same MB/s as
+        # 16MB, so amortizing more latency per put is ~free); which size
+        # wins depends on the day's link, so the batch shape is part of
+        # the probed config space, not a separate afterthought stage
         shapes.append((3 * batch_rows, 3 * nnz_cap))
+        shapes.append((9 * batch_rows, 9 * nnz_cap))
     combos = [(p, c, s) for c in cms for s in shapes for p in pts]
     if len(combos) > 1:
         # the tunnel decides: probe transfer streams × wire compaction ×
