@@ -69,9 +69,12 @@ suite() {
         timeout 5400 python benchmarks/bench_suite.py >>"$LOG" 2>&1
 }
 
+# micro first: ~1 min, and it is the proof that the redesigned Pallas
+# kernels lower on real hardware — a short-lived grant should capture
+# that before committing to the long root bench
+run_step "tpu_micro" micro
 run_step "root bench" bench_root
 run_step "root bench 3x shape" bench_3x
 run_step "tpu_diag" diag
-run_step "tpu_micro" micro
 run_step "bench_suite" suite
 echo "=== $(date -u +%H:%M:%S) done ===" >>"$LOG"
