@@ -309,3 +309,59 @@ def test_packer_early_close_on_nnz_pressure():
     st = p.stats()
     assert st["rows"] == 4 and st["truncated_values"] == 0
     p.close()
+
+
+def test_pack_roundtrip_fuzz():
+    """Property fuzz (the reference's recordio-fuzz idea applied to the
+    pack layer): random ragged CSR blocks — including empty rows, dense
+    rows, valueless features and fields — must reconstruct exactly from
+    BOTH packed layouts when nothing is truncated."""
+    import numpy as np
+    from dmlc_core_tpu.data.row_block import RowBlockContainer
+    from dmlc_core_tpu.pipeline.packing import pack_flat, pack_rowmajor
+
+    rng = np.random.default_rng(0)
+    for trial in range(25):
+        n = int(rng.integers(1, 40))
+        c = RowBlockContainer()
+        truth = []
+        with_fields = bool(trial % 2)
+        for r in range(n):
+            k = int(rng.integers(0, 12))       # empty rows included
+            idx = np.sort(rng.choice(10_000, size=k, replace=False))
+            vals = rng.random(k).astype(np.float32)
+            fields = (rng.integers(0, 7, k).astype(np.uint32)
+                      if with_fields else None)
+            c.push_row(float(r % 3), idx.astype(np.uint64), vals,
+                       weight=1.0 + r,
+                       fields=fields)
+            truth.append((idx, vals, fields))
+        blk = c.get_block()
+        cap = int(blk.offsets[-1]) + 5
+        rows_cap = n + int(rng.integers(0, 4))
+
+        flat = pack_flat(blk, rows_cap, cap, want_fields=with_fields)
+        for r, (idx, vals, fields) in enumerate(truth):
+            m = flat["segments"] == r
+            assert m.sum() == len(idx), (trial, r)
+            np.testing.assert_array_equal(flat["ids"][m], idx)
+            np.testing.assert_allclose(flat["vals"][m], vals, rtol=1e-6)
+            if with_fields:
+                np.testing.assert_array_equal(flat["fields"][m], fields)
+            assert flat["labels"][r] == float(r % 3)
+            assert flat["weights"][r] == 1.0 + r
+        # padding rows weigh zero — silent-loss guard for the loss masks
+        assert (flat["weights"][n:] == 0).all()
+
+        kmax = max((len(t[0]) for t in truth), default=1) or 1
+        rm = pack_rowmajor(blk, rows_cap, kmax, want_fields=with_fields)
+        for r, (idx, vals, fields) in enumerate(truth):
+            got = rm["vals"][r][rm["vals"][r] != 0]
+            keep = vals != 0          # rowmajor padding is val==0
+            np.testing.assert_allclose(np.sort(got), np.sort(vals[keep]),
+                                       rtol=1e-6)
+            gi = rm["ids"][r][:len(idx)]
+            np.testing.assert_array_equal(gi, idx)
+            if with_fields:
+                np.testing.assert_array_equal(rm["fields"][r][:len(idx)],
+                                              fields)
