@@ -1,0 +1,48 @@
+"""Orbax interop for the checkpoint substrate.
+
+:class:`~dmlc_core_tpu.utils.checkpoint.CheckpointManager` is the native
+path — URI-addressed (file/s3/gs/hdfs through the io layer), atomic
+versioned publishes, template restore, data fast-forward (the reference's
+Serializable/serializer substrate, `include/dmlc/io.h:112`, expressed for
+pytrees).  This module bridges to orbax — the JAX ecosystem's standard
+checkpointer — so dmlc_core_tpu state drops into deployments that already
+manage checkpoints with orbax (multi-host array gathering, async saves),
+and orbax-managed state loads back into our managers.
+
+Kept deliberately thin: two functions, no policy.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_orbax", "restore_orbax"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_orbax(path: str, tree: Any, *, force: bool = True) -> None:
+    """Write ``tree`` (a pytree of arrays) as an orbax checkpoint at the
+    local directory ``path``.  For URI-addressed / versioned checkpoints
+    use :class:`CheckpointManager`; this is the ecosystem-interop escape
+    hatch."""
+    ckpt = _checkpointer()
+    ckpt.save(os.path.abspath(path), tree, force=force)
+    # StandardCheckpointer saves asynchronously; the contract here is
+    # durability-on-return (matching CheckpointManager's atomic publish)
+    ckpt.wait_until_finished()
+
+
+def restore_orbax(path: str, template: Optional[Any] = None) -> Any:
+    """Read an orbax checkpoint.  ``template`` (a pytree of arrays or
+    ShapeDtypeStructs) pins structure/dtypes/shardings the way
+    ``load_pytree(template=...)`` does for the native format."""
+    ckpt = _checkpointer()
+    path = os.path.abspath(path)
+    if template is None:
+        return ckpt.restore(path)
+    return ckpt.restore(path, template)
