@@ -165,14 +165,18 @@ def main() -> None:
                           os.path.join(REPO, ".jax_cache"))
     import jax
 
+    import bench as bench_mod
     if os.environ.get("DMLC_FORCE_CPU") == "1":
         # the axon plugin's client init can block on a busy tunnel even
         # under JAX_PLATFORMS=cpu — pin cpu + drop its backend factory
-        import bench
-        bench.force_cpu()
+        bench_mod.force_cpu()
+    elif os.environ.get("DMLC_REQUIRE_TPU") == "1":
+        # probe in a SUBPROCESS first: jax.devices() against a dead/busy
+        # tunnel blocks indefinitely in-process (see tpu_micro.py)
+        if not bench_mod.probe_tpu():
+            bench_mod.require_tpu_or_exit("cpu")
     import numpy as np
 
-    import bench as bench_mod
     bench_mod.require_tpu_or_exit(jax.devices()[0].platform)
 
     doc = {"platform": jax.devices()[0].platform,

@@ -57,6 +57,14 @@ def main() -> int:
         # under JAX_PLATFORMS=cpu — pin cpu + drop its backend factory
         import bench
         bench.force_cpu()
+    elif os.environ.get("DMLC_REQUIRE_TPU") == "1":
+        # probe in a SUBPROCESS before touching the backend: jax.devices()
+        # against a dead/busy tunnel blocks indefinitely in-process, which
+        # would burn this script's whole timeout budget instead of exiting
+        # 9 promptly for the harvest loop
+        import bench
+        if not bench.probe_tpu():
+            bench.require_tpu_or_exit("cpu")
 
     log("initialising backend (jax.devices()) ...")
     devs = jax.devices()
