@@ -1,0 +1,120 @@
+"""dmlc-train CLI: config file + CLI overrides through the Parameter
+system, model selection through the registry, training, AUC, checkpoint —
+the reference ecosystem's xgboost-style UX composed from config.h +
+parameter.h + registry.h counterparts."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.models.cli", *args],
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+
+
+@pytest.fixture()
+def libsvm_file(tmp_path):
+    rng = np.random.default_rng(0)
+    path = tmp_path / "t.libsvm"
+    w = rng.standard_normal(50)
+    with open(path, "w") as f:
+        for _ in range(800):
+            idx = np.sort(rng.choice(50, size=8, replace=False))
+            x = rng.random(8)
+            y = int((w[idx] * x).sum() > 0)
+            f.write(f"{y} " + " ".join(
+                f"{j}:{v:.4f}" for j, v in zip(idx, x)) + "\n")
+    return str(path)
+
+
+def test_cli_config_file_with_overrides(libsvm_file, tmp_path):
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "# xgboost-style config\n"
+        f"data = {libsvm_file}\n"
+        "model = logreg\n"
+        "features = 64\n"
+        "epochs = 1\n"
+        "batch_rows = 128\n"
+        "nnz_cap = 2048\n"
+        "lr = 0.1\n"
+        "log_every = 0\n")
+    ckpt = tmp_path / "ck"
+    # CLI overrides the file's model and adds a checkpoint dir
+    out = _run([str(conf), "model=fm", "dim=4", f"ckpt_dir={ckpt}",
+                "epochs=2"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "trained fm:" in out.stdout
+    assert "train AUC" in out.stdout
+    auc = float(out.stdout.split("train AUC")[1].split()[0])
+    assert auc > 0.7, out.stdout
+    assert "checkpoint step" in out.stdout
+    assert (ckpt / "MANIFEST.json").exists() or any(ckpt.iterdir())
+
+
+def test_cli_ffm_on_libfm(libsvm_file, tmp_path):
+    rng = np.random.default_rng(1)
+    path = tmp_path / "t.libfm"
+    with open(path, "w") as f:
+        for _ in range(400):
+            k = int(rng.integers(1, 5))
+            ent = " ".join(f"{int(rng.integers(0, 5))}:"
+                           f"{int(rng.integers(0, 100))}:"
+                           f"{rng.random():.3f}" for _ in range(k))
+            f.write(f"{int(rng.integers(0, 2))} {ent}\n")
+    out = _run([f"data={path}", "model=ffm", "features=128", "fields=5",
+                "dim=3", "batch_rows=128", "nnz_cap=2048", "log_every=0"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "trained ffm:" in out.stdout
+
+
+def test_cli_errors_loudly(libsvm_file):
+    # unknown key lists candidates
+    out = _run([f"data={libsvm_file}", "modle=fm"])
+    assert out.returncode == 2
+    assert "unknown parameter 'modle'" in out.stderr
+    assert "model" in out.stderr            # candidates listed
+    # enum violation
+    out = _run([f"data={libsvm_file}", "model=resnet"])
+    assert out.returncode == 2
+    # missing required
+    out = _run(["model=fm"])
+    assert out.returncode == 2
+    assert "data" in out.stderr
+
+
+def test_cli_help_prints_docstring():
+    out = _run(["--help"])
+    assert out.returncode == 0
+    assert "Parameters of TrainParams" in out.stdout
+    assert "batch_rows" in out.stdout
+
+
+def test_cli_malformed_config_and_suffix_resolution(tmp_path):
+    bad = tmp_path / "bad.conf"
+    bad.write_text("model\n")          # missing '='
+    out = _run([str(bad)])
+    assert out.returncode == 2
+    assert "dmlc-train:" in out.stderr and "Traceback" not in out.stderr
+
+    # .csv suffix resolves the parser without an explicit format=
+    rng = np.random.default_rng(2)
+    path = tmp_path / "t.csv"
+    with open(path, "w") as f:
+        for _ in range(300):
+            row = rng.random(6)
+            f.write(f"{int(rng.integers(0, 2))}," +
+                    ",".join(f"{v:.3f}" for v in row) + "\n")
+    out = _run([f"data={path}?label_column=0", "model=logreg",
+                "features=16", "batch_rows=64", "nnz_cap=1024",
+                "log_every=0"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "trained logreg:" in out.stdout
