@@ -23,13 +23,11 @@ on ``[batch, seq, heads, dim]`` arrays sharded on ``seq``.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["ring_attention", "make_ring_attention", "reference_attention"]
 

@@ -19,8 +19,7 @@ path) lives in :mod:`dmlc_core_tpu.parallel.rabit`.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
