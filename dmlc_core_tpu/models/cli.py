@@ -80,6 +80,10 @@ class TrainParams(Parameter):
     l2 = field(float, default=0.0, lower_bound=0.0)
     seed = field(int, default=0)
     ckpt_dir = field(str, default="", help="checkpoint dir URI ('' = off)")
+    resume = field(bool, default=False,
+                   help="continue from the latest checkpoint in ckpt_dir "
+                        "(the reference ecosystem's model_in/model_out "
+                        "continuation)")
     eval_auc = field(bool, default=True,
                      help="streaming AUC over the train stream at the end")
     log_every = field(int, default=100)
@@ -148,13 +152,29 @@ def main(argv=None) -> int:
     opt_state = opt.init(params)
     step = make_train_step(model, opt)
 
+    start_n = 0
+    if p.resume:
+        if not p.ckpt_dir:
+            print("dmlc-train: resume=true needs ckpt_dir", file=sys.stderr)
+            return 2
+        from ..utils import CheckpointManager, DMLCError as _DE
+        try:
+            start_n, state = CheckpointManager(p.ckpt_dir).restore(
+                template={"params": params})
+            params = state["params"]
+            print(f"resumed from step {start_n} in {p.ckpt_dir}",
+                  flush=True)
+        except _DE:
+            print(f"no checkpoint in {p.ckpt_dir} — starting fresh",
+                  flush=True)
+
     # ONE loader, rewound between epochs (the fit_stream pattern): the
     # parser/transfer threads and pinned buffers are reused, not rebuilt
     loader = DeviceLoader(
         create_parser(p.data, 0, 1, fmt),
         batch_rows=p.batch_rows, nnz_cap=p.nnz_cap,
         fields=needs_fields, id_mod=p.features)
-    n = 0
+    n = start_n
     loss = None
     try:
         for epoch in range(p.epochs):

@@ -118,3 +118,21 @@ def test_cli_malformed_config_and_suffix_resolution(tmp_path):
                 "log_every=0"])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "trained logreg:" in out.stdout
+
+
+def test_cli_resume_continues_from_checkpoint(libsvm_file, tmp_path):
+    ckpt = tmp_path / "ck"
+    common = [f"data={libsvm_file}", "model=fm", "features=64", "dim=4",
+              "batch_rows=128", "nnz_cap=2048", "lr=0.05",
+              f"ckpt_dir={ckpt}", "log_every=0", "eval_auc=0"]
+    a = _run(common)
+    assert a.returncode == 0, a.stderr[-2000:]
+    loss_a = float(a.stdout.split("final loss")[1].split()[0])
+    b = _run(common + ["resume=1"])
+    assert b.returncode == 0, b.stderr[-2000:]
+    assert "resumed from step" in b.stdout
+    loss_b = float(b.stdout.split("final loss")[1].split()[0])
+    assert loss_b < loss_a, (loss_a, loss_b)   # training actually continued
+    # resume without ckpt_dir is a loud config error
+    c = _run([f"data={libsvm_file}", "resume=1"])
+    assert c.returncode == 2
