@@ -2,6 +2,9 @@
 
 from .packing import pack_flat, pack_rowmajor, batch_slices, PackStats  # noqa: F401
 from .device_loader import DeviceLoader  # noqa: F401
+from .ingest_service import (serve_ingest, RemoteIngestLoader,  # noqa: F401
+                             ingest_worker_main)
 
 __all__ = ["pack_flat", "pack_rowmajor", "batch_slices", "PackStats",
+           "serve_ingest", "RemoteIngestLoader", "ingest_worker_main",
            "DeviceLoader"]

@@ -389,6 +389,13 @@ class DeviceLoader:
                    batches take the per-array transfer path (the fused wire
                    layouts carry no field region), so this knob trades a
                    little transfer efficiency for the extra array.
+    emit:          "device" (default) yields device batches; "host" stops
+                   after stage 1 and yields the packed fused host items
+                   (``("fused", buf, meta, rows)``) without touching any
+                   device — the producer side of the disaggregated ingest
+                   service (:mod:`dmlc_core_tpu.pipeline.ingest_service`).
+                   Requires the fused path (flat layout, no sharding, no
+                   fields).  Recycle consumed buffers via ``recycle(buf)``.
     """
 
     def __init__(self, source, batch_rows: int, nnz_cap: int,
@@ -396,8 +403,14 @@ class DeviceLoader:
                  sharding: Optional[jax.sharding.Sharding] = None,
                  prefetch: int = 2, drop_remainder: bool = False,
                  id_mod: int = 0, put_threads: int = 1,
-                 wire_compact="auto", fields: bool = False):
+                 wire_compact="auto", fields: bool = False,
+                 emit: str = "device"):
         check(layout in ("flat", "rowmajor"), f"bad layout {layout!r}")
+        check(emit in ("device", "host"), f"bad emit {emit!r}")
+        if emit == "host":
+            check(layout == "flat" and sharding is None and not fields,
+                  "emit='host' requires the fused path "
+                  "(flat layout, no sharding, no fields)")
         if wire_compact == "auto":
             wire_compact = jax.default_backend() != "cpu"
         self.wire_compact = bool(wire_compact)
@@ -410,6 +423,7 @@ class DeviceLoader:
         self.id_mod = id_mod
         self.fields = bool(fields)
         self.stats = PackStats()
+        self.emit = emit
         put_threads = max(1, int(put_threads))
         depth = max(2, int(prefetch), put_threads)
         self._pool = _BufPool(cap=2 * depth + 2)
@@ -419,7 +433,9 @@ class DeviceLoader:
         self._pack_iter: ThreadedIter = ThreadedIter(max_capacity=depth)
         self._pack_iter.init(self._pack_factory(), self._reset_source)
         # stage 2: device transfer → bounded device queue
-        if put_threads > 1:
+        if emit == "host":
+            self._iter = self._pack_iter      # stage 1 only
+        elif put_threads > 1:
             self._iter = _TransferPool(
                 self._pack_iter,
                 lambda item: self._transfer_item(item, sync=True),
@@ -643,11 +659,16 @@ class DeviceLoader:
     def before_first(self) -> None:
         self._iter.before_first()
 
+    def recycle(self, buf: np.ndarray) -> None:
+        """Return a consumed host buffer to the pool (emit='host' mode)."""
+        self._pool.put(buf)
+
     def close(self) -> None:
         # upstream first: a transfer thread blocked in pack_iter.next()
         # unblocks with None (destroy-aware next), then unwinds cleanly
         self._pack_iter.destroy()
-        self._iter.destroy()
+        if self._iter is not self._pack_iter:
+            self._iter.destroy()
         self._drain_inflight()
         self._pool.clear()
         if hasattr(self.source, "close"):

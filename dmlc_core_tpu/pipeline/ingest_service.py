@@ -1,0 +1,333 @@
+"""Disaggregated ingest: parse/pack on remote workers, train here.
+
+The round-3 bottleneck analysis (docs/perf.md) showed the trainer host
+CPU-bound on parse+pack while the device link had headroom — the exact
+situation tf.data service addresses by moving input processing onto
+separate workers (PAPERS.md: "A Case for Disaggregating ML Input Data
+Processing").  The reference scales ingest only *within* a process
+(OpenMP, `text_parser.h:100-115`); this module scales it *across hosts*
+while reusing the whole existing ladder:
+
+    worker N: InputSplit(part=N) → native parse → Packer → fused wire
+              buffers  (DeviceLoader(emit="host") — stage 1 unchanged)
+        │  TCP frames: [meta u64][words u32][rows u32][payload]
+        ▼
+    trainer:  RemoteIngestLoader → jax.device_put + on-device decode
+              (the same fused-buffer transfer stage as DeviceLoader)
+
+The wire payload IS the fused transfer layout (v2 or compact v3) — bytes
+go from the worker's packer to ``device_put`` untouched, so remote ingest
+adds no re-encode step.  Each worker serves its byte-range partition
+(`part_index/num_parts` — the same partition math as multi-host training);
+the union-of-parts guarantee carries over from InputSplit.
+
+One trainer connection = one epoch pass over the worker's partition
+(frame ``words=0`` marks end-of-stream); reconnect for the next epoch.
+Batch order interleaves across workers by arrival — a data-parallel
+stream, not a deterministic sequence (document-level parity with
+``ShuffleInputSplit``'s relaxed ordering).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import ThreadedIter, check
+from ..utils.logging import DMLCError, log_info
+from .device_loader import _BufPool, _fused_words_meta, _put_fused_buf
+
+__all__ = ["serve_ingest", "RemoteIngestLoader", "ingest_worker_main"]
+
+_FRAME = struct.Struct("<QII")          # meta u64, words u32, rows u32
+_NO_ROWS = 0xFFFFFFFF                   # rows unknown (native packer path)
+
+
+def _send_all(sock: socket.socket, data) -> None:
+    sock.sendall(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
+            return None
+        got += r
+    return bytes(buf)
+
+
+def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
+                 batch_rows: int, nnz_cap: int, port: int,
+                 host: str = "0.0.0.0", id_mod: int = 0,
+                 wire_compact="auto", max_epochs: int = 0,
+                 ready_event: Optional[threading.Event] = None) -> None:
+    """Serve fused ingest frames for one partition; blocks forever (or for
+    ``max_epochs`` connections when > 0 — tests use this to terminate)."""
+    from ..data import create_parser
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(4)
+    if ready_event is not None:
+        ready_event.set()
+    log_info("ingest worker: part %d/%d of %s on :%d", part, nparts, uri,
+             srv.getsockname()[1])
+    served = 0
+    try:
+        while not max_epochs or served < max_epochs:
+            conn, addr = srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            from .device_loader import DeviceLoader
+            loader = DeviceLoader(
+                create_parser(uri, part, nparts, fmt),
+                batch_rows=batch_rows, nnz_cap=nnz_cap,
+                id_mod=id_mod, wire_compact=wire_compact, emit="host")
+            try:
+                for item in loader:
+                    kind, buf, meta, rows = item
+                    check(kind == "fused", "host emit must be fused")
+                    # exact fused size, NOT len(buf): recycled pool buffers
+                    # are over-sized and their dead tail must not ride the
+                    # very link this feature exists to relieve
+                    words = _fused_words_meta(batch_rows, int(meta))
+                    _send_all(conn, _FRAME.pack(
+                        int(meta), words,
+                        _NO_ROWS if rows is None else int(rows)))
+                    _send_all(conn, memoryview(buf[:words]).cast("B"))
+                    loader.recycle(buf)
+                _send_all(conn, _FRAME.pack(0, 0, 0))      # end of stream
+            except (BrokenPipeError, ConnectionError):
+                pass                      # trainer went away: next epoch
+            finally:
+                loader.close()
+                conn.close()
+            served += 1
+    finally:
+        srv.close()
+
+
+class RemoteIngestLoader:
+    """Consume fused frames from N ingest workers → device batches.
+
+    Same consumer surface as :class:`DeviceLoader` (iterate, ``close()``);
+    ``before_first()`` reconnects for the next epoch.  One reader thread
+    per worker feeds a bounded queue; the transfer stage is the identical
+    fused-buffer ``device_put`` + jitted decode the local loader uses.
+    """
+
+    def __init__(self, addresses: Sequence[Tuple[str, int]],
+                 batch_rows: int, prefetch: int = 4,
+                 connect_timeout: float = 60.0):
+        check(len(addresses) > 0, "need at least one ingest worker")
+        self.addresses = list(addresses)
+        self.batch_rows = batch_rows
+        self.connect_timeout = connect_timeout
+        depth = max(2, int(prefetch))
+        self._depth = depth
+        self._pool = _BufPool(cap=2 * depth + 2)
+        self._frames: ThreadedIter = ThreadedIter(
+            max_capacity=max(depth, len(self.addresses)))
+        self._gen_lock = threading.Lock()
+        self._frames.init(self._frame_source(), self._restart_readers)
+        self._iter: ThreadedIter = ThreadedIter(max_capacity=depth)
+        self._iter.init(self._transfer_next, self._reset_transfer)
+
+    # -- reader side: N sockets → one queue ---------------------------
+    def _spawn_readers(self) -> dict:
+        cv = threading.Condition()
+        state = {"out": [], "cv": cv, "live": len(self.addresses),
+                 "err": None, "stop": False, "socks": []}
+        cap = max(self._depth, len(self.addresses))
+
+        def read_one(addr):
+            try:
+                sock = socket.create_connection(
+                    addr, timeout=self.connect_timeout)
+                sock.settimeout(self.connect_timeout)
+                with cv:
+                    if state["stop"]:
+                        sock.close()
+                        return
+                    state["socks"].append(sock)
+                with sock:
+                    while True:
+                        hdr = _recv_exact(sock, _FRAME.size)
+                        if hdr is None:
+                            raise DMLCError(
+                                f"ingest worker {addr} closed mid-stream")
+                        meta, words, rows = _FRAME.unpack(hdr)
+                        if words == 0:
+                            return                     # worker's EOS
+                        buf = self._pool.get(words)
+                        view = memoryview(buf)[:words].cast("B")
+                        got = 0
+                        while got < len(view):
+                            r = sock.recv_into(view[got:], len(view) - got)
+                            if not r:
+                                raise DMLCError(
+                                    f"ingest worker {addr} died mid-frame")
+                            got += r
+                        with cv:
+                            # backpressure: the pool is bounded, the frame
+                            # list must be too — otherwise a slow consumer
+                            # buffers the whole epoch in trainer RSS
+                            while (len(state["out"]) >= cap
+                                   and not state["stop"]):
+                                cv.wait(timeout=1.0)
+                            if state["stop"]:
+                                return
+                            state["out"].append(
+                                (buf[:words] if len(buf) != words else buf,
+                                 meta,
+                                 None if rows == _NO_ROWS else rows, buf))
+                            cv.notify_all()
+            except Exception as e:                      # noqa: BLE001
+                with cv:
+                    if not state["stop"]:
+                        state["err"] = state["err"] or e
+                    cv.notify_all()
+            finally:
+                with cv:
+                    state["live"] -= 1
+                    cv.notify_all()
+
+        state["threads"] = [threading.Thread(target=read_one, args=(a,),
+                                             daemon=True)
+                            for a in self.addresses]
+        for t in state["threads"]:
+            t.start()
+        return state
+
+    @staticmethod
+    def _cancel_readers(state: Optional[dict]) -> None:
+        """Stop an epoch's readers NOW: flag + close their sockets so
+        blocked recvs fail immediately; orphaned readers must not keep
+        draining the worker (which would block its next accept) nor keep
+        allocating buffers."""
+        if state is None:
+            return
+        cv = state["cv"]
+        with cv:
+            state["stop"] = True
+            socks = list(state["socks"])
+            cv.notify_all()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in state.get("threads", []):
+            t.join(timeout=5.0)
+
+    def _frame_source(self):
+        holder: Dict[str, object] = {"state": None}
+
+        def next_fn(_cell):
+            with self._gen_lock:
+                if holder["state"] is None:
+                    holder["state"] = self._spawn_readers()
+            state = holder["state"]
+            cv = state["cv"]
+            with cv:
+                while True:
+                    if state["out"]:
+                        item = state["out"].pop(0)
+                        cv.notify_all()        # free a backpressure slot
+                        return item
+                    if state["err"] is not None:
+                        err = state["err"]
+                        raise DMLCError(f"ingest reader failed: {err}") \
+                            from err
+                    if state["live"] == 0:
+                        holder["state"] = None         # epoch exhausted
+                        return None
+                    cv.wait(timeout=1.0)
+
+        self._frame_holder = holder
+        return next_fn
+
+    def _restart_readers(self) -> None:
+        with self._gen_lock:
+            self._cancel_readers(self._frame_holder["state"])
+            self._frame_holder["state"] = None         # reconnect lazily
+
+    # -- transfer side (same as DeviceLoader's fused path) -------------
+    def _transfer_next(self, _cell):
+        item = self._frames.next()
+        if item is None:
+            return None
+        view, meta, rows, buf = item
+        expected = _fused_words_meta(self.batch_rows, int(meta))
+        if expected != len(view):
+            raise DMLCError(
+                f"ingest frame size mismatch: worker sent {len(view)} "
+                f"words but batch_rows={self.batch_rows} implies "
+                f"{expected} — trainer and worker batch_rows differ")
+        out = _put_fused_buf(view, self.batch_rows, meta)
+        import jax
+        jax.block_until_ready(out)
+        self._pool.put(buf)
+        return out
+
+    def _reset_transfer(self) -> None:
+        self._frames.before_first()
+
+    # -- consumer surface ----------------------------------------------
+    def __iter__(self):
+        while True:
+            b = self._iter.next()
+            if b is None:
+                return
+            yield b
+
+    def next_batch(self):
+        return self._iter.next()
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+
+    def close(self) -> None:
+        with self._gen_lock:
+            self._cancel_readers(self._frame_holder["state"])
+            self._frame_holder["state"] = None
+        self._frames.destroy()
+        self._iter.destroy()
+        self._pool.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def ingest_worker_main(argv=None) -> int:
+    """CLI: ``dmlc-ingest-worker uri part nparts fmt port [key=value…]``."""
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    if len(args) < 5:
+        print("usage: dmlc-ingest-worker <uri> <part> <nparts> <fmt> "
+              "<port> [batch_rows=N] [nnz_cap=N] [id_mod=N]",
+              file=sys.stderr)
+        return 2
+    uri, part, nparts, fmt, port = (args[0], int(args[1]), int(args[2]),
+                                    args[3], int(args[4]))
+    kw = dict(batch_rows=16384, nnz_cap=512 * 1024, id_mod=0)
+    for a in args[5:]:
+        k, v = a.split("=", 1)
+        kw[k] = int(v)
+    serve_ingest(uri, part, nparts, fmt, port=port, **kw)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(ingest_worker_main())
