@@ -7,6 +7,8 @@ number; this suite is for profiling the rest):
 * ``libfm``     — field-aware sparse (Criteo-style) → device batches
 * ``recordio``  — .rec streaming: write then partitioned read MB/s
 * ``stream``    — raw SeekStream read MB/s at several buffer sizes
+* ``remote_ingest`` — disaggregated ingest: 2 worker subprocesses stream
+                  fused wire frames to this process
 * ``allreduce`` — mesh psum bus-bandwidth (GB/s) over available devices
 * ``sharded``   — multi-partition libfm ingest (all parts on this host),
                   the single-host stand-in for multi-chip sharded InputSplit
@@ -228,6 +230,71 @@ def bench_recordio() -> dict:
             "unit": "MB/s"}
 
 
+def bench_remote_ingest() -> dict:
+    """Disaggregated ingest: 2 worker subprocesses parse partitions and
+    stream fused wire frames; this process only device_puts.  On a
+    multi-core host this scales parse horizontally (tf.data-service
+    shape); on a 1-core host it measures the disaggregation overhead."""
+    import socket
+    import subprocess
+    import sys as _sys
+    import jax
+    from dmlc_core_tpu.pipeline import RemoteIngestLoader
+
+    path = "/tmp/bench_suite.libsvm"
+    _gen_libsvm(path)
+    size_mb = os.path.getsize(path) / MB
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    workers = [subprocess.Popen(
+        [_sys.executable, "-m", "dmlc_core_tpu.pipeline.ingest_service",
+         f"file://{path}", str(i), "2", "libsvm", str(port),
+         "batch_rows=4096", "nnz_cap=131072"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for i, port in enumerate(ports)]
+    try:
+        # wait for the workers' listeners before timing anything
+        deadline = time.monotonic() + 120
+        for port in ports:
+            while True:
+                try:
+                    socket.create_connection(("127.0.0.1", port),
+                                             timeout=2).close()
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"ingest worker :{port} never came up")
+                    time.sleep(0.5)
+        best = 0.0
+        for attempt in range(3):
+            loader = RemoteIngestLoader(
+                [("127.0.0.1", p) for p in ports], batch_rows=4096,
+                connect_timeout=120.0)
+            last = None
+            t0 = time.perf_counter()
+            n = 0
+            for b in loader:
+                last = b
+                n += 1
+            if last is not None:
+                jax.block_until_ready(last["vals"])
+            dt = time.perf_counter() - t0
+            loader.close()
+            best = max(best, size_mb / dt)
+        return {"metric": "remote_ingest_2workers", "value": round(best, 1),
+                "unit": "MB/s"}
+    finally:
+        for w in workers:
+            w.kill()
+
+
 def bench_stream() -> dict:
     """Raw SeekStream read throughput at several buffer sizes (reference
     `test/stream_read_test.cc:16-43` instrumentation) — isolates the L3
@@ -414,6 +481,7 @@ ALL = {
     "sharded": bench_sharded,
     "recordio": bench_recordio,
     "stream": bench_stream,
+    "remote_ingest": bench_remote_ingest,
     "allreduce_mesh8": bench_allreduce_mesh8,
     "sp_mesh8": bench_sp_mesh8,
     "allreduce": bench_allreduce,
