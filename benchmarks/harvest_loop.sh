@@ -7,6 +7,10 @@
 # Stop condition: /tmp/harvest_stop exists, or all five artifacts landed.
 set -u
 cd "$(dirname "$0")/.."
+# shorter probe budget in loop mode: the loop IS the retry, so cheap
+# frequent attempts beat one long wait (a flickering tunnel re-grant is
+# easier to catch at ~10-min cadence than ~21-min)
+export DMLC_TPU_PROBE_S="${DMLC_TPU_PROBE_S:-240}"
 while [ ! -f /tmp/harvest_stop ]; do
     bash benchmarks/harvest_run.sh
     rc=$?
