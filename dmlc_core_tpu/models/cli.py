@@ -58,6 +58,11 @@ class TrainParams(Parameter):
     """All knobs of a training run (printable via ``--help``/doc_string)."""
 
     data = field(str, help="training data URI")   # no default → required
+    mode = field(str, default="train", enum=["train", "predict"],
+                 help="predict: restore ckpt_dir's latest and write "
+                      "scores for `data` to `output` (xgboost task=pred)")
+    output = field(str, default="",
+                   help="predictions URI (predict mode; any scheme)")
     format = field(str, default="auto",
                    enum=["auto", "libsvm", "libfm", "csv"],
                    help="input format ('auto': ?format= URI arg, then file "
@@ -107,6 +112,60 @@ def _parse_argv(argv):
     return conf
 
 
+def _predict(p: TrainParams, model, template_params, fmt: str,
+             needs_fields: bool) -> int:
+    """Restore the latest checkpoint and write one score per input row to
+    ``p.output`` (text, '%.6f\\n'; sigmoid for binary task) through the io
+    layer, so any registered scheme works as the sink."""
+    import sys
+
+    import jax
+    import numpy as np
+
+    from ..data import create_parser
+    from ..io import open_stream
+    from ..pipeline import DeviceLoader
+    from ..utils import CheckpointManager, DMLCError
+
+    if not p.ckpt_dir or not p.output:
+        print("dmlc-train: predict mode needs ckpt_dir and output",
+              file=sys.stderr)
+        return 2
+    try:
+        step_no, state = CheckpointManager(p.ckpt_dir).restore(
+            template={"params": template_params})
+    except DMLCError as e:
+        print(f"dmlc-train: {e}", file=sys.stderr)
+        return 2
+    meta_model = CheckpointManager(p.ckpt_dir).meta(step_no).get("model")
+    if meta_model and meta_model != p.model:
+        print(f"dmlc-train: checkpoint was trained as '{meta_model}' but "
+              f"model={p.model} requested", file=sys.stderr)
+        return 2
+    params = state["params"]
+    fwd = jax.jit(model.forward)
+    n = 0
+    with open_stream(p.output, "w") as out:
+        loader = DeviceLoader(
+            create_parser(p.data, 0, 1, fmt),
+            batch_rows=p.batch_rows, nnz_cap=p.nnz_cap,
+            fields=needs_fields, id_mod=p.features)
+        try:
+            for batch in loader:
+                scores = fwd(params, batch)
+                if p.task == "binary":
+                    scores = jax.nn.sigmoid(scores)
+                keep = np.asarray(batch["weights"]) > 0
+                for v in np.asarray(scores)[keep]:
+                    out.write(b"%.6f\n" % float(v))
+                    n += 1
+        finally:
+            loader.close()
+    print(f"wrote {n} predictions from step {step_no} -> {p.output}",
+          flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -148,6 +207,10 @@ def main(argv=None) -> int:
                 fmt = "auto"
 
     params = model.init(jax.random.PRNGKey(p.seed))
+
+    if p.mode == "predict":
+        return _predict(p, model, params, fmt, needs_fields)
+
     opt = optax.adam(p.lr)
     opt_state = opt.init(params)
     step = make_train_step(model, opt)

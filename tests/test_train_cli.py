@@ -136,3 +136,40 @@ def test_cli_resume_continues_from_checkpoint(libsvm_file, tmp_path):
     # resume without ckpt_dir is a loud config error
     c = _run([f"data={libsvm_file}", "resume=1"])
     assert c.returncode == 2
+
+
+def test_cli_predict_mode_roundtrip(libsvm_file, tmp_path):
+    """train → checkpoint → predict: one score per row, informative AUC,
+    and a model-name mismatch against the checkpoint meta fails loudly."""
+    ckpt = tmp_path / "ck"
+    common = [f"data={libsvm_file}", "model=fm", "features=64", "dim=4",
+              "batch_rows=128", "nnz_cap=2048", "lr=0.1", "epochs=3",
+              f"ckpt_dir={ckpt}", "log_every=0", "eval_auc=0"]
+    assert _run(common).returncode == 0
+    pred = tmp_path / "scores.txt"
+    out = _run([f"data={libsvm_file}", "mode=predict", "model=fm",
+                "features=64", "dim=4", "batch_rows=128", "nnz_cap=2048",
+                f"ckpt_dir={ckpt}", f"output=file://{pred}"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    scores = [float(x) for x in pred.read_text().split()]
+    labels = [int(line.split()[0]) for line in
+              open(libsvm_file).read().splitlines()]
+    assert len(scores) == len(labels)
+    assert all(0.0 <= s <= 1.0 for s in scores)      # sigmoid applied
+    # scores must actually rank the labels (train AUC >~ chance)
+    import numpy as _np
+    s, y = _np.asarray(scores), _np.asarray(labels)
+    pos, neg = s[y == 1], s[y == 0]
+    auc = (pos[:, None] > neg[None, :]).mean()
+    assert auc > 0.7, auc
+
+    # mismatched model name vs checkpoint meta
+    bad = _run([f"data={libsvm_file}", "mode=predict", "model=logreg",
+                "features=64", "batch_rows=128", "nnz_cap=2048",
+                f"ckpt_dir={ckpt}", f"output=file://{pred}"])
+    assert bad.returncode == 2
+    assert "trained as 'fm'" in bad.stderr
+    # missing output
+    bad2 = _run([f"data={libsvm_file}", "mode=predict",
+                 f"ckpt_dir={ckpt}"])
+    assert bad2.returncode == 2
