@@ -73,18 +73,63 @@ def cmd_cp(src_uri: str, dst_uri: str) -> int:
     return 0
 
 
+def cmd_pack(src_uri: str, dst_uri: str) -> int:
+    """Each text line (newline stripped) becomes one recordio record —
+    the im2rec-style list→.rec conversion, format-agnostic."""
+    from .recordio import RecordIOWriter
+    n = 0
+    with open_seek_stream_for_read(src_uri) as src, \
+            open_stream(dst_uri, "w") as dst:
+        w = RecordIOWriter(dst)
+        carry = b""
+        while True:
+            chunk = src.read(_CHUNK)
+            if not chunk:
+                break
+            carry += chunk
+            *lines, carry = carry.split(b"\n")
+            for line in lines:
+                w.write_record(line)
+                n += 1
+        if carry:
+            w.write_record(carry)
+            n += 1
+    print(f"packed {n} records {src_uri} -> {dst_uri}", file=sys.stderr)
+    return 0
+
+
+def cmd_unpack(src_uri: str, dst_uri: str) -> int:
+    """Inverse of pack: one text line per record."""
+    from .recordio import RecordIOReader
+    n = 0
+    with open_seek_stream_for_read(src_uri) as src, \
+            open_stream(dst_uri, "w") as dst:
+        r = RecordIOReader(src)
+        while True:
+            rec = r.next_record()
+            if rec is None:
+                break
+            dst.write(rec)
+            dst.write(b"\n")
+            n += 1
+    print(f"unpacked {n} records {src_uri} -> {dst_uri}", file=sys.stderr)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="dmlc-fs",
         description="ls/cat/cp/stat over any URI scheme "
-                    "(file, http(s), s3, gs, hdfs, azure)")
+                    "(file, http(s), s3, gs, hdfs, azure); pack/unpack "
+                    "convert line-text <-> recordio")
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("ls").add_argument("uri")
     sub.add_parser("stat").add_argument("uri")
     sub.add_parser("cat").add_argument("uri")
-    cp = sub.add_parser("cp")
-    cp.add_argument("src")
-    cp.add_argument("dst")
+    for name in ("cp", "pack", "unpack"):
+        sp = sub.add_parser(name)
+        sp.add_argument("src")
+        sp.add_argument("dst")
     args = p.parse_args(argv)
     try:
         if args.cmd == "ls":
@@ -93,6 +138,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_stat(args.uri)
         if args.cmd == "cat":
             return cmd_cat(args.uri)
+        if args.cmd == "pack":
+            return cmd_pack(args.src, args.dst)
+        if args.cmd == "unpack":
+            return cmd_unpack(args.src, args.dst)
         return cmd_cp(args.src, args.dst)
     except DMLCError as e:
         print(f"dmlc-fs: {e}", file=sys.stderr)

@@ -697,3 +697,20 @@ def test_checkpoint_manager_over_webhdfs_rename_publish(hdfs_server):
     # no temp objects left behind, pruned step deleted
     assert set(h.files) == {"/ck/run/ckpt-1.bin", "/ck/run/ckpt-2.bin",
                             "/ck/run/MANIFEST.json"}
+
+
+def test_fscli_pack_unpack_roundtrip(tmp_path, capsys):
+    """text → .rec → text roundtrip through the CLI, including lines that
+    embed the recordio magic bytes (the codec's escape path)."""
+    from dmlc_core_tpu.io.fscli import main
+    import struct as _struct
+    src = tmp_path / "in.txt"
+    magic = _struct.pack("<I", 0xced7230a)
+    lines = [b"hello world", b"", b"x" * 5000, magic + b"embedded" + magic,
+             "unicode-é".encode()]
+    src.write_bytes(b"\n".join(lines) + b"\n")
+    rec = tmp_path / "out.rec"
+    txt = tmp_path / "back.txt"
+    assert main(["pack", f"file://{src}", f"file://{rec}"]) == 0
+    assert main(["unpack", f"file://{rec}", f"file://{txt}"]) == 0
+    assert txt.read_bytes() == src.read_bytes()
