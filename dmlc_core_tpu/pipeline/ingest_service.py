@@ -271,11 +271,26 @@ class RemoteIngestLoader:
                 f"ingest frame size mismatch: worker sent {len(view)} "
                 f"words but batch_rows={self.batch_rows} implies "
                 f"{expected} — trainer and worker batch_rows differ")
-        out = _put_fused_buf(view, self.batch_rows, meta)
-        import jax
-        jax.block_until_ready(out)
+        self._maybe_bind()
+        with self._m_h2d.time():
+            out = _put_fused_buf(view, self.batch_rows, meta)
+            import jax
+            jax.block_until_ready(out)
         self._pool.put(buf)
+        self._m_batches.add(1)
+        if rows is not None:
+            self._m_rows.add(rows)
         return out
+
+    def _maybe_bind(self) -> None:
+        # same observability surface as DeviceLoader: per-stage timers +
+        # counters, re-bound when the metrics registry generation changes
+        from ..utils.metrics import metrics
+        if getattr(self, "_m_gen", None) != metrics.generation:
+            self._m_gen = metrics.generation
+            self._m_h2d = metrics.stage("remote_ingest.h2d")
+            self._m_batches = metrics.counter("remote_ingest.batches")
+            self._m_rows = metrics.throughput("remote_ingest.rows")
 
     def _reset_transfer(self) -> None:
         self._frames.before_first()
