@@ -63,6 +63,10 @@ class TrainParams(Parameter):
                       "scores for `data` to `output` (xgboost task=pred)")
     output = field(str, default="",
                    help="predictions URI (predict mode; any scheme)")
+    workers = field(str, default="",
+                    help="comma-separated host:port ingest workers "
+                         "(disaggregated ingest; train mode, fused "
+                         "formats only — see docs/data.md)")
     format = field(str, default="auto",
                    enum=["auto", "libsvm", "libfm", "csv"],
                    help="input format ('auto': ?format= URI arg, then file "
@@ -233,10 +237,23 @@ def main(argv=None) -> int:
 
     # ONE loader, rewound between epochs (the fit_stream pattern): the
     # parser/transfer threads and pinned buffers are reused, not rebuilt
-    loader = DeviceLoader(
-        create_parser(p.data, 0, 1, fmt),
-        batch_rows=p.batch_rows, nnz_cap=p.nnz_cap,
-        fields=needs_fields, id_mod=p.features)
+    if p.workers:
+        if needs_fields:
+            print("dmlc-train: workers= (fused wire) does not carry "
+                  "libfm fields — use local ingest for ffm",
+                  file=sys.stderr)
+            return 2
+        from ..pipeline import RemoteIngestLoader
+        addrs = []
+        for tok in p.workers.split(","):
+            host, _, port = tok.strip().rpartition(":")
+            addrs.append((host, int(port)))
+        loader = RemoteIngestLoader(addrs, batch_rows=p.batch_rows)
+    else:
+        loader = DeviceLoader(
+            create_parser(p.data, 0, 1, fmt),
+            batch_rows=p.batch_rows, nnz_cap=p.nnz_cap,
+            fields=needs_fields, id_mod=p.features)
     n = start_n
     loss = None
     try:

@@ -173,3 +173,28 @@ def test_cli_predict_mode_roundtrip(libsvm_file, tmp_path):
     bad2 = _run([f"data={libsvm_file}", "mode=predict",
                  f"ckpt_dir={ckpt}"])
     assert bad2.returncode == 2
+
+
+def test_cli_trains_from_ingest_workers(libsvm_file, tmp_path):
+    """workers= routes the CLI through the disaggregated ingest service."""
+    import socket
+    import threading
+    from dmlc_core_tpu.pipeline import serve_ingest
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ev = threading.Event()
+    threading.Thread(
+        target=serve_ingest,
+        args=(f"file://{libsvm_file}", 0, 1, "libsvm"),
+        kwargs=dict(batch_rows=128, nnz_cap=2048, port=port,
+                    host="127.0.0.1", max_epochs=4, ready_event=ev),
+        daemon=True).start()
+    assert ev.wait(timeout=30)
+    out = _run([f"data={libsvm_file}", "model=fm", "features=64", "dim=4",
+                f"workers=127.0.0.1:{port}", "batch_rows=128",
+                "nnz_cap=2048", "epochs=2", "log_every=0", "eval_auc=0"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "trained fm:" in out.stdout
