@@ -7,11 +7,13 @@ from .deep import DeepFM  # noqa: F401
 from .ftrl import ftrl, FTRLState  # noqa: F401
 from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F401
                     param_shardings, shard_params, fit_stream,
-                    streaming_auc, auc_from_histograms)
+                    streaming_auc, auc_from_histograms,
+                    evaluate_stream)
 
 __all__ = [
     "SparseLogReg", "FactorizationMachine", "FieldAwareFM", "DeepFM",
     "weighted_bce", "weighted_mse",
     "make_train_step", "make_eval_step", "batch_sharding", "param_shardings",
     "shard_params", "fit_stream", "streaming_auc", "auc_from_histograms",
+    "evaluate_stream",
 ]

@@ -34,7 +34,7 @@ from ..utils.timer import Timer
 
 __all__ = ["make_train_step", "make_eval_step", "batch_sharding",
            "param_shardings", "shard_params", "fit_stream", "TrainState",
-           "streaming_auc", "auc_from_histograms"]
+           "streaming_auc", "auc_from_histograms", "evaluate_stream"]
 
 TrainState = Tuple[Dict[str, jax.Array], Any]
 
@@ -162,6 +162,29 @@ def auc_from_histograms(pos: jax.Array, neg: jax.Array) -> jax.Array:
         [jnp.zeros((1,), pos.dtype), jnp.cumsum(neg)[:-1]])
     wins = (pos * (neg_below + 0.5 * neg)).sum()
     return wins / (total_pos * total_neg)
+
+
+def evaluate_stream(model, params, loader, *, mesh: Optional[Mesh] = None,
+                    auc: bool = True):
+    """One pass over ``loader``: weighted accuracy and (optionally) the
+    streaming binned ROC-AUC.  Works with any loader exposing the batch
+    dict contract (DeviceLoader, RemoteIngestLoader)."""
+    ev = make_eval_step(model, mesh)
+    fwd = jax.jit(model.forward)
+    correct = total = 0.0
+    pos = neg = 0.0
+    for batch in loader:
+        c, t = ev(params, batch)
+        correct += float(c)
+        total += float(t)
+        if auc:
+            a, b = streaming_auc(fwd(params, batch), batch["labels"],
+                                 batch["weights"])
+            pos, neg = pos + a, neg + b
+    out = {"accuracy": correct / max(total, 1e-9), "weight": total}
+    if auc:
+        out["auc"] = float(auc_from_histograms(pos, neg))
+    return out
 
 
 def fit_stream(model, loader: DeviceLoader, *, epochs: int = 1,

@@ -258,3 +258,19 @@ def test_streaming_auc_matches_sklearn_style_reference():
     p, q = streaming_auc(jnp.asarray(scores[:10]), jnp.ones((10,)),
                          jnp.ones((10,)))
     assert np.isfinite(float(auc_from_histograms(p, q)))
+
+
+def test_evaluate_stream_helper(tmp_path):
+    from dmlc_core_tpu.models import evaluate_stream
+    rng = np.random.default_rng(6)
+    path = str(tmp_path / "e.libsvm")
+    write_linear_dataset(path, rng, n=600)
+    loader = DeviceLoader(create_parser(path), batch_rows=128, nnz_cap=2048)
+    model = SparseLogReg(num_features=60)
+    params, _ = fit_stream(model, loader, epochs=3,
+                           optimizer=optax.adam(0.05), log_every=0)
+    loader.before_first()
+    r = evaluate_stream(model, params, loader)
+    loader.close()
+    assert r["accuracy"] > 0.85 and 0.85 < r["auc"] <= 1.0, r
+    assert r["weight"] == 600
