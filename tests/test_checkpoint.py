@@ -214,6 +214,7 @@ def test_train_interrupt_resume(tmp_path):
 def test_orbax_interop_roundtrip(tmp_path):
     """Orbax bridge: save a params pytree via orbax, restore with and
     without a template, values identical to the native format's."""
+    pytest.importorskip("orbax.checkpoint")
     import jax
     import jax.numpy as jnp
     import numpy as np
