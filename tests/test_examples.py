@@ -174,3 +174,36 @@ def test_train_ffm_example(tmp_path):
         env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "done:" in out.stdout
+
+
+def test_failure_injection_two_crashes_wide_cohort(tmp_path):
+    """Two workers of an 8-wide cohort crash on their first attempt; both
+    are reborn by the retry loop, the tree topology assembles with all 8,
+    and the allreduce is correct — elastic recovery beyond the minimal
+    3-worker case."""
+    script = tmp_path / "wide_worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "import numpy as np\n"
+        "from dmlc_core_tpu.parallel import RabitContext\n"
+        "tid = os.environ['DMLC_TASK_ID']\n"
+        "att = int(os.environ.get('DMLC_NUM_ATTEMPT', '0'))\n"
+        "if tid in ('2', '5') and att == 0:\n"
+        "    print('INJECTED-CRASH', tid, flush=True)\n"
+        "    sys.exit(1)\n"
+        "ctx = RabitContext.from_env()\n"
+        "out = ctx.allreduce(np.array([float(ctx.rank + 1)]))\n"
+        "assert out[0] == sum(range(1, ctx.world_size + 1)), out\n"
+        "print('SURVIVED rank', ctx.rank, 'attempt', att, flush=True)\n"
+        "ctx.shutdown()\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
+         "--cluster", "local", "-n", "8",
+         "--env", f"PYTHONPATH={REPO}",
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=480,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert out.stdout.count("INJECTED-CRASH") == 2
+    assert out.stdout.count("SURVIVED") == 8
+    assert out.stdout.count("attempt 1") == 2   # both reborn workers
