@@ -162,9 +162,14 @@ def force_cpu() -> None:
         pass
 
 
-def measure_ours():
+def measure_ours(platform_override: str = ""):
     """Returns (mean_mbps, per_run_mbps, (put_threads, compact, rows),
-    platform)."""
+    platform).
+
+    ``platform_override`` forces the config-probe control flow of another
+    platform while running on the current backend — the multi-combo TPU
+    probe path must be exercisable in CPU tests, or a bug in it would
+    surface for the first time during the one driver run that matters."""
     sys.path.insert(0, REPO)
     from dmlc_core_tpu import native
     if not native.available():
@@ -175,7 +180,7 @@ def measure_ours():
     from dmlc_core_tpu.utils.metrics import metrics
 
     size_mb = os.path.getsize(DATA) / (1 << 20)
-    platform = jax.devices()[0].platform
+    platform = platform_override or jax.devices()[0].platform
     log(f"running ingest on {platform} ...")
     batch_rows = int(os.environ.get("DMLC_BENCH_ROWS", "16384"))
     nnz_cap = int(os.environ.get("DMLC_BENCH_NNZ", str(512 * 1024)))

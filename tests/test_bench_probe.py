@@ -1,0 +1,64 @@
+"""The root bench's multi-combo probe control flow (put_threads × compact
+× batch shape, screen-then-confirm) — exercised on the CPU backend via
+platform_override so a regression can't hide until the driver's one TPU
+run."""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench_mod(tmp_path_factory):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_under_test"] = mod
+    spec.loader.exec_module(mod)
+    # small corpus: the probe runs ~20 passes over it
+    data = tmp_path_factory.mktemp("bench") / "probe.libsvm"
+    rng = np.random.default_rng(0)
+    with open(data, "w") as f:
+        for r in range(4000):
+            idx = np.sort(rng.choice(50_000, size=12, replace=False))
+            f.write(f"{r % 2} " + " ".join(
+                f"{j}:{rng.random():.4f}" for j in idx) + "\n")
+    mod.DATA = str(data)
+    return mod
+
+
+def test_probe_flow_tpu_configspace_on_cpu(bench_mod, capfd):
+    mean, runs, (pt, cm, rows), platform = bench_mod.measure_ours(
+        platform_override="tpu")
+    err = capfd.readouterr().err
+    assert platform == "tpu"
+    assert len(runs) == 3 and all(r > 0 for r in runs)
+    assert mean > 0
+    # the full config space was screened: 2 pt × 2 compact × 3 shapes
+    assert "config probe:" in err
+    probe_line = [ln for ln in err.splitlines() if "config probe:" in ln][0]
+    assert probe_line.count("pt=") >= 12, probe_line
+    for frag in ("rows=16384", "rows=49152", "rows=147456",
+                 "compact=1", "compact=0"):
+        assert frag in probe_line, (frag, probe_line)
+    # the winner is one of the probed configs
+    assert pt in (1, 4) and cm in (True, False)
+    assert rows in (16384, 49152, 147456)
+
+
+def test_probe_flow_pinned_by_env(bench_mod, capfd, monkeypatch):
+    monkeypatch.setenv("DMLC_BENCH_PUT_THREADS", "1")
+    monkeypatch.setenv("DMLC_BENCH_COMPACT", "0")
+    monkeypatch.setenv("DMLC_BENCH_ROWS", "8192")
+    monkeypatch.setenv("DMLC_BENCH_NNZ", "131072")
+    mean, runs, (pt, cm, rows), _ = bench_mod.measure_ours(
+        platform_override="tpu")
+    err = capfd.readouterr().err
+    assert "config probe:" not in err       # single pinned combo, no probe
+    assert (pt, cm, rows) == (1, False, 8192)
+    assert mean > 0
