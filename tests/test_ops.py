@@ -76,3 +76,37 @@ def test_pallas_embed_bag_interpret_matches_reference():
     ref = embed_bag_reference(ids, vals, table)
     out = embed_bag_pallas(ids, vals, table, interpret=True)
     np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_engine_autotune_logic(monkeypatch):
+    """_pallas_faster: picks by measured time, caches per shape, and a
+    kernel failure degrades to XLA instead of raising — exercised on CPU
+    since the real gate only opens on TPU."""
+    from dmlc_core_tpu.ops import pallas_embed as pe
+
+    pe._engine_time_cache.clear()
+    # kernel raises (CPU without interpret) → False, no exception
+    assert pe._pallas_faster(64, 4, 8, fused=False) is False
+    assert pe._engine_time_cache[(4, 8, False)] is False
+
+    # substitute engines with controllable speeds: pallas wins.  The slow
+    # engine must be slow when COMPILED (the autotuner jits the xla side),
+    # so it carries real FLOPs, not a python sleep that traces away.
+    def fast(ids, vals, table):
+        return jnp.zeros((ids.shape[0], table.shape[1]), jnp.float32)
+
+    def slow(ids, vals, table, square=False):
+        x = jnp.ones((400, 400), jnp.float32)
+        for _ in range(30):
+            x = (x @ x) * 1e-3
+        return jnp.zeros((ids.shape[0], table.shape[1]),
+                         jnp.float32) + x[0, 0]
+
+    monkeypatch.setattr(pe, "embed_bag_pallas", fast)
+    monkeypatch.setattr(pe, "embed_bag_reference", slow)
+    pe._engine_time_cache.clear()
+    assert pe._pallas_faster(64, 5, 8, fused=False) is True
+    # cached: flipping the implementations does not change the verdict
+    monkeypatch.setattr(pe, "embed_bag_pallas", slow)
+    assert pe._pallas_faster(64, 5, 8, fused=False) is True
+    pe._engine_time_cache.clear()
