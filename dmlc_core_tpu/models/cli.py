@@ -67,6 +67,9 @@ class TrainParams(Parameter):
                     help="comma-separated host:port ingest workers "
                          "(disaggregated ingest; train mode, fused "
                          "formats only — see docs/data.md)")
+    valid = field(str, default="",
+                  help="validation data URI: accuracy/AUC printed per "
+                       "epoch (the reference ecosystem's watchlist)")
     format = field(str, default="auto",
                    enum=["auto", "libsvm", "libfm", "csv"],
                    help="input format ('auto': ?format= URI arg, then file "
@@ -254,6 +257,23 @@ def main(argv=None) -> int:
             create_parser(p.data, 0, 1, fmt),
             batch_rows=p.batch_rows, nnz_cap=p.nnz_cap,
             fields=needs_fields, id_mod=p.features)
+    def eval_valid(epoch: int) -> None:
+        if not p.valid:
+            return
+        from .train import evaluate_stream
+        vl = DeviceLoader(
+            create_parser(p.valid, 0, 1, fmt),
+            batch_rows=p.batch_rows, nnz_cap=p.nnz_cap,
+            fields=needs_fields, id_mod=p.features)
+        try:
+            r = evaluate_stream(model, params, vl,
+                                auc=p.task == "binary")
+        finally:
+            vl.close()
+        auc = f" auc {r['auc']:.4f}" if "auc" in r else ""
+        print(f"epoch {epoch} valid acc {r['accuracy']:.4f}{auc}",
+              flush=True)
+
     n = start_n
     loss = None
     try:
@@ -265,6 +285,7 @@ def main(argv=None) -> int:
                     print(f"epoch {epoch} step {n} loss {float(loss):.5f}",
                           flush=True)
             loader.before_first()
+            eval_valid(epoch)
         if loss is None:
             print("dmlc-train: no batches in input", file=sys.stderr)
             return 3

@@ -198,3 +198,15 @@ def test_cli_trains_from_ingest_workers(libsvm_file, tmp_path):
                 "nnz_cap=2048", "epochs=2", "log_every=0", "eval_auc=0"])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "trained fm:" in out.stdout
+
+
+def test_cli_valid_watchlist(libsvm_file, tmp_path):
+    out = _run([f"data={libsvm_file}", f"valid={libsvm_file}", "model=fm",
+                "features=64", "dim=4", "batch_rows=128", "nnz_cap=2048",
+                "lr=0.1", "epochs=2", "log_every=0", "eval_auc=0"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if "valid acc" in ln]
+    assert len(lines) == 2                       # once per epoch
+    assert "auc" in lines[-1]
+    final_auc = float(lines[-1].split("auc")[1])
+    assert final_auc > 0.7, lines
