@@ -365,3 +365,38 @@ def test_pack_roundtrip_fuzz():
             if with_fields:
                 np.testing.assert_array_equal(rm["fields"][r][:len(idx)],
                                               fields)
+
+
+def test_wire_compact_property_fuzz(tmp_path):
+    """Hypothesis-style generative sweep of the compact codec's regime
+    space: id widths 1..31 bits, value cardinalities from binary to
+    unbounded, row counts hitting every flush path — plain and compact
+    wire must agree bit-exactly in all of them."""
+    import itertools
+    from dmlc_core_tpu import native
+    if not native.has_compact():
+        pytest.skip("native compact packer unavailable")
+    rng = np.random.default_rng(11)
+    id_spaces = [2, 1 << 7, 1 << 13, 1 << 20, (1 << 31) - 2]
+    val_modes = ["binary", "quantized", "continuous"]
+    rowcounts = [1, 127, 128, 300]
+    for trial, (ids_hi, vmode, nrows) in enumerate(
+            itertools.product(id_spaces, val_modes, rowcounts)):
+        path = tmp_path / f"f{trial}.libsvm"
+        with open(path, "w") as f:
+            for r in range(nrows):
+                n = int(rng.integers(1, 7))
+                hi = min(ids_hi, 1 << 20)  # choice() cost; top id forced:
+                idx = sorted(set(rng.integers(0, hi, n).tolist()))
+                if r == 0 and ids_hi > hi:
+                    idx = sorted(set(idx + [ids_hi - 1]))
+                if vmode == "binary":
+                    toks = [f"{j}:1" for j in idx]
+                elif vmode == "quantized":
+                    toks = [f"{j}:{rng.integers(0, 16) * 0.25}"
+                            for j in idx]
+                else:
+                    toks = [f"{j}:{rng.random():.7f}" for j in idx]
+                f.write(f"{r % 2} " + " ".join(toks) + "\n")
+        _assert_batches_equal(_loader_batches(str(path), False),
+                              _loader_batches(str(path), True))
