@@ -122,3 +122,29 @@ def test_get_env(monkeypatch):
     assert get_env("DMLC_TEST_MISSING", 3) == 3
     monkeypatch.setenv("DMLC_TEST_FLAG", "true")
     assert get_env("DMLC_TEST_FLAG", False) is True
+
+
+def test_param_fuzz_never_crashes_unstructured():
+    """Generative sweep: arbitrary key/value strings through a Parameter
+    struct either succeed or raise ParamError — never any other failure
+    (the CLI feeds raw user config straight into init)."""
+    import numpy as np
+    from dmlc_core_tpu.models.cli import TrainParams
+    from dmlc_core_tpu.utils import ParamError
+
+    rng = np.random.default_rng(0)
+    keys = ["data", "model", "dim", "epochs", "lr", "task", "bogus",
+            "batch_rows", "", "features", "résumé", "mode", "a b"]
+    vals = ["", "fm", "x", "-1", "0", "1e9", "3.5", "True", "none",
+            "libsvm", "🤖", "1,2", " 7 ", "nan", "inf", "-"]
+    for _ in range(300):
+        kv = {str(rng.choice(keys)): str(rng.choice(vals))
+              for _ in range(int(rng.integers(1, 6)))}
+        try:
+            p = TrainParams()
+            p.init(dict(kv))
+        except ParamError:
+            continue
+        # success ⇒ every set field round-trips through to_dict
+        d = p.to_dict()
+        assert isinstance(d, dict)
