@@ -83,13 +83,35 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """All-to-all resharded exact attention (inside shard_map).
 
     q,k,v: LOCAL sequence shards [B, T/N, H, D] with H % N == 0.
-    """
+
+    Differentiable: the backward is supplied via ``custom_vjp`` built from
+    FORWARD-direction collectives only — the two reshardings are inverse
+    permutations, so each one's adjoint IS the other (``all_to_all``'s
+    autodiff transpose mislowers under this shard_map configuration, and
+    the explicit adjoint pair is also the numerically obvious thing)."""
     n = jax.lax.axis_size(axis_name)
-    qh = _seq_to_heads(q, axis_name, n)       # [B, T, H/N, D]
-    kh = _seq_to_heads(k, axis_name, n)
-    vh = _seq_to_heads(v, axis_name, n)
-    out = reference_attention(qh, kh, vh, causal=causal)
-    return _heads_to_seq(out, axis_name, n)   # [B, T/N, H, D]
+
+    @jax.custom_vjp
+    def run(q, k, v):
+        return _fwd(q, k, v)[0]
+
+    def _fwd(q, k, v):
+        qh = _seq_to_heads(q, axis_name, n)    # [B, T, H/N, D]
+        kh = _seq_to_heads(k, axis_name, n)
+        vh = _seq_to_heads(v, axis_name, n)
+        out_h, att_vjp = jax.vjp(
+            lambda a, b, c: reference_attention(a, b, c, causal=causal),
+            qh, kh, vh)
+        return _heads_to_seq(out_h, axis_name, n), att_vjp
+
+    def _bwd(att_vjp, ct):
+        ct_h = _seq_to_heads(ct, axis_name, n)   # adjoint of heads_to_seq
+        dqh, dkh, dvh = att_vjp(ct_h)
+        return tuple(_heads_to_seq(g, axis_name, n)  # adjoint of seq_to_heads
+                     for g in (dqh, dkh, dvh))
+
+    run.defvjp(_fwd, _bwd)
+    return run(q, k, v)
 
 
 def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",

@@ -69,3 +69,28 @@ def test_ulysses_and_ring_agree():
     out_r = make_ring_attention(mesh, "sp", causal=True)(qs, ks, vs)
     np.testing.assert_allclose(np.asarray(out_u), np.asarray(out_r),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_gradients_match_dense(causal):
+    """Long-context is a TRAINING feature: grads through the all-to-all
+    resharding must equal dense-attention grads (custom_vjp built from
+    forward-direction collectives — all_to_all's autodiff transpose
+    mislowers under this shard_map configuration)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = _mesh(8)
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, 2, 32, 8, 16)
+    sharding = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
+    fn = make_ulysses_attention(mesh, "sp", causal=causal)
+    g = jax.grad(lambda a, b, c: jnp.sum(fn(a, b, c) ** 2),
+                 argnums=(0, 1, 2))(qs, ks, vs)
+    gr = jax.grad(
+        lambda a, b, c: jnp.sum(
+            reference_attention(a, b, c, causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
