@@ -104,8 +104,10 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                     _send_all(conn, memoryview(buf[:words]).cast("B"))
                     loader.recycle(buf)
                 _send_all(conn, _FRAME.pack(0, 0, 0))      # end of stream
-            except (BrokenPipeError, ConnectionError):
-                pass                      # trainer went away: next epoch
+            except Exception as e:  # noqa: BLE001 — a server: one bad
+                # connection (trainer vanished, parse error, send failure)
+                # must never take down the listener for the next epoch
+                log_info("ingest worker: connection ended early: %r", e)
             finally:
                 loader.close()
                 conn.close()
@@ -132,6 +134,7 @@ class RemoteIngestLoader:
         self.connect_timeout = connect_timeout
         depth = max(2, int(prefetch))
         self._depth = depth
+        self._closed = False
         self._pool = _BufPool(cap=2 * depth + 2)
         self._frames: ThreadedIter = ThreadedIter(
             max_capacity=max(depth, len(self.addresses)))
@@ -232,6 +235,12 @@ class RemoteIngestLoader:
 
         def next_fn(_cell):
             with self._gen_lock:
+                # the closed check lives under the SAME lock as close()'s
+                # cancellation: without it, a producer racing close() could
+                # spawn fresh readers — a ghost connection that consumes the
+                # worker's next epoch slot
+                if self._closed:
+                    return None
                 if holder["state"] is None:
                     holder["state"] = self._spawn_readers()
             state = holder["state"]
@@ -246,8 +255,8 @@ class RemoteIngestLoader:
                         err = state["err"]
                         raise DMLCError(f"ingest reader failed: {err}") \
                             from err
-                    if state["live"] == 0:
-                        holder["state"] = None         # epoch exhausted
+                    if state["live"] == 0 or state["stop"]:
+                        holder["state"] = None  # epoch exhausted / closed
                         return None
                     cv.wait(timeout=1.0)
 
@@ -311,6 +320,7 @@ class RemoteIngestLoader:
 
     def close(self) -> None:
         with self._gen_lock:
+            self._closed = True
             self._cancel_readers(self._frame_holder["state"])
             self._frame_holder["state"] = None
         self._frames.destroy()
