@@ -23,7 +23,7 @@ def test_distributed_logreg_example(tmp_path):
          "--env", f"PYTHONPATH={REPO}",
          "--", sys.executable,
          os.path.join(REPO, "examples", "distributed_logreg.py"), str(data)],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=600,
         env={**os.environ, "PYTHONPATH": REPO, "EPOCHS": "2"})
     assert out.returncode == 0, out.stderr[-3000:]
     assert out.stderr.count("all workers agree") == 3
@@ -54,7 +54,7 @@ def test_failure_injection_worker_crash_and_recover(tmp_path):
          "--cluster", "local", "-n", "3",
          "--env", f"PYTHONPATH={REPO}",
          "--", sys.executable, str(script)],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=600,
         env={**os.environ, "PYTHONPATH": REPO})
     assert out.returncode == 0, out.stderr[-3000:]
     assert "INJECTED-CRASH" in out.stdout
@@ -98,7 +98,7 @@ def test_failure_injection_midjob_crash_and_second_allreduce(tmp_path):
          "--env", f"PYTHONPATH={REPO}",
          "--env", f"DMLC_CHECKPOINT_DIR={tmp_path}",
          "--", sys.executable, str(script)],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=600,
         env={**os.environ, "PYTHONPATH": REPO})
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-3000:])
     assert "MIDJOB-CRASH" in out.stdout
@@ -147,7 +147,7 @@ def test_checkpoint_resume_after_midjob_kill_converges(tmp_path):
          "--env", f"CKPT_DIR={tmp_path}",
          "--env", "DMLC_RECOVER_TIMEOUT=30",
          "--", sys.executable, str(script)],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=600,
         env={**os.environ, "PYTHONPATH": REPO})
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-3000:])
     assert "KILLED-MIDJOB" in out.stdout
@@ -170,7 +170,7 @@ def test_train_ffm_example(tmp_path):
         [sys.executable, os.path.join(REPO, "examples", "train_ffm.py"),
          f"file://{data}", "--features", "256", "--fields", "5",
          "--batch-rows", "128", "--nnz-cap", "2048"],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=600,
         env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "done:" in out.stdout
