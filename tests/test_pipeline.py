@@ -400,3 +400,18 @@ def test_wire_compact_property_fuzz(tmp_path):
                 f.write(f"{r % 2} " + " ".join(toks) + "\n")
         _assert_batches_equal(_loader_batches(str(path), False),
                               _loader_batches(str(path), True))
+
+
+def test_wire_compact_with_transfer_pool(libsvm_file):
+    """The bench probes compact × put_threads on the chip; the combination
+    (pool recycling + compact buffers) must agree with the plain single-
+    thread path batch-for-batch."""
+    from dmlc_core_tpu import native
+    if not native.has_compact():
+        pytest.skip("native compact packer unavailable")
+    plain = _loader_batches(libsvm_file, False)
+    with DeviceLoader(create_parser(libsvm_file), batch_rows=128,
+                      nnz_cap=1024, wire_compact=True,
+                      put_threads=4) as loader:
+        pooled = [{k: np.asarray(v) for k, v in b.items()} for b in loader]
+    _assert_batches_equal(plain, pooled)
