@@ -75,3 +75,35 @@ def test_disk_iter_small_pages(libsvm_file, tmp_path):
     assert len(blocks) > 1  # multiple pages
     assert sum(b.size for b in blocks) == 2000
     it.close()
+
+
+def test_disk_cache_iter_feeds_device_loader(tmp_path):
+    """#cache RowBlockIter as a DeviceLoader source across two epochs —
+    the reference's disk_row_iter → consumer composition on the device
+    path, with the second epoch served purely from the cache."""
+    import numpy as np
+    from dmlc_core_tpu.pipeline import DeviceLoader
+
+    rng = np.random.default_rng(0)
+    path = tmp_path / "d.libsvm"
+    with open(path, "w") as f:
+        for r in range(300):
+            idx = np.sort(rng.choice(500, size=4, replace=False))
+            f.write(f"{r} " + " ".join(
+                f"{j}:{rng.random():.4f}" for j in idx) + "\n")
+    cache = tmp_path / "rows.cache"
+    it = create_row_block_iter(f"file://{path}#{cache}", 0, 1, "libsvm")
+    loader = DeviceLoader(it, batch_rows=64, nnz_cap=1024)
+    try:
+        def labels_of():
+            seen = []
+            for b in loader:
+                w = np.asarray(b["weights"]) > 0
+                seen.extend(np.asarray(b["labels"])[w].astype(int).tolist())
+            return sorted(seen)
+        assert labels_of() == list(range(300))
+        path.unlink()                   # second epoch must come from cache
+        loader.before_first()
+        assert labels_of() == list(range(300))
+    finally:
+        loader.close()
