@@ -47,7 +47,7 @@ def test_failure_injection_worker_crash_and_recover(tmp_path):
         "ctx = RabitContext.from_env()\n"
         "out = ctx.allreduce(np.array([float(ctx.rank)]))\n"
         "assert out[0] == sum(range(ctx.world_size))\n"
-        "print('SURVIVED rank', ctx.rank, 'attempt', att, flush=True)\n"
+        "print(f'SURVIVED rank {ctx.rank} attempt {att}', flush=True)\n"
         "ctx.shutdown()\n")
     out = subprocess.run(
         [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
@@ -90,7 +90,7 @@ def test_failure_injection_midjob_crash_and_second_allreduce(tmp_path):
         "out2 = ctx.allreduce(np.array([out1[0] * (ctx.rank + 1)]))\n"
         "expected = out1[0] * sum(r + 1 for r in range(ctx.world_size))\n"
         "assert out2[0] == expected, (out2, expected)\n"
-        "print('SECOND-OK rank', ctx.rank, 'attempt', att, flush=True)\n"
+        "print(f'SECOND-OK rank {ctx.rank} attempt {att}', flush=True)\n"
         "ctx.shutdown()\n")
     out = subprocess.run(
         [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
@@ -126,7 +126,7 @@ def test_checkpoint_resume_after_midjob_kill_converges(tmp_path):
         "    s, state = mgr.restore()\n"
         "    start, w = s + 1, state['w']\n"
         "    ctx.resume_seq(state['seq'])\n"
-        "    print('RESUMED rank', ctx.rank, 'from step', s, flush=True)\n"
+        "    print(f'RESUMED rank {ctx.rank} from step {s}', flush=True)\n"
         "target = 3.0\n"
         "for step in range(start, 10):\n"
         "    g = ctx.allreduce(w - target) / ctx.world_size\n"
@@ -137,8 +137,8 @@ def test_checkpoint_resume_after_midjob_kill_converges(tmp_path):
         "        os._exit(1)\n"
         "final = ctx.allreduce(w) / ctx.world_size\n"
         "assert abs(final[0] - target) < 0.1, final\n"
-        "print('CONVERGED rank', ctx.rank, 'attempt', att,\n"
-        "      float(final[0]), flush=True)\n"
+        "print(f'CONVERGED rank {ctx.rank} attempt {att} '\n"
+        "      f'{float(final[0])}', flush=True)\n"
         "ctx.shutdown()\n")
     out = subprocess.run(
         [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
@@ -189,12 +189,12 @@ def test_failure_injection_two_crashes_wide_cohort(tmp_path):
         "tid = os.environ['DMLC_TASK_ID']\n"
         "att = int(os.environ.get('DMLC_NUM_ATTEMPT', '0'))\n"
         "if tid in ('2', '5') and att == 0:\n"
-        "    print('INJECTED-CRASH', tid, flush=True)\n"
+        "    print(f'INJECTED-CRASH {tid}', flush=True)\n"
         "    sys.exit(1)\n"
         "ctx = RabitContext.from_env()\n"
         "out = ctx.allreduce(np.array([float(ctx.rank + 1)]))\n"
         "assert out[0] == sum(range(1, ctx.world_size + 1)), out\n"
-        "print('SURVIVED rank', ctx.rank, 'attempt', att, flush=True)\n"
+        "print(f'SURVIVED rank {ctx.rank} attempt {att}', flush=True)\n"
         "ctx.shutdown()\n")
     out = subprocess.run(
         [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
