@@ -36,6 +36,14 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def host_cores() -> int:
+    """Usable cores (affinity-aware; the bench host may be pinned)."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
 def gen_data() -> None:
     if os.path.exists(DATA) and os.path.getsize(DATA) >= TARGET_MB * 0.9 * (1 << 20):
         return
@@ -185,10 +193,7 @@ def measure_ours(platform_override: str = ""):
     batch_rows = int(os.environ.get("DMLC_BENCH_ROWS", "16384"))
     nnz_cap = int(os.environ.get("DMLC_BENCH_NNZ", str(512 * 1024)))
 
-    try:
-        cores = len(os.sched_getaffinity(0))
-    except (AttributeError, OSError):
-        cores = os.cpu_count() or 1
+    cores = host_cores()
     # on a single core the extra parse thread + OpenMP team only add
     # context-switch overhead; on real hosts they scale the parse
     nthreads, threaded = (1, False) if cores == 1 else (cores, True)

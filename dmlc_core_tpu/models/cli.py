@@ -158,12 +158,26 @@ def _predict(p: TrainParams, model, template_params, fmt: str,
             batch_rows=p.batch_rows, nnz_cap=p.nnz_cap,
             fields=needs_fields, id_mod=p.features)
         try:
+            # one-score-per-input-row alignment: padding rows exist only at
+            # the TAIL of the FINAL batch (batch_slices yields full batches;
+            # only the flush pads), and loader.stats.rows is the exact real
+            # row total once iteration ends — so write with a one-batch lag
+            # and trim the held-back last batch.  Weights are NOT a padding
+            # signal: a real row may carry an explicit weight of 0 and must
+            # still get its score (ADVICE r3).
+            held = None
             for batch in loader:
                 scores = fwd(params, batch)
                 if p.task == "binary":
                     scores = jax.nn.sigmoid(scores)
-                keep = np.asarray(batch["weights"]) > 0
-                for v in np.asarray(scores)[keep]:
+                if held is not None:
+                    for v in held:
+                        out.write(b"%.6f\n" % float(v))
+                    n += len(held)
+                held = np.asarray(scores)
+            if held is not None:
+                total = int(loader.stats.rows)
+                for v in held[:max(0, total - n)]:
                     out.write(b"%.6f\n" % float(v))
                     n += 1
         finally:
@@ -229,11 +243,20 @@ def main(argv=None) -> int:
             return 2
         from ..utils import CheckpointManager, DMLCError as _DE
         try:
+            # opt_state rides the checkpoint (ADVICE r3: params-only resume
+            # silently reset Adam moments); older params-only checkpoints
+            # restore without the key — warn, don't fail
             start_n, state = CheckpointManager(p.ckpt_dir).restore(
-                template={"params": params})
+                template={"params": params, "opt_state": opt_state})
             params = state["params"]
-            print(f"resumed from step {start_n} in {p.ckpt_dir}",
-                  flush=True)
+            if "opt_state" in state:
+                opt_state = state["opt_state"]
+                print(f"resumed from step {start_n} in {p.ckpt_dir}",
+                      flush=True)
+            else:
+                print(f"resumed params from step {start_n} in {p.ckpt_dir} "
+                      "(old checkpoint without opt_state — optimizer "
+                      "moments reset)", flush=True)
         except _DE:
             print(f"no checkpoint in {p.ckpt_dir} — starting fresh",
                   flush=True)
@@ -307,7 +330,7 @@ def main(argv=None) -> int:
     if p.ckpt_dir:
         from ..utils import CheckpointManager
         mgr = CheckpointManager(p.ckpt_dir)
-        mgr.save(n, {"params": params},
+        mgr.save(n, {"params": params, "opt_state": opt_state},
                  meta={"model": p.model, "steps": int(n)})
         print(f"checkpoint step {n} -> {p.ckpt_dir}", flush=True)
     return 0

@@ -65,12 +65,32 @@ def _pallas_supported(D: int, fused: bool = False) -> bool:
 _engine_time_cache: dict = {}
 
 
-def _pallas_faster(B: int, K: int, D: int, fused: bool) -> bool:
-    """Timed auto-tune per (K, D, fused): compiling is necessary but not
-    sufficient — a kernel that lowers can still lose to XLA at some shapes
-    (e.g. very small D makes the per-row DMAs tiny).  Times both engines
-    once on synthetic data at the call's K/D (batch clipped — relative
-    cost is per-row) and caches the verdict."""
+def _pallas_profitable(B: int, K: int, D: int, fused: bool) -> bool:
+    """Deterministic shape-based engine choice (ADVICE r3 medium): every
+    host on a shared mesh must pick the SAME engine for the same jitted
+    step, so the default verdict is a pure function of the call shape —
+    no wall-clock probes whose outcome can differ across hosts/runs.
+
+    Heuristic: the kernel's win is skipping the ``[B·K, D]`` gathered HBM
+    intermediate; its cost is one small DMA per (row, k).  Tiny D makes
+    those DMAs latency-bound (a D<lane-width row can't fill a 128-lane
+    transfer), so require ``D >= DMLC_PALLAS_MIN_D`` (default 64) and a
+    batch tall enough to amortize grid launch (``B >= 64``).
+
+    Opt-in timed auto-tune (``DMLC_EMBED_AUTOTUNE=1``) restores the r3
+    behavior for single-host benchmarking, where cross-host divergence
+    cannot happen and measured truth beats the heuristic."""
+    import os
+    if os.environ.get("DMLC_EMBED_AUTOTUNE", "0") == "1":
+        return _pallas_faster_timed(B, K, D, fused)
+    min_d = int(os.environ.get("DMLC_PALLAS_MIN_D", "64"))
+    return D >= min_d and B >= 64
+
+
+def _pallas_faster_timed(B: int, K: int, D: int, fused: bool) -> bool:
+    """Wall-clock probe per (K, D, fused) — only behind
+    DMLC_EMBED_AUTOTUNE=1 (single-host bench use; nondeterministic across
+    hosts, so never the default on a shared mesh)."""
     key = (K, D, fused)
     hit = _engine_time_cache.get(key)
     if hit is not None:
@@ -110,9 +130,13 @@ def _pallas_faster(B: int, K: int, D: int, fused: bool) -> bool:
 
 def _resolve_engine(engine: str, D: int, fused: bool = False,
                     B: int = 1024, K: int = 32) -> str:
+    import os
+    pinned = os.environ.get("DMLC_EMBED_ENGINE")
+    if pinned:                       # multi-host escape hatch: pin globally
+        engine = pinned
     if engine == "auto":
         if (jax.default_backend() == "tpu" and _pallas_supported(D, fused)
-                and _pallas_faster(B, K, D, fused)):
+                and _pallas_profitable(B, K, D, fused)):
             return "pallas"
         return "xla"
     if engine not in ("xla", "pallas"):

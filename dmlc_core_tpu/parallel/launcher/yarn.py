@@ -9,26 +9,40 @@ with node blacklisting (`ApplicationMaster.java:73-74,535-563`).
 TPU-native expression: no custom AM — we target YARN's stock
 **DistributedShell** application with a generated wrapper script that maps
 the container index onto ``DMLC_TASK_ID``/``DMLC_ROLE`` and exports the
-tracker rendezvous env. Failure handling: the AM's maxNumAttempt policy
-maps onto ``--max-attempts`` (forwarded as ``DMLC_MAX_ATTEMPT``) driving an
-**in-place retry loop** inside the container — the worker restarts with a
-stable task id and an incremented ``DMLC_NUM_ATTEMPT``, which flips the
-rabit client into the tracker's ``recover`` protocol (`tracker.py:279-291`
-analog). Container-*level* replacement (a fresh container with a new id) is
-not supported by stock DistributedShell; a deployment that needs it should
-front this launcher with a custom AM, as the reference does.
+tracker rendezvous env. Failure handling is two-tier:
+
+* **task crash** → the AM's maxNumAttempt policy maps onto
+  ``--max-attempts`` (forwarded as ``DMLC_MAX_ATTEMPT``) driving an
+  **in-place retry loop** inside the container — the worker restarts with
+  a stable task id and an incremented ``DMLC_NUM_ATTEMPT``, which flips
+  the rabit client into the tracker's ``recover`` protocol
+  (`tracker.py:279-291` analog).
+* **node/container death** (the case the reference's Java AM handles by
+  re-requesting containers with node blacklisting,
+  `ApplicationMaster.java:73-74,535-563`) → stock DistributedShell cannot
+  re-request containers inside a running app, so the launcher reacquires
+  at the *application* granularity: when the app finishes FAILED, it
+  queries the RM REST API for diagnostics
+  (``/ws/v1/cluster/apps/{id}``, endpoint from ``DMLC_YARN_RM_HTTP``),
+  logs them, and **resubmits the whole app** — every container is
+  allocated fresh, and YARN's own unhealthy-node tracking keeps the dead
+  node out of the new allocation.  Bounded by ``DMLC_YARN_APP_ATTEMPTS``
+  (default: ``--max-attempts``).  The tracker keeps listening across
+  resubmits, so the fresh cohort re-rendezvouses at a new generation.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import re
 import subprocess
 from typing import Dict, List
 
 from ...utils import DMLCError, log_info
 from .wrapper import write_wrapper_script
 
-__all__ = ["submit_yarn", "build_yarn_command"]
+__all__ = ["submit_yarn", "build_yarn_command", "rm_app_report"]
 
 # CONTAINER_ID ends in _<attempt>_<id>; ids start at 1 and container 1 is
 # the AM itself, so first-allocation task index = id - 2 (the shared
@@ -76,17 +90,74 @@ def build_yarn_command(args, tracker_envs: Dict[str, str]) -> List[str]:
     return cmd
 
 
+_APP_ID_RE = re.compile(r"application_\d+_\d+")
+
+
+def rm_app_report(app_id: str, rm_http: str = "",
+                  timeout: float = 10.0) -> Dict:
+    """Best-effort ResourceManager REST query for one application
+    (``GET {rm}/ws/v1/cluster/apps/{app_id}``) → the ``app`` object
+    (``state``, ``finalStatus``, ``diagnostics``, …), or ``{}`` when the
+    endpoint is unset/unreachable — diagnostics must never turn a launch
+    failure into a launcher crash."""
+    import urllib.request
+    rm = rm_http or os.environ.get("DMLC_YARN_RM_HTTP", "")
+    if not rm or not app_id:
+        return {}
+    url = f"{rm.rstrip('/')}/ws/v1/cluster/apps/{app_id}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode()).get("app", {}) or {}
+    except Exception as e:  # noqa: BLE001 — best-effort telemetry
+        log_info("yarn: RM REST report unavailable (%s: %s)",
+                 type(e).__name__, e)
+        return {}
+
+
 def submit_yarn(args, tracker_envs: Dict[str, str]) -> int:
     cmd = build_yarn_command(args, tracker_envs)
     script = cmd[cmd.index("-shell_script") + 1]
     log_info("yarn%s: %s", " (dry run)" if args.dry_run else "",
              " ".join(cmd))
+    app_attempts = max(1, int(os.environ.get(
+        "DMLC_YARN_APP_ATTEMPTS", str(getattr(args, "max_attempts", 1)))))
     try:
         if args.dry_run:
             with open(script) as f:
                 log_info("yarn wrapper script:\n%s", f.read())
             return 0
-        return subprocess.call(cmd)
+        rc = 1
+        for attempt in range(1, app_attempts + 1):
+            # line-streaming tee: a training app runs for hours and the
+            # client prints continuous AM progress — the operator must see
+            # it live, and only the application id needs capturing
+            app_id = ""
+            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                print(line, end="", flush=True)
+                if not app_id:
+                    m = _APP_ID_RE.search(line)
+                    if m:
+                        app_id = m.group(0)
+            rc = proc.wait()
+            if rc == 0:
+                return 0
+            report = rm_app_report(app_id)
+            if report:
+                log_info("yarn: %s finished %s/%s: %s", app_id,
+                         report.get("state"), report.get("finalStatus"),
+                         (report.get("diagnostics") or "").strip()[:500])
+            if attempt < app_attempts:
+                # application-level reacquire: a fresh submission allocates
+                # every container anew (the app-granularity analog of the
+                # reference AM's container re-request; YARN itself keeps
+                # unhealthy nodes out of the new allocation)
+                log_info("yarn: app failed (rc %d) — resubmitting with "
+                         "fresh containers (attempt %d/%d)",
+                         rc, attempt + 1, app_attempts)
+        return rc
     except FileNotFoundError as e:
         raise DMLCError(
             f"yarn submit needs the hadoop CLI on PATH (or HADOOP_HOME): {e}"

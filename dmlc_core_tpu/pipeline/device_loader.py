@@ -472,7 +472,16 @@ class DeviceLoader:
         carry = None
         for blk in self._blocks():
             for piece in batch_slices(blk, self.batch_rows):
-                if piece.size == self.batch_rows:
+                if carry is not None and carry.rows > 0:
+                    # a pending partial tail: EVERY subsequent piece must
+                    # route through the carry until it drains, or batches
+                    # would leave in permuted row order (full slices
+                    # jumping ahead of carried rows — breaks the one-
+                    # score-per-row alignment predict depends on)
+                    full = carry.add(piece)
+                    if full is not None:
+                        yield self._pack_host(full, fused)
+                elif piece.size == self.batch_rows:
                     yield self._pack_host(piece, fused)
                 else:
                     # merge leftovers across source blocks

@@ -84,13 +84,14 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
     try:
         while not max_epochs or served < max_epochs:
             conn, addr = srv.accept()
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            from .device_loader import DeviceLoader
-            loader = DeviceLoader(
-                create_parser(uri, part, nparts, fmt),
-                batch_rows=batch_rows, nnz_cap=nnz_cap,
-                id_mod=id_mod, wire_compact=wire_compact, emit="host")
+            loader = None
             try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                from .device_loader import DeviceLoader
+                loader = DeviceLoader(
+                    create_parser(uri, part, nparts, fmt),
+                    batch_rows=batch_rows, nnz_cap=nnz_cap,
+                    id_mod=id_mod, wire_compact=wire_compact, emit="host")
                 for item in loader:
                     kind, buf, meta, rows = item
                     check(kind == "fused", "host emit must be fused")
@@ -105,11 +106,13 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                     loader.recycle(buf)
                 _send_all(conn, _FRAME.pack(0, 0, 0))      # end of stream
             except Exception as e:  # noqa: BLE001 — a server: one bad
-                # connection (trainer vanished, parse error, send failure)
-                # must never take down the listener for the next epoch
+                # connection (trainer vanished, parse/IO error — including
+                # while CONSTRUCTING the loader) must never take down the
+                # listener for the next epoch
                 log_info("ingest worker: connection ended early: %r", e)
             finally:
-                loader.close()
+                if loader is not None:
+                    loader.close()
                 conn.close()
             served += 1
     finally:

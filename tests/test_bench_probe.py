@@ -62,3 +62,29 @@ def test_probe_flow_pinned_by_env(bench_mod, capfd, monkeypatch):
     assert "config probe:" not in err       # single pinned combo, no probe
     assert (pt, cm, rows) == (1, False, 8192)
     assert mean > 0
+
+
+def test_suite_hang_isolation(tmp_path):
+    """A wedged config child (simulated 1h sleep — the r3 tunnel wedge) is
+    killed by the per-config timeout and the NEXT config still runs and
+    lands in the artifact (VERDICT r3 #6)."""
+    import json
+    import subprocess
+
+    out = tmp_path / "suite.json"
+    env = {**os.environ, "DMLC_SUITE_TEST_HANG": "1",
+           "DMLC_SUITE_CONFIG_TIMEOUT": "10",
+           "DMLC_BENCH_SUITE_OUT": str(out),
+           "DMLC_BENCH_MB": "2", "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO}
+    env.pop("DMLC_REQUIRE_TPU", None)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "bench_suite.py"),
+         "_hang", "stream"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-2000:]
+    data = json.loads(out.read_text())
+    assert len(data["results"]) == 2
+    hang, stream = data["results"]
+    assert hang["metric"] == "_hang" and "timeout" in hang["error"]
+    assert "error" not in stream and stream.get("unit") == "MB/s"

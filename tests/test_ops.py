@@ -78,15 +78,46 @@ def test_pallas_embed_bag_interpret_matches_reference():
     np.testing.assert_allclose(out, ref, rtol=1e-5)
 
 
+def test_engine_dispatch_deterministic(monkeypatch):
+    """Default dispatch is a pure function of shape (ADVICE r3: every host
+    on a shared mesh must pick the same engine): no timing, threshold on D
+    and B, env-tunable."""
+    from dmlc_core_tpu.ops import pallas_embed as pe
+
+    monkeypatch.delenv("DMLC_EMBED_AUTOTUNE", raising=False)
+    monkeypatch.delenv("DMLC_PALLAS_MIN_D", raising=False)
+    assert pe._pallas_profitable(1024, 32, 64, fused=False) is True
+    assert pe._pallas_profitable(1024, 32, 8, fused=False) is False   # tiny D
+    assert pe._pallas_profitable(8, 32, 512, fused=False) is False    # tiny B
+    monkeypatch.setenv("DMLC_PALLAS_MIN_D", "256")
+    assert pe._pallas_profitable(1024, 32, 64, fused=False) is False
+    # same inputs, same verdict — repeat-call determinism
+    assert pe._pallas_profitable(1024, 32, 64, fused=False) is False
+
+
+def test_engine_env_pin(monkeypatch):
+    """DMLC_EMBED_ENGINE pins the engine regardless of auto heuristics —
+    the multi-host escape hatch."""
+    from dmlc_core_tpu.ops import pallas_embed as pe
+
+    monkeypatch.setenv("DMLC_EMBED_ENGINE", "xla")
+    assert pe._resolve_engine("auto", 512) == "xla"
+    assert pe._resolve_engine("pallas", 512) == "xla"   # pin beats explicit
+    monkeypatch.setenv("DMLC_EMBED_ENGINE", "bogus")
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        pe._resolve_engine("auto", 512)
+
+
 def test_engine_autotune_logic(monkeypatch):
-    """_pallas_faster: picks by measured time, caches per shape, and a
-    kernel failure degrades to XLA instead of raising — exercised on CPU
-    since the real gate only opens on TPU."""
+    """Opt-in timed autotune (DMLC_EMBED_AUTOTUNE=1): picks by measured
+    time, caches per shape, and a kernel failure degrades to XLA instead of
+    raising — exercised on CPU since the real gate only opens on TPU."""
     from dmlc_core_tpu.ops import pallas_embed as pe
 
     pe._engine_time_cache.clear()
     # kernel raises (CPU without interpret) → False, no exception
-    assert pe._pallas_faster(64, 4, 8, fused=False) is False
+    assert pe._pallas_faster_timed(64, 4, 8, fused=False) is False
     assert pe._engine_time_cache[(4, 8, False)] is False
 
     # substitute engines with controllable speeds: pallas wins.  The slow
@@ -105,8 +136,11 @@ def test_engine_autotune_logic(monkeypatch):
     monkeypatch.setattr(pe, "embed_bag_pallas", fast)
     monkeypatch.setattr(pe, "embed_bag_reference", slow)
     pe._engine_time_cache.clear()
-    assert pe._pallas_faster(64, 5, 8, fused=False) is True
+    assert pe._pallas_faster_timed(64, 5, 8, fused=False) is True
     # cached: flipping the implementations does not change the verdict
     monkeypatch.setattr(pe, "embed_bag_pallas", slow)
-    assert pe._pallas_faster(64, 5, 8, fused=False) is True
+    assert pe._pallas_faster_timed(64, 5, 8, fused=False) is True
+    # DMLC_EMBED_AUTOTUNE=1 routes _pallas_profitable through the timer
+    monkeypatch.setenv("DMLC_EMBED_AUTOTUNE", "1")
+    assert pe._pallas_profitable(64, 5, 8, fused=False) is True
     pe._engine_time_cache.clear()

@@ -415,3 +415,34 @@ def test_wire_compact_with_transfer_pool(libsvm_file):
                       put_threads=4) as loader:
         pooled = [{k: np.asarray(v) for k, v in b.items()} for b in loader]
     _assert_batches_equal(plain, pooled)
+
+
+def test_python_pack_preserves_row_order_across_blocks(monkeypatch):
+    """Cross-block carry must not permute rows (code-review r4): once a
+    partial tail is pending, later full slices may NOT jump ahead of it —
+    predict's one-score-per-input-row contract depends on batch order ==
+    input order.  Forced onto the python pack path (the native packer
+    streams in order by construction)."""
+    from dmlc_core_tpu import native
+    monkeypatch.setattr(native, "has_packer", lambda: False)
+
+    # blocks sized so tails interleave with full slices: 36-row tail, then
+    # a block large enough to yield full slices while the carry is pending
+    sizes = [100, 200, 37, 64, 99]
+    blocks, label = [], 0
+    for sz in sizes:
+        c = RowBlockContainer()
+        for _ in range(sz):
+            c.push_row(float(label), [label % 50], [1.0])
+            label += 1
+        blocks.append(c.get_block())
+
+    loader = DeviceLoader(iter(blocks), batch_rows=64, nnz_cap=256)
+    seen = []
+    try:
+        for batch in loader:
+            w = np.asarray(batch["weights"])
+            seen.extend(np.asarray(batch["labels"])[w > 0].tolist())
+    finally:
+        loader.close()
+    assert seen == [float(i) for i in range(sum(sizes))]

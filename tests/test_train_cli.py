@@ -138,6 +138,64 @@ def test_cli_resume_continues_from_checkpoint(libsvm_file, tmp_path):
     assert c.returncode == 2
 
 
+def test_cli_resume_restores_optimizer_state(libsvm_file, tmp_path):
+    """resume must restore Adam moments, not just params (ADVICE r3):
+    the checkpoint carries opt_state, the resumed run reports a clean
+    resume, and a legacy params-only checkpoint resumes with a loud
+    moments-reset warning instead of failing."""
+    ckpt = tmp_path / "ck"
+    common = [f"data={libsvm_file}", "model=fm", "features=64", "dim=4",
+              "batch_rows=128", "nnz_cap=2048", "lr=0.05",
+              f"ckpt_dir={ckpt}", "log_every=0", "eval_auc=0"]
+    assert _run(common).returncode == 0
+    # the saved state itself carries opt_state
+    from dmlc_core_tpu.utils import CheckpointManager
+    _, state = CheckpointManager(str(ckpt)).restore()
+    assert "opt_state" in state and "params" in state
+    b = _run(common + ["resume=1"])
+    assert b.returncode == 0, b.stderr[-2000:]
+    assert "resumed from step" in b.stdout
+    assert "moments reset" not in b.stdout
+
+    # legacy params-only checkpoint: resumes, warns, still trains
+    legacy = tmp_path / "ck_legacy"
+    mgr = CheckpointManager(str(legacy))
+    mgr.save(7, {"params": state["params"]}, meta={"model": "fm"})
+    c = _run([f"data={libsvm_file}", "model=fm", "features=64", "dim=4",
+              "batch_rows=128", "nnz_cap=2048", "lr=0.05",
+              f"ckpt_dir={legacy}", "log_every=0", "eval_auc=0",
+              "resume=1"])
+    assert c.returncode == 0, c.stderr[-2000:]
+    assert "moments reset" in c.stdout
+
+
+def test_cli_predict_keeps_weight_zero_rows(libsvm_file, tmp_path):
+    """Predict output is one score per INPUT row: rows with an explicit
+    weight of 0 (libsvm 'label:weight' head) must not be dropped — padding
+    is identified by row count, not by weight (ADVICE r3)."""
+    rng = np.random.default_rng(5)
+    path = tmp_path / "w0.libsvm"
+    nrows = 137                       # not a batch multiple → padded tail
+    with open(path, "w") as f:
+        for i in range(nrows):
+            idx = np.sort(rng.choice(50, size=4, replace=False))
+            x = rng.random(4)
+            w = 0 if i % 3 == 0 else 1   # a third of rows weigh 0
+            f.write(f"{i % 2}:{w} " + " ".join(
+                f"{j}:{v:.4f}" for j, v in zip(idx, x)) + "\n")
+    ckpt = tmp_path / "ck"
+    assert _run([f"data={libsvm_file}", "model=logreg", "features=64",
+                 "batch_rows=64", "nnz_cap=1024", f"ckpt_dir={ckpt}",
+                 "log_every=0", "eval_auc=0"]).returncode == 0
+    pred = tmp_path / "scores.txt"
+    out = _run([f"data={path}", "mode=predict", "model=logreg",
+                "features=64", "batch_rows=64", "nnz_cap=1024",
+                f"ckpt_dir={ckpt}", f"output=file://{pred}"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    scores = pred.read_text().split()
+    assert len(scores) == nrows, (len(scores), nrows)
+
+
 def test_cli_predict_mode_roundtrip(libsvm_file, tmp_path):
     """train → checkpoint → predict: one score per row, informative AUC,
     and a model-name mismatch against the checkpoint meta fails loudly."""
