@@ -191,6 +191,9 @@ def _register_text_format(fmt: str, description: str) -> None:
         split = create_input_split(uri, part_index, num_parts, "text")
         parser: ParserBase = TextParser(
             split, _make_kernel(fmt, extra, nthreads), nthreads)
+        # the concrete text format, for consumers that can fuse parse+pack
+        # natively (DeviceLoader._use_streampack)
+        parser.text_format = fmt
         if threaded:
             parser = ThreadedParser(parser)
         return parser

@@ -98,6 +98,28 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.dmlc_packer2_set_compact.argtypes = [ctypes.c_void_p,
                                                      ctypes.c_int32]
             lib.dmlc_packer2_set_compact.restype = None
+        if hasattr(lib, "dmlc_sppack_create"):
+            lib.dmlc_sppack_create.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_uint64]
+            lib.dmlc_sppack_create.restype = ctypes.c_void_p
+            lib.dmlc_sppack_destroy.argtypes = [ctypes.c_void_p]
+            lib.dmlc_sppack_destroy.restype = None
+            lib.dmlc_sppack_set_compact.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_int32]
+            lib.dmlc_sppack_set_compact.restype = None
+            lib.dmlc_sppack_feed_libsvm.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.dmlc_sppack_feed_libsvm.restype = ctypes.c_int32
+            lib.dmlc_sppack_flush.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.dmlc_sppack_flush.restype = ctypes.c_int64
+            lib.dmlc_sppack_stats.argtypes = [ctypes.c_void_p] + \
+                [ctypes.POINTER(ctypes.c_int64)] * 5
+            lib.dmlc_sppack_stats.restype = None
         _lib = lib
         return _lib
 
@@ -112,6 +134,13 @@ def has_compact() -> bool:
     """True when the loaded library supports the v3 compact wire layout."""
     lib = _load()
     return lib is not None and hasattr(lib, "dmlc_packer2_set_compact")
+
+
+def has_sppack() -> bool:
+    """True when the loaded library carries the fused streaming
+    parse→pack ABI (libsvm text → wire batches in one pass)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "dmlc_sppack_create")
 
 
 def available() -> bool:
@@ -343,3 +372,93 @@ class Packer:
         self._lib.dmlc_packer2_stats(self._p, *[ctypes.byref(v) for v in vals])
         return {"rows": vals[0].value, "padded_rows": vals[1].value,
                 "truncated_values": vals[2].value, "batches": vals[3].value}
+
+
+class SpPacker:
+    """Fused streaming parse→pack: libsvm text chunks → fused wire batches
+    in ONE native pass (``SpPackC`` in dmlc_native.cpp), skipping the CSR
+    RowBlock the two-stage (``parse_libsvm`` → :class:`Packer`) path
+    materialises in between.  Same wire layouts and meta contract as
+    :class:`Packer`; a partial batch carries across chunks until
+    :meth:`flush`.  Row/batch semantics are equivalence-tested against the
+    two-stage path (tests/test_pipeline.py)."""
+
+    def __init__(self, batch_rows: int, nnz_cap: int, id_mod: int = 0,
+                 quantum: int = 0, compact: bool = False):
+        lib = _load()
+        if lib is None or not hasattr(lib, "dmlc_sppack_create"):
+            raise RuntimeError("native sppack unavailable (stale library?)")
+        self._lib = lib
+        if quantum <= 0:
+            quantum = max(1, nnz_cap // 8)
+        self._p = lib.dmlc_sppack_create(batch_rows, nnz_cap, quantum,
+                                         id_mod)
+        if not self._p:
+            raise MemoryError("dmlc_sppack_create failed")
+        if compact:
+            lib.dmlc_sppack_set_compact(self._p, 1)
+        self.batch_rows = batch_rows
+        self.nnz_cap = nnz_cap
+        self.words_max = fused_words(batch_rows, nnz_cap)
+
+    def close(self) -> None:
+        if self._p:
+            self._lib.dmlc_sppack_destroy(self._p)
+            self._p = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def feed_text(self, chunk: bytes, get_buf=None, put_buf=None):
+        """Yield ``(buf, meta)`` fused batches parsed from one record-
+        aligned text chunk.  Buffer pool contract as :meth:`Packer.feed`."""
+        if get_buf is None:
+            get_buf = lambda words: np.empty(words, np.int32)  # noqa: E731
+        pos = ctypes.c_int64(0)
+        meta = ctypes.c_int64(0)
+        view = _buf_view(chunk)          # zero-copy for mmap memoryviews
+        addr = ctypes.c_char_p(view.ctypes.data)
+        n = len(view)
+        buf = None
+        try:
+            while True:
+                if buf is None:
+                    buf = get_buf(self.words_max)
+                rc = self._lib.dmlc_sppack_feed_libsvm(
+                    self._p, addr, n, ctypes.byref(pos), buf.ctypes.data,
+                    ctypes.byref(meta))
+                if rc == -2:
+                    raise IdOverflowError(
+                        f"feature id > 2^31-1 near text offset {pos.value} "
+                        f"— pass id_mod (feature hashing) or keep ids below "
+                        f"int32 range")
+                if rc < 0:
+                    raise RuntimeError(f"dmlc_sppack_feed error {rc}")
+                if rc == 0:
+                    break
+                out, buf = buf, None
+                yield out, int(meta.value)
+        finally:
+            if buf is not None and put_buf is not None:
+                put_buf(buf)
+
+    def flush(self, get_buf=None):
+        """Emit the final partial batch as ``(buf, meta)`` (padded), or
+        None when empty."""
+        if get_buf is None:
+            get_buf = lambda words: np.empty(words, np.int32)  # noqa: E731
+        buf = get_buf(self.words_max)
+        meta = ctypes.c_int64(0)
+        rows = self._lib.dmlc_sppack_flush(self._p, buf.ctypes.data,
+                                           ctypes.byref(meta))
+        return (buf, int(meta.value)) if rows > 0 else None
+
+    def stats(self) -> Dict[str, int]:
+        vals = [ctypes.c_int64(0) for _ in range(5)]
+        self._lib.dmlc_sppack_stats(self._p, *[ctypes.byref(v) for v in vals])
+        return {"rows": vals[0].value, "padded_rows": vals[1].value,
+                "truncated_values": vals[2].value, "batches": vals[3].value,
+                "bad_lines": vals[4].value}

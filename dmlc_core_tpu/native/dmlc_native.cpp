@@ -846,9 +846,172 @@ struct PackerC {
   }
 };
 
+// ---------------- fused streaming parse→pack (libsvm) ----------------
+//
+// One pass: text chunk → fused wire batches, no CSR block in between.  The
+// two-stage path materialises every value three times (ThreadBlock scratch
+// → adopted CSR arrays → packer staging); on a serial ingest host those
+// extra passes are the measured difference between ~340 and ~400 MB/s of
+// text rate (BENCH_capacity: parse_only vs pack_null).  InputSplit chunks
+// are record-aligned (io/input_split.py byte-range realign), so rows never
+// span a feed call and no cross-chunk carry is needed.
+//
+// Row semantics mirror parse_sparse_range(kLibSVM) exactly — label[:weight]
+// head, value-less tokens ⇒ 1.0, a bad token keeps the values parsed so
+// far and counts the line bad — and batch-close semantics mirror
+// dmlc_packer2_feed (close on batch_rows or nnz pressure; single rows
+// wider than nnz_cap truncated and counted).  Equivalence is pinned by
+// tests/test_pipeline.py::test_streampack_matches_two_stage.
+
+struct SpPackC {
+  PackerC packer;
+  raw_vector<int32_t> row_ids;   // one parsed row, pre-hash, pre-close
+  raw_vector<float> row_vals;
+  int64_t bad_lines = 0;
+  bool lone_cr = false;  // cached per chunk (pos==0) — recomputing on every
+                         // resumed feed call would rescan the chunk tail
+                         // once per emitted batch
+  SpPackC(int64_t rows, int64_t nnz, int64_t quant, uint64_t mod)
+      : packer(rows, nnz, quant, mod) {
+    row_ids.resize(static_cast<size_t>(nnz));
+    row_vals.resize(static_cast<size_t>(nnz));
+  }
+};
+
 }  // namespace
 
 extern "C" {
+
+void* dmlc_sppack_create(int64_t batch_rows, int64_t nnz_cap,
+                         int64_t quantum, uint64_t id_mod) {
+  if (batch_rows <= 0 || nnz_cap <= 0) return nullptr;
+  return new (std::nothrow) SpPackC(batch_rows, nnz_cap, quantum, id_mod);
+}
+
+void dmlc_sppack_destroy(void* p) { delete static_cast<SpPackC*>(p); }
+
+void dmlc_sppack_set_compact(void* p, int32_t on) {
+  static_cast<SpPackC*>(p)->packer.compact = on != 0;
+}
+
+// Parse libsvm text from data+*pos.  Returns 1 when a batch was emitted
+// into out_buf (*out_meta = emit meta) — call again with the SAME data to
+// continue; 0 when the text is exhausted (partial batch retained across
+// calls/chunks); -2 on a feature id above int32 range with no id_mod.
+int32_t dmlc_sppack_feed_libsvm(void* vp, const char* data, int64_t len,
+                                int64_t* pos, int32_t* out_buf,
+                                int64_t* out_meta) {
+  SpPackC* s = static_cast<SpPackC*>(vp);
+  PackerC* p = &s->packer;
+  const char* cur = data + *pos;
+  const char* end = data + len;
+  if (*pos == 0) s->lone_cr = has_lone_cr(cur, end);
+  const bool lone_cr = s->lone_cr;
+  int32_t* rid = s->row_ids.data();
+  float* rvl = s->row_vals.data();
+  while (cur < end) {
+    while (cur < end && is_eol(*cur)) ++cur;
+    if (cur >= end) break;
+    const char* line_end = line_end_of(cur, end, lone_cr);
+    const char* P = cur;
+    while (P < line_end && is_space(*P)) ++P;
+    float label = 0.f, weight = 1.f;
+    int n = parse_float(P, line_end, &label);
+    if (n == 0) {  // empty/garbage line: skip
+      const char* q = P;
+      while (q < line_end && is_space(*q)) ++q;
+      if (q != line_end) ++s->bad_lines;
+      cur = line_end;
+      continue;
+    }
+    P += n;
+    if (P < line_end && *P == ':') {  // label:weight head
+      ++P;
+      n = parse_float(P, line_end, &weight);
+      if (n == 0) {  // 'label:garbage' — drop the whole row
+        ++s->bad_lines;
+        cur = line_end;
+        continue;
+      }
+      P += n;
+    }
+    int64_t k = 0;
+    uint32_t om = 0;
+    while (P < line_end) {
+      while (P < line_end && is_space(*P)) ++P;
+      if (P >= line_end) break;
+      uint64_t a = 0;
+      n = parse_uint64(P, line_end, &a);
+      if (n == 0) { ++s->bad_lines; break; }
+      P += n;
+      float v = 1.0f;  // value-less token 'idx' ⇒ implicit 1.0
+      if (P < line_end && *P == ':') {
+        ++P;
+        n = parse_float(P, line_end, &v);
+        if (n == 0) { ++s->bad_lines; break; }
+        P += n;
+      }
+      if (k < p->nnz_cap) {
+        uint32_t id;
+        if (p->id_mod) {
+          id = static_cast<uint32_t>(a % p->id_mod);
+        } else {
+          if (a > 0x7fffffffULL) { *pos = cur - data; return -2; }
+          id = static_cast<uint32_t>(a);
+        }
+        rid[k] = static_cast<int32_t>(id);
+        rvl[k] = v;
+        om |= id;
+        ++k;
+      } else {
+        // single row wider than a whole batch: tail values are dropped —
+        // including any oversized ids in them, matching dmlc_packer2_feed
+        // (which truncates k BEFORE its overflow scan)
+        ++p->truncated_values;
+      }
+    }
+    const bool close =
+        p->row_count == p->batch_rows || p->nnz_count + k > p->nnz_cap;
+    if (close) *out_meta = p->emit(out_buf);
+    std::memcpy(p->ids_s.data() + p->nnz_count, rid, k * 4);
+    std::memcpy(reinterpret_cast<float*>(p->vals_s.data()) + p->nnz_count,
+                rvl, k * 4);
+    p->ormask |= om;
+    reinterpret_cast<float*>(p->labs_s.data())[p->row_count] = label;
+    reinterpret_cast<float*>(p->wgts_s.data())[p->row_count] = weight;
+    ++p->row_count;
+    p->nnz_count += k;
+    p->rp_s[p->row_count] = static_cast<int32_t>(p->nnz_count);
+    cur = line_end;
+    if (close) {
+      *pos = cur - data;
+      return 1;
+    }
+  }
+  *pos = end - data;
+  return 0;
+}
+
+int64_t dmlc_sppack_flush(void* vp, int32_t* out_buf, int64_t* out_meta) {
+  PackerC* p = &static_cast<SpPackC*>(vp)->packer;
+  const int64_t rows = p->row_count;
+  if (rows == 0) return 0;
+  *out_meta = p->emit(out_buf);
+  return rows;
+}
+
+void dmlc_sppack_stats(void* vp, int64_t* rows, int64_t* padded_rows,
+                       int64_t* truncated_values, int64_t* batches,
+                       int64_t* bad_lines) {
+  SpPackC* s = static_cast<SpPackC*>(vp);
+  // pending partial-batch rows count as parsed rows (the two-stage path
+  // counts rows at parse time; stats must agree mid-stream)
+  *rows = s->packer.total_rows + s->packer.row_count;
+  *padded_rows = s->packer.padded_rows;
+  *truncated_values = s->packer.truncated_values;
+  *batches = s->packer.batches;
+  *bad_lines = s->bad_lines;
+}
 
 void* dmlc_packer2_create(int64_t batch_rows, int64_t nnz_cap,
                           int64_t quantum, uint64_t id_mod) {

@@ -446,3 +446,59 @@ def test_python_pack_preserves_row_order_across_blocks(monkeypatch):
     finally:
         loader.close()
     assert seen == [float(i) for i in range(sum(sizes))]
+
+
+def test_streampack_matches_two_stage(tmp_path, monkeypatch):
+    """The fused native parse→pack fast path (SpPacker: text → wire in one
+    C++ pass) must produce the SAME device batch stream as the two-stage
+    parse→Packer path, on messy input (label:weight heads, implicit-1.0
+    tokens, blank/bad lines) across multiple chunks and both wire
+    layouts."""
+    from dmlc_core_tpu import native
+    if not native.has_sppack():
+        pytest.skip("native sppack not built")
+
+    rng = np.random.default_rng(11)
+    path = tmp_path / "m.libsvm"
+    with open(path, "w") as f:
+        for i in range(4000):
+            n = int(rng.integers(1, 10))
+            idx = np.sort(rng.choice(50_000, size=n, replace=False))
+            toks = [f"{j}" if rng.random() < 0.3 else
+                    f"{j}:{rng.random():.4f}" for j in idx]
+            head = f"{i % 2}" if i % 5 else f"{i % 2}:{rng.random():.2f}"
+            f.write(head + " " + " ".join(toks) + "\n")
+            if i == 777:
+                f.write("\n")            # blank line
+            if i == 1234:
+                f.write("1 5:xx 9:1\n")  # bad token mid-row
+
+    from dmlc_core_tpu.data import create_parser
+
+    def collect(streampack: bool, compact: bool):
+        monkeypatch.setenv("DMLC_STREAMPACK", "1" if streampack else "0")
+        loader = DeviceLoader(
+            create_parser(f"file://{path}", 0, 1, "libsvm", nthreads=1,
+                          threaded=False),
+            batch_rows=512, nnz_cap=8192, wire_compact=compact)
+        if streampack:
+            assert loader._use_streampack()
+        else:
+            assert not loader._use_streampack()
+        out = []
+        try:
+            for b in loader:
+                out.append({k: np.asarray(v) for k, v in b.items()})
+        finally:
+            loader.close()
+        return out, loader.stats.rows
+
+    for compact in (False, True):
+        a, rows_a = collect(True, compact)
+        b, rows_b = collect(False, compact)
+        assert rows_a == rows_b
+        assert len(a) == len(b), (compact, len(a), len(b))
+        for i, (x, y) in enumerate(zip(a, b)):
+            assert x.keys() == y.keys()
+            for k in x:
+                np.testing.assert_array_equal(x[k], y[k], err_msg=f"{i}/{k}")
