@@ -164,7 +164,7 @@ def _default_nthreads() -> int:
     return n if n > 1 else 8
 
 
-def _make_kernel(fmt: str, extra: Dict[str, str], nthreads: int) -> Callable[[bytes], Dict]:
+def _make_kernel(fmt: str, nthreads: int, csv_param=None) -> Callable[[bytes], Dict]:
     use_native = native.available()
     if nthreads <= 0:
         nthreads = _default_nthreads()
@@ -175,9 +175,7 @@ def _make_kernel(fmt: str, extra: Dict[str, str], nthreads: int) -> Callable[[by
         return (lambda b: native.parse_libfm(b, nthreads)) if use_native \
             else (lambda b: py_parsers.parse_libfm(b))
     if fmt == "csv":
-        param = CSVParserParam()
-        param.init_allow_unknown(extra)
-        lc, dl = param.label_column, param.delimiter
+        lc, dl = csv_param.label_column, csv_param.delimiter
         return (lambda b: native.parse_csv(b, lc, dl, nthreads)) if use_native \
             else (lambda b: py_parsers.parse_csv(b, lc, dl))
     raise DMLCError(f"no parse kernel for format {fmt!r}")
@@ -189,11 +187,20 @@ def _register_text_format(fmt: str, description: str) -> None:
                 extra: Dict[str, str], nthreads: int = 0,
                 threaded: bool = True) -> ParserBase:
         split = create_input_split(uri, part_index, num_parts, "text")
+        # parse the csv knobs ONCE: the chunk kernel and the fused
+        # streampack path must read the same values by construction
+        csv_param = None
+        if fmt == "csv":
+            csv_param = CSVParserParam()
+            csv_param.init_allow_unknown(extra)
         parser: ParserBase = TextParser(
-            split, _make_kernel(fmt, extra, nthreads), nthreads)
-        # the concrete text format, for consumers that can fuse parse+pack
-        # natively (DeviceLoader._use_streampack)
+            split, _make_kernel(fmt, nthreads, csv_param), nthreads)
+        # the concrete text format (+csv knobs), for consumers that can
+        # fuse parse+pack natively (DeviceLoader._use_streampack)
         parser.text_format = fmt
+        if csv_param is not None:
+            parser.csv_label_col = csv_param.label_column
+            parser.csv_delim = csv_param.delimiter
         if threaded:
             parser = ThreadedParser(parser)
         return parser

@@ -98,7 +98,11 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.dmlc_packer2_set_compact.argtypes = [ctypes.c_void_p,
                                                      ctypes.c_int32]
             lib.dmlc_packer2_set_compact.restype = None
-        if hasattr(lib, "dmlc_sppack_create"):
+        # the sppack ABI is all-or-nothing: a stale .so from before the
+        # libfm/csv feeds (no compiler to rebuild) must degrade to the
+        # two-stage path for every format, not crash _load() — so the gate
+        # requires the NEWEST symbol of the set
+        if hasattr(lib, "dmlc_sppack_feed_csv"):
             lib.dmlc_sppack_create.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_uint64]
@@ -108,11 +112,19 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.dmlc_sppack_set_compact.argtypes = [ctypes.c_void_p,
                                                     ctypes.c_int32]
             lib.dmlc_sppack_set_compact.restype = None
-            lib.dmlc_sppack_feed_libsvm.argtypes = [
+            for nm in ("dmlc_sppack_feed_libsvm", "dmlc_sppack_feed_libfm"):
+                fn = getattr(lib, nm)
+                fn.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_int64)]
+                fn.restype = ctypes.c_int32
+            lib.dmlc_sppack_feed_csv.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_char,
                 ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
                 ctypes.POINTER(ctypes.c_int64)]
-            lib.dmlc_sppack_feed_libsvm.restype = ctypes.c_int32
+            lib.dmlc_sppack_feed_csv.restype = ctypes.c_int32
             lib.dmlc_sppack_flush.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.POINTER(ctypes.c_int64)]
@@ -137,10 +149,11 @@ def has_compact() -> bool:
 
 
 def has_sppack() -> bool:
-    """True when the loaded library carries the fused streaming
-    parse→pack ABI (libsvm text → wire batches in one pass)."""
+    """True when the loaded library carries the COMPLETE fused streaming
+    parse→pack ABI (libsvm/libfm/csv text → wire batches in one pass);
+    a stale partial .so reports False and every format stays two-stage."""
     lib = _load()
-    return lib is not None and hasattr(lib, "dmlc_sppack_create")
+    return lib is not None and hasattr(lib, "dmlc_sppack_feed_csv")
 
 
 def available() -> bool:
@@ -383,11 +396,17 @@ class SpPacker:
     :meth:`flush`.  Row/batch semantics are equivalence-tested against the
     two-stage path (tests/test_pipeline.py)."""
 
+    FORMATS = ("libsvm", "libfm", "csv")
+
     def __init__(self, batch_rows: int, nnz_cap: int, id_mod: int = 0,
-                 quantum: int = 0, compact: bool = False):
+                 quantum: int = 0, compact: bool = False,
+                 fmt: str = "libsvm", label_col: int = -1,
+                 delim: str = ","):
         lib = _load()
-        if lib is None or not hasattr(lib, "dmlc_sppack_create"):
+        if lib is None or not hasattr(lib, "dmlc_sppack_feed_csv"):
             raise RuntimeError("native sppack unavailable (stale library?)")
+        if fmt not in self.FORMATS:
+            raise ValueError(f"sppack format {fmt!r} not in {self.FORMATS}")
         self._lib = lib
         if quantum <= 0:
             quantum = max(1, nnz_cap // 8)
@@ -400,6 +419,15 @@ class SpPacker:
         self.batch_rows = batch_rows
         self.nnz_cap = nnz_cap
         self.words_max = fused_words(batch_rows, nnz_cap)
+        if fmt == "csv":
+            d = delim.encode()[:1] or b","
+            self._feed = lambda p, d_, n, pos, buf, meta: \
+                lib.dmlc_sppack_feed_csv(p, d_, n, label_col, d, pos, buf,
+                                         meta)
+        elif fmt == "libfm":
+            self._feed = lib.dmlc_sppack_feed_libfm
+        else:
+            self._feed = lib.dmlc_sppack_feed_libsvm
 
     def close(self) -> None:
         if self._p:
@@ -427,7 +455,7 @@ class SpPacker:
             while True:
                 if buf is None:
                     buf = get_buf(self.words_max)
-                rc = self._lib.dmlc_sppack_feed_libsvm(
+                rc = self._feed(
                     self._p, addr, n, ctypes.byref(pos), buf.ctypes.data,
                     ctypes.byref(meta))
                 if rc == -2:

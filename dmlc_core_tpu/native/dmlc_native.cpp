@@ -894,14 +894,39 @@ void dmlc_sppack_set_compact(void* p, int32_t on) {
   static_cast<SpPackC*>(p)->packer.compact = on != 0;
 }
 
-// Parse libsvm text from data+*pos.  Returns 1 when a batch was emitted
+}  // extern "C" — the sparse feed core below is a C++ template
+
+namespace {
+
+// append one parsed row to the packer staging, emitting first when the
+// batch is full.  Returns true when a batch left via out_buf.
+inline bool sppack_push_row(PackerC* p, const int32_t* rid, const float* rvl,
+                            int64_t k, uint32_t om, float label, float weight,
+                            int32_t* out_buf, int64_t* out_meta) {
+  const bool close =
+      p->row_count == p->batch_rows || p->nnz_count + k > p->nnz_cap;
+  if (close) *out_meta = p->emit(out_buf);
+  std::memcpy(p->ids_s.data() + p->nnz_count, rid, k * 4);
+  std::memcpy(reinterpret_cast<float*>(p->vals_s.data()) + p->nnz_count,
+              rvl, k * 4);
+  p->ormask |= om;
+  reinterpret_cast<float*>(p->labs_s.data())[p->row_count] = label;
+  reinterpret_cast<float*>(p->wgts_s.data())[p->row_count] = weight;
+  ++p->row_count;
+  p->nnz_count += k;
+  p->rp_s[p->row_count] = static_cast<int32_t>(p->nnz_count);
+  return close;
+}
+
+// Sparse-format streaming feed core (libsvm / libfm): parse text rows from
+// data+*pos straight into the packer.  Returns 1 when a batch was emitted
 // into out_buf (*out_meta = emit meta) — call again with the SAME data to
 // continue; 0 when the text is exhausted (partial batch retained across
 // calls/chunks); -2 on a feature id above int32 range with no id_mod.
-int32_t dmlc_sppack_feed_libsvm(void* vp, const char* data, int64_t len,
-                                int64_t* pos, int32_t* out_buf,
-                                int64_t* out_meta) {
-  SpPackC* s = static_cast<SpPackC*>(vp);
+template <Fmt F>
+int32_t sppack_feed_sparse(SpPackC* s, const char* data, int64_t len,
+                           int64_t* pos, int32_t* out_buf,
+                           int64_t* out_meta) {
   PackerC* p = &s->packer;
   const char* cur = data + *pos;
   const char* end = data + len;
@@ -944,12 +969,29 @@ int32_t dmlc_sppack_feed_libsvm(void* vp, const char* data, int64_t len,
       n = parse_uint64(P, line_end, &a);
       if (n == 0) { ++s->bad_lines; break; }
       P += n;
-      float v = 1.0f;  // value-less token 'idx' ⇒ implicit 1.0
-      if (P < line_end && *P == ':') {
+      float v = 1.0f;
+      if (F == Fmt::kLibFM) {
+        // field:idx:val — the fused wire carries no field region (the
+        // loader's fields=False path; FFM uses the two-stage pack), so
+        // the field id is validated and dropped
+        if (P >= line_end || *P != ':') { ++s->bad_lines; break; }
+        ++P;
+        n = parse_uint64(P, line_end, &a);  // a = idx now
+        if (n == 0) { ++s->bad_lines; break; }
+        P += n;
+        if (P >= line_end || *P != ':') { ++s->bad_lines; break; }
         ++P;
         n = parse_float(P, line_end, &v);
         if (n == 0) { ++s->bad_lines; break; }
         P += n;
+      } else {
+        // libsvm: value-less token 'idx' ⇒ implicit 1.0
+        if (P < line_end && *P == ':') {
+          ++P;
+          n = parse_float(P, line_end, &v);
+          if (n == 0) { ++s->bad_lines; break; }
+          P += n;
+        }
       }
       if (k < p->nnz_cap) {
         uint32_t id;
@@ -970,18 +1012,97 @@ int32_t dmlc_sppack_feed_libsvm(void* vp, const char* data, int64_t len,
         ++p->truncated_values;
       }
     }
-    const bool close =
-        p->row_count == p->batch_rows || p->nnz_count + k > p->nnz_cap;
-    if (close) *out_meta = p->emit(out_buf);
-    std::memcpy(p->ids_s.data() + p->nnz_count, rid, k * 4);
-    std::memcpy(reinterpret_cast<float*>(p->vals_s.data()) + p->nnz_count,
-                rvl, k * 4);
-    p->ormask |= om;
-    reinterpret_cast<float*>(p->labs_s.data())[p->row_count] = label;
-    reinterpret_cast<float*>(p->wgts_s.data())[p->row_count] = weight;
-    ++p->row_count;
-    p->nnz_count += k;
-    p->rp_s[p->row_count] = static_cast<int32_t>(p->nnz_count);
+    const bool close = sppack_push_row(p, rid, rvl, k, om, label, weight,
+                                       out_buf, out_meta);
+    cur = line_end;
+    if (close) {
+      *pos = cur - data;
+      return 1;
+    }
+  }
+  *pos = end - data;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t dmlc_sppack_feed_libsvm(void* vp, const char* data, int64_t len,
+                                int64_t* pos, int32_t* out_buf,
+                                int64_t* out_meta) {
+  return sppack_feed_sparse<Fmt::kLibSVM>(static_cast<SpPackC*>(vp), data,
+                                          len, pos, out_buf, out_meta);
+}
+
+int32_t dmlc_sppack_feed_libfm(void* vp, const char* data, int64_t len,
+                               int64_t* pos, int32_t* out_buf,
+                               int64_t* out_meta) {
+  return sppack_feed_sparse<Fmt::kLibFM>(static_cast<SpPackC*>(vp), data,
+                                         len, pos, out_buf, out_meta);
+}
+
+// Dense csv rows: every column a value (id = position among value
+// columns), one column (or none: -1) the label; a row with any
+// unparseable cell is dropped whole (parse_csv_range semantics).
+int32_t dmlc_sppack_feed_csv(void* vp, const char* data, int64_t len,
+                             int32_t label_col, char delim, int64_t* pos,
+                             int32_t* out_buf, int64_t* out_meta) {
+  SpPackC* s = static_cast<SpPackC*>(vp);
+  PackerC* p = &s->packer;
+  const char* cur = data + *pos;
+  const char* end = data + len;
+  if (*pos == 0) s->lone_cr = has_lone_cr(cur, end);
+  const bool lone_cr = s->lone_cr;
+  int32_t* rid = s->row_ids.data();
+  float* rvl = s->row_vals.data();
+  while (cur < end) {
+    while (cur < end && is_eol(*cur)) ++cur;
+    if (cur >= end) break;
+    const char* line_end = line_end_of(cur, end, lone_cr);
+    const char* P = cur;
+    float label = 0.f;
+    int64_t col = 0, k = 0;
+    uint32_t om = 0;
+    bool ok = true;
+    while (true) {  // one iteration per field (runs once for empty tail)
+      while (P < line_end && is_space(*P)) ++P;
+      float v = 0.f;
+      int n = parse_float(P, line_end, &v);
+      if (n == 0) {
+        // empty cell parses as 0.0; anything unparseable kills the row
+        if (P < line_end && *P != delim && !is_space(*P)) {
+          ok = false;
+          break;
+        }
+      }
+      P += n;
+      while (P < line_end && is_space(*P)) ++P;
+      if (col == label_col) {
+        label = v;
+      } else if (k < p->nnz_cap) {
+        // column position is the feature id (hashed like any other id)
+        const uint32_t id = p->id_mod
+            ? static_cast<uint32_t>(static_cast<uint64_t>(k) % p->id_mod)
+            : static_cast<uint32_t>(k);
+        rid[k] = static_cast<int32_t>(id);
+        rvl[k] = v;
+        om |= id;
+        ++k;
+      } else {
+        ++p->truncated_values;
+      }
+      ++col;
+      if (P < line_end && *P == delim) { ++P; continue; }
+      break;
+    }
+    if (!ok || P != line_end) {
+      ++s->bad_lines;
+      cur = line_end;
+      continue;
+    }
+    const bool close = sppack_push_row(p, rid, rvl, k, om, label, 1.0f,
+                                       out_buf, out_meta);
     cur = line_end;
     if (close) {
       *pos = cur - data;

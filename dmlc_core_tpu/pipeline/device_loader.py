@@ -464,12 +464,13 @@ class DeviceLoader:
         """Fused native parse→pack: text chunks straight into wire batches,
         never materialising the chunk's CSR block (throughput-neutral on a
         serial host but ~⅓ the peak RSS, and one fewer pipeline stage).
-        Only for an UN-threaded, SINGLE-parse-thread libsvm TextParser
-        source: a ThreadedParser's prefetch thread pulls chunks from the
-        same InputSplit and would race this path, and a parser configured
-        with nthreads>1 gets OpenMP chunk-parallel parsing from the
-        two-stage path that this serial pass would silently forfeit.
-        ``DMLC_STREAMPACK=0`` opts out."""
+        Only for an UN-threaded, SINGLE-parse-thread text source in a
+        SpPacker-supported format (libsvm/libfm/csv): a ThreadedParser's
+        prefetch thread pulls chunks from the same InputSplit and would
+        race this path, and a parser configured with nthreads>1 gets
+        OpenMP chunk-parallel parsing from the two-stage path that this
+        serial pass would silently forfeit.  ``DMLC_STREAMPACK=0`` opts
+        out."""
         import os
 
         from .. import native
@@ -478,7 +479,8 @@ class DeviceLoader:
                 and self._use_native_pack() and native.has_sppack()
                 and type(self.source) is TextParser
                 and getattr(self.source, "nthreads", 0) == 1
-                and getattr(self.source, "text_format", None) == "libsvm")
+                and getattr(self.source, "text_format", None)
+                in native.SpPacker.FORMATS)
 
     def _host_items(self) -> Iterator:
         """Yield host-side items: ('fused', buf, B, rows|None) for the
@@ -550,7 +552,11 @@ class DeviceLoader:
         sp = native.SpPacker(self.batch_rows, self.nnz_cap,
                              id_mod=self.id_mod,
                              compact=(self.wire_compact
-                                      and native.has_compact()))
+                                      and native.has_compact()),
+                             fmt=self.source.text_format,
+                             label_col=getattr(self.source,
+                                               "csv_label_col", -1),
+                             delim=getattr(self.source, "csv_delim", ","))
         rows_seen = 0
         try:
             while True:

@@ -502,3 +502,59 @@ def test_streampack_matches_two_stage(tmp_path, monkeypatch):
             assert x.keys() == y.keys()
             for k in x:
                 np.testing.assert_array_equal(x[k], y[k], err_msg=f"{i}/{k}")
+
+
+@pytest.mark.parametrize("fmt", ["libfm", "csv"])
+def test_streampack_matches_two_stage_other_formats(tmp_path, monkeypatch,
+                                                    fmt):
+    """libfm (field dropped — fused wire carries none) and csv (column
+    position = feature id, bad rows dropped whole) through the fused path
+    must match the two-stage path batch-for-batch."""
+    from dmlc_core_tpu import native
+    if not native.has_sppack():
+        pytest.skip("native sppack not built")
+
+    rng = np.random.default_rng(13)
+    if fmt == "libfm":
+        path = tmp_path / "m.libfm"
+        with open(path, "w") as f:
+            for i in range(2000):
+                n = int(rng.integers(1, 7))
+                ent = " ".join(
+                    f"{int(rng.integers(0, 9))}:{int(rng.integers(0, 9999))}"
+                    f":{rng.random():.3f}" for _ in range(n))
+                f.write(f"{i % 2} {ent}\n")
+            f.write("1 3:5\n")            # malformed libfm token (2-part)
+        uri = f"file://{path}"
+    else:
+        path = tmp_path / "m.csv"
+        with open(path, "w") as f:
+            for i in range(2000):
+                row = rng.random(7)
+                f.write(f"{i % 2}," +
+                        ",".join(f"{v:.4f}" for v in row) + "\n")
+            f.write("1,0.5,oops,0.25,1,2,3,4\n")   # bad cell → row dropped
+            f.write("0,,0.5,,1,2,3,4\n")           # empty cells → 0.0
+        uri = f"file://{path}?label_column=0"
+
+    from dmlc_core_tpu.data import create_parser
+
+    def collect(streampack: bool):
+        monkeypatch.setenv("DMLC_STREAMPACK", "1" if streampack else "0")
+        loader = DeviceLoader(
+            create_parser(uri, 0, 1, fmt, nthreads=1, threaded=False),
+            batch_rows=256, nnz_cap=4096)
+        assert loader._use_streampack() == streampack
+        out = []
+        try:
+            for b in loader:
+                out.append({k: np.asarray(v) for k, v in b.items()})
+        finally:
+            loader.close()
+        return out
+
+    a, b = collect(True), collect(False)
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k], err_msg=f"{i}/{k}")
