@@ -30,6 +30,7 @@ stream, not a deterministic sequence (document-level parity with
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -88,8 +89,25 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
             try:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 from .device_loader import DeviceLoader
+                # core-aware parser config (the root bench's rule): a
+                # serial worker host skips the extra parse thread, which
+                # also lets the loader engage the fused streampack path.
+                # An explicit DMLC_NUM_THREADS/OMP_NUM_THREADS pin beats
+                # the heuristic (the throttled-but-multicore case
+                # _default_nthreads exists for) — defer to the defaults
+                # then, which consult those env vars.
+                try:
+                    cores = len(os.sched_getaffinity(0))
+                except (AttributeError, OSError):
+                    cores = os.cpu_count() or 1
+                pinned = (os.environ.get("DMLC_NUM_THREADS")
+                          or os.environ.get("OMP_NUM_THREADS"))
+                nthreads, threaded = ((1, False)
+                                      if cores == 1 and not pinned
+                                      else (0, True))
                 loader = DeviceLoader(
-                    create_parser(uri, part, nparts, fmt),
+                    create_parser(uri, part, nparts, fmt,
+                                  nthreads=nthreads, threaded=threaded),
                     batch_rows=batch_rows, nnz_cap=nnz_cap,
                     id_mod=id_mod, wire_compact=wire_compact, emit="host")
                 for item in loader:

@@ -219,3 +219,28 @@ def test_multithread_parse_equivalence(fmt, gen):
         np.testing.assert_array_equal(a["fields"], b["fields"])
     assert a["bad_lines"] == b["bad_lines"]
     assert len(a["offsets"]) == 4001
+
+
+def test_float_shapes_exact_vs_python():
+    """The SWAR float fast path (one-window 'd.dddd' splice) must agree
+    with Python's float() to the float32 ulp across shape edge cases:
+    dot positions, window-boundary lengths, leading zeros, exponents,
+    signs, and value-less fallthroughs."""
+    shapes = ["0.5", "0.25", "0.1234", "0.123456", "0.1234567",
+              "0.12345678", "12.5", "123.4567", "1234567.1",
+              ".5", ".0625", "0.0", "00.5", "7", "42", "1234567",
+              "1e3", "1.5e-4", "2.5E2", "-0.75", "+0.125",
+              "0.00001", "12345.67", "999999.9", "3.14159265358979",
+              # dot at/near the 8-byte window boundary (the d==7 shape was
+              # a UB shift-by-64 before the d<7 guard)
+              "1234567.5", "1234567.", "123456.7", "12345678.5",
+              "1234567.89", "999999.", "0.9999999"]
+    lines = []
+    for i, s in enumerate(shapes):
+        lines.append(f"{i % 2} {i}:{s}")
+    data = ("\n".join(lines) + "\n").encode()
+    out = native.parse_libsvm(data)
+    got = out["values"]
+    want = np.array([np.float32(float(s)) for s in shapes], np.float32)
+    np.testing.assert_array_equal(got.view(np.uint32), want.view(np.uint32),
+                                  err_msg=str(list(zip(shapes, got, want))))

@@ -1,6 +1,8 @@
 """Packing + DeviceLoader tests: fixed shapes, padding/truncation accounting,
 epoch resets, row conservation."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -558,3 +560,37 @@ def test_streampack_matches_two_stage_other_formats(tmp_path, monkeypatch,
     for i, (x, y) in enumerate(zip(a, b)):
         for k in x:
             np.testing.assert_array_equal(x[k], y[k], err_msg=f"{i}/{k}")
+
+
+def test_streampack_with_cache_sugar(tmp_path, monkeypatch):
+    """#cachefile URI sugar replays CHUNKS from the cache file on epoch 2;
+    the fused streampack path consumes chunks directly from the split, so
+    replay must deliver identical batches even after the source file is
+    deleted (the CachedInputSplit contract)."""
+    from dmlc_core_tpu import native
+    if not native.has_sppack():
+        pytest.skip("native sppack not built")
+    rng = np.random.default_rng(17)
+    src = tmp_path / "c.libsvm"
+    with open(src, "w") as f:
+        for i in range(800):
+            idx = np.sort(rng.choice(999, size=4, replace=False))
+            f.write(f"{i % 2} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    cache = tmp_path / "cc"
+    from dmlc_core_tpu.data import create_parser
+    loader = DeviceLoader(
+        create_parser(f"file://{src}#{cache}", 0, 1, "libsvm", nthreads=1,
+                      threaded=False),
+        batch_rows=256, nnz_cap=4096)
+    assert loader._use_streampack()
+    try:
+        ep1 = [np.asarray(b["labels"]) for b in loader]
+        os.remove(src)                       # epoch 2 must come from cache
+        loader.before_first()
+        ep2 = [np.asarray(b["labels"]) for b in loader]
+    finally:
+        loader.close()
+    assert len(ep1) == len(ep2) == 4
+    for a, b in zip(ep1, ep2):
+        np.testing.assert_array_equal(a, b)
