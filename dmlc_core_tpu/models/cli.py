@@ -92,6 +92,10 @@ class TrainParams(Parameter):
     l2 = field(float, default=0.0, lower_bound=0.0)
     seed = field(int, default=0)
     ckpt_dir = field(str, default="", help="checkpoint dir URI ('' = off)")
+    ckpt_every = field(int, default=0, lower_bound=0,
+                       help="async-checkpoint every N steps (0 = only at "
+                            "the end); saves overlap training and are "
+                            "awaited before exit")
     resume = field(bool, default=False,
                    help="continue from the latest checkpoint in ckpt_dir "
                         "(the reference ecosystem's model_in/model_out "
@@ -297,8 +301,19 @@ def main(argv=None) -> int:
         print(f"epoch {epoch} valid acc {r['accuracy']:.4f}{auc}",
               flush=True)
 
+    mgr = None
+    if p.ckpt_dir:
+        from ..utils import CheckpointManager
+        mgr = CheckpointManager(p.ckpt_dir)
+    elif p.ckpt_every:
+        # same loud-misconfig contract as resume-without-ckpt_dir: a long
+        # job silently writing zero checkpoints is unrecoverable
+        print("dmlc-train: ckpt_every needs ckpt_dir", file=sys.stderr)
+        return 2
+
     n = start_n
     loss = None
+    last_async_step = -1
     try:
         for epoch in range(p.epochs):
             for batch in loader:
@@ -307,6 +322,14 @@ def main(argv=None) -> int:
                 if p.log_every and n % p.log_every == 0:
                     print(f"epoch {epoch} step {n} loss {float(loss):.5f}",
                           flush=True)
+                if mgr is not None and p.ckpt_every \
+                        and n % p.ckpt_every == 0:
+                    # overlaps the next train steps (device leaves get an
+                    # async on-device copy — they survive donation)
+                    mgr.save_async(n, {"params": params,
+                                       "opt_state": opt_state},
+                                   meta={"model": p.model, "steps": int(n)})
+                    last_async_step = n
             loader.before_first()
             eval_valid(epoch)
         if loss is None:
@@ -326,12 +349,22 @@ def main(argv=None) -> int:
                   flush=True)
     finally:
         loader.close()
+        if mgr is not None:
+            # drain the in-flight save even when the loop raised: the last
+            # published checkpoint is exactly what a crash needs for resume
+            try:
+                mgr.wait()
+            except Exception as e:  # noqa: BLE001 — secondary failure
+                print(f"dmlc-train: background checkpoint failed: {e}",
+                      file=sys.stderr)
 
-    if p.ckpt_dir:
-        from ..utils import CheckpointManager
-        mgr = CheckpointManager(p.ckpt_dir)
-        mgr.save(n, {"params": params, "opt_state": opt_state},
-                 meta={"model": p.model, "steps": int(n)})
+    if mgr is not None:
+        mgr.wait()                     # surface any mid-train async failure
+        # dedup only against a save THIS run made: a stale same-numbered
+        # checkpoint from an earlier run must be overwritten, not trusted
+        if last_async_step != n:
+            mgr.save(n, {"params": params, "opt_state": opt_state},
+                     meta={"model": p.model, "steps": int(n)})
         print(f"checkpoint step {n} -> {p.ckpt_dir}", flush=True)
     return 0
 

@@ -367,6 +367,7 @@ class CheckpointManager:
         self.max_to_keep = max_to_keep
         self._store = (_RemoteStore(directory) if "://" in directory
                        else _LocalStore(directory))
+        self._pending: Optional[Tuple[Any, List[Any]]] = None  # (thread, box)
 
     def _name(self, step: int) -> str:
         return f"ckpt-{step}.bin"
@@ -429,6 +430,86 @@ class CheckpointManager:
             self._store.delete(self._name(drop))
         log_info("checkpoint: saved step %d -> %s", step, self._path(step))
         return self._path(step)
+
+    def save_async(self, step: int, state: Any,
+                   meta: Optional[Dict[str, Any]] = None) -> None:
+        """Queue :meth:`save` on a background thread and return immediately
+        — the TPU-native discipline: the train loop keeps dispatching while
+        device→host readback, serialization and the store upload drain off
+        the critical path (the async half of what orbax calls
+        AsyncCheckpointer; the reference has no analog — its rabit
+        CheckPoint is synchronous by design).
+
+        Snapshot semantics: ``jax.Array`` leaves get an async ON-DEVICE
+        copy (``jnp.copy`` — an HBM memcpy that dispatches without
+        blocking): jax arrays are immutable but a donating train step
+        (``make_train_step`` donates params/opt_state) DELETES the old
+        buffers on its next call, so capture-by-reference would hand the
+        writer dead arrays.  Mutable ``np.ndarray`` leaves are copied NOW
+        so a loop that updates host state in place cannot race the writer.
+        One save is in flight at a time — a second ``save_async`` first
+        waits for (and surfaces errors from) the previous one.  Call
+        :meth:`wait` before reading ``latest_step`` or exiting."""
+        self.wait()                       # serialize + surface prior errors
+        import jax
+        import jax.numpy as jnp
+
+        def snap(node):
+            # order-preserving walk (jax.tree.map would rebuild dicts in
+            # sorted-key order and change the serialized byte layout)
+            if isinstance(node, dict):
+                out = {k: snap(v) for k, v in node.items()}
+                return out if type(node) is dict else type(node)(out)
+            if isinstance(node, tuple):
+                vals = [snap(v) for v in node]
+                return (type(node)(*vals) if hasattr(node, "_fields")
+                        else tuple(vals))
+            if isinstance(node, list):
+                return [snap(v) for v in node]
+            if isinstance(node, jax.Array):
+                return jnp.copy(node)     # survives donation; async HBM copy
+            if isinstance(node, np.ndarray):
+                return node.copy()
+            # custom registered pytree nodes (dataclass optimizer states,
+            # flax structs, …): flatten/unflatten preserves THEIR leaf
+            # order, so snapshot semantics hold for every container kind —
+            # only plain dicts need the explicit branch above (tree_flatten
+            # would re-sort their keys and change the serialized layout)
+            leaves, treedef = jax.tree_util.tree_flatten(node)
+            if len(leaves) == 1 and leaves[0] is node:
+                return node               # true leaf (scalar/str/None/…)
+            return jax.tree_util.tree_unflatten(
+                treedef, [snap(leaf) for leaf in leaves])
+
+        snapped = snap(state)
+        box: List[Any] = []               # [result] or [None, exc]
+        import threading
+
+        def run() -> None:
+            try:
+                box.append(self.save(step, snapped, meta))
+            except BaseException as e:  # noqa: BLE001 — surfaced in wait()
+                box.append(None)
+                box.append(e)
+
+        th = threading.Thread(target=run, name=f"ckpt-save-{step}",
+                              daemon=True)
+        self._pending = (th, box)
+        th.start()
+
+    def wait(self) -> Optional[str]:
+        """Block until the pending :meth:`save_async` has published; return
+        its checkpoint path (None when nothing was pending).  Re-raises the
+        background save's exception, so failures cannot pass silently."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        th, box = pending
+        th.join()
+        if len(box) == 2:
+            raise DMLCError(
+                f"async checkpoint save failed: {box[1]}") from box[1]
+        return box[0]
 
     def restore(self, step: Optional[int] = None,
                 template: Any = None) -> Tuple[int, Any]:

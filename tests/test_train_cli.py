@@ -268,3 +268,22 @@ def test_cli_valid_watchlist(libsvm_file, tmp_path):
     assert "auc" in lines[-1]
     final_auc = float(lines[-1].split("auc")[1])
     assert final_auc > 0.7, lines
+
+
+def test_cli_periodic_async_checkpoints(libsvm_file, tmp_path):
+    """ckpt_every=N async-saves during training (overlapping the loop),
+    waits before exit, and resume from a mid-train checkpoint works."""
+    ckpt = tmp_path / "ck"
+    out = _run([f"data={libsvm_file}", "model=fm", "features=64", "dim=4",
+                "batch_rows=128", "nnz_cap=2048", "lr=0.05",
+                f"ckpt_dir={ckpt}", "ckpt_every=3", "log_every=0",
+                "eval_auc=0"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    import sys as _sys
+    from dmlc_core_tpu.utils import CheckpointManager
+    mgr = CheckpointManager(str(ckpt))
+    # 800 rows / 128 = 7 steps: every-3 saves at 3,6 + final at 7; bounded
+    # retention (3) keeps them all
+    assert mgr.steps == [3, 6, 7], mgr.steps
+    step, st = mgr.restore(6)
+    assert step == 6 and "opt_state" in st
