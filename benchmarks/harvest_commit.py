@@ -78,6 +78,31 @@ def main() -> int:
         up = d.get("unpack", {})
         if "v2" in up and "v3" in up:
             print(f"unpack:    v2 {up['v2']} | v3 {up['v3']}")
+    m = found.get(f"TPU_MICRO_{tag}.json")
+    if m:
+        eb = m.get("embed_bag_pallas_vs_xla", {})
+        if eb:
+            print("pallas:    " + " ".join(
+                f"K={k}:xla {v['xla_us']}us/pallas "
+                f"{v['pallas_us'] if v['pallas_us'] is not None else 'FAIL'}"
+                f"{'us' if v['pallas_us'] is not None else ''}"
+                for k, v in eb.items()))
+            wins = [k for k, v in eb.items()
+                    if v["pallas_us"] is not None
+                    and v["pallas_us"] < v["xla_us"]]
+            if wins:
+                print(f"→ pallas wins at K∈{{{','.join(wins)}}}: consider "
+                      "lowering DMLC_PALLAS_MIN_D from measurement")
+            elif all(v["pallas_us"] is None for v in eb.values()):
+                print("→ pallas never lowered on hardware: keep XLA default")
+        sp = m.get("sp_1dev", {})
+        pp = m.get("pp_1dev", {})
+        if sp or pp:
+            print(f"sp/pp 1dev: ring {sp.get('ring_us')}us "
+                  f"ulysses {sp.get('ulysses_us')}us "
+                  f"gpipe {pp.get('us')}us"
+                  + (f" (sp err: {[v for k, v in sp.items() if 'error' in k]})"
+                     if any('error' in k for k in sp) else ""))
     s = found.get(f"BENCH_suite_{tag}.json")
     if s and "results" in s:
         cpu_left = [r["metric"] for r in s["results"]
