@@ -1,0 +1,101 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from dmlc_core_tpu import native
+from dmlc_core_tpu.pipeline.device_loader import _fused_words_meta
+
+assert native.has_sppack()
+fails = 0
+for seed in range(50):
+    rng = np.random.default_rng(seed)
+    fmt = ["libsvm", "libfm", "csv"][seed % 3]
+    compact = bool(seed % 2)
+    B = int(rng.choice([64, 256, 1000]))
+    CAP = int(rng.choice([512, 4096, 16384]))
+    idmod = int(rng.choice([0, 1 << 14]))
+    lines = []
+    nrows = int(rng.integers(500, 3000))
+    ncol = int(rng.integers(3, 12))
+    for i in range(nrows):
+        r = rng.random()
+        if fmt == "csv":
+            if r < 0.02:
+                lines.append("1,garbage," + "0.5," * (ncol - 2))
+            else:
+                lines.append(f"{i%2}," + ",".join(
+                    "" if rng.random() < 0.05 else f"{v:.5f}"
+                    for v in rng.random(ncol)))
+        else:
+            n = int(rng.integers(0, 15))
+            idx = np.sort(rng.choice(1 << 20, size=n, replace=False))
+            if fmt == "libsvm":
+                toks = [f"{j}" if rng.random() < 0.25 else
+                        f"{j}:{rng.random()*1000:.6f}" for j in idx]
+            else:
+                toks = [f"{int(rng.integers(0,50))}:{j}:{rng.random():.4f}"
+                        for j in idx]
+            head = f"{i%2}" if r < 0.7 else f"{i%2}:{rng.random():.3f}"
+            if r > 0.98:
+                lines.append("")
+            lines.append(head + " " + " ".join(toks))
+    text = ("\n".join(lines) + "\n").encode()
+    # random record-aligned chunking
+    cuts = [0]
+    for frac in sorted(rng.random(int(rng.integers(1, 4)))):
+        idx2 = text.find(b"\n", int(len(text) * frac))
+        if idx2 >= 0 and idx2 + 1 > cuts[-1]:
+            cuts.append(idx2 + 1)
+    cuts.append(len(text))
+    chunks = [text[cuts[i]:cuts[i+1]] for i in range(len(cuts) - 1)]
+
+    lc, dl = (0, ",") if fmt == "csv" else (-1, ",")
+    sp = native.SpPacker(B, CAP, id_mod=idmod, compact=compact, fmt=fmt,
+                         label_col=lc, delim=dl)
+    a = []
+    try:
+        for ch in chunks:
+            for buf, meta in sp.feed_text(ch):
+                a.append((buf.copy(), meta))
+        t = sp.flush()
+        if t: a.append((t[0].copy(), t[1]))
+        sa = sp.stats()
+    finally:
+        sp.close()
+
+    from dmlc_core_tpu.data.row_block import RowBlockContainer
+    pk = native.Packer(B, CAP, id_mod=idmod, compact=compact)
+    b = []
+    try:
+        for ch in chunks:
+            if fmt == "csv":
+                d = native.parse_csv(ch, 0, ",", 1)
+            elif fmt == "libfm":
+                d = native.parse_libfm(ch, 1)
+            else:
+                d = native.parse_libsvm(ch, 1)
+            blk = RowBlockContainer.from_arrays(
+                d["offsets"], d["labels"], d["indices"], d.get("values"),
+                d.get("weights")).get_block()
+            for bf, m in pk.feed(blk):
+                b.append((bf.copy(), m))
+        t = pk.flush()
+        if t: b.append((t[0].copy(), t[1]))
+        sb = pk.stats()
+    finally:
+        pk.close()
+
+    ok = len(a) == len(b)
+    if ok:
+        for (x, mx), (y, my) in zip(a, b):
+            w = _fused_words_meta(B, mx)
+            if mx != my or not np.array_equal(x[:w], y[:w]):
+                ok = False
+                break
+    for k in ("rows", "padded_rows", "truncated_values", "batches"):
+        if sa[k] != sb[k]:
+            ok = False
+    if not ok:
+        fails += 1
+        print(f"SEED {seed} MISMATCH fmt={fmt} compact={compact} B={B} "
+              f"CAP={CAP} idmod={idmod} a={len(a)} b={len(b)} sa={sa} sb={sb}")
+print(f"fuzz: 50 seeds, {fails} mismatches")
