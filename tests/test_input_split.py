@@ -192,3 +192,27 @@ def test_threaded_equals_unthreaded(text_corpus):
     with create_input_split(path, 1, 3, "text", threaded=True) as t, \
          create_input_split(path, 1, 3, "text", threaded=False) as u:
         assert list(t) == list(u)
+
+
+def test_before_first_mid_stream(text_corpus):
+    """Reference split_repeat_read_test.cc: read PART of the stream, reset,
+    and the re-read must reproduce the records byte-for-byte — a reset
+    must clear the overflow/partial-record carry, not splice it into the
+    next epoch.  Covered for plain, threaded, and shuffled splits."""
+    path, lines = text_corpus
+    for kw in ({}, {"threaded": True},
+               {"shuffle": True, "num_shuffle_parts": 4, "shuffle_seed": 7}):
+        with create_input_split(path, 0, 1, "text",
+                                **({"threaded": False} | kw)) as s:
+            seen = []
+            for rec in s:
+                seen.append(rec)
+                if len(seen) == max(3, len(lines) // 3):
+                    break               # mid-stream: carry likely nonempty
+            s.before_first()
+            replay = list(s)
+        if kw.get("shuffle"):
+            assert sorted(replay) == sorted(lines), kw
+        else:
+            assert replay == lines, kw
+            assert replay[:len(seen)] == seen, kw

@@ -244,3 +244,29 @@ def test_float_shapes_exact_vs_python():
     want = np.array([np.float32(float(s)) for s in shapes], np.float32)
     np.testing.assert_array_equal(got.view(np.uint32), want.view(np.uint32),
                                   err_msg=str(list(zip(shapes, got, want))))
+
+
+def test_parser_before_first_mid_stream(tmp_path):
+    """Mid-stream before_first on the (threaded) parser must replay from
+    the top — the ThreadedIter reset protocol drains the producer without
+    leaking the in-flight chunk into epoch 2 (reference
+    split_repeat_read_test.cc discipline, one layer up)."""
+    rng = np.random.default_rng(3)
+    path = tmp_path / "m.libsvm"
+    with open(path, "w") as f:
+        for i in range(2000):
+            idx = sorted(rng.choice(500, 4, replace=False).tolist())
+            f.write(f"{i % 2} " + " ".join(f"{j}:1" for j in idx) + "\n")
+    for threaded in (False, True):
+        with create_parser(str(path), 0, 1, "libsvm",
+                           threaded=threaded) as p:
+            it = iter(p)
+            first = next(it).get_block()
+            head = first.labels[:5].tolist()
+            p.before_first()
+            labels = []
+            for c in p:
+                labels.extend(c.get_block().labels.tolist())
+        assert len(labels) == 2000, threaded
+        assert labels[:5] == head, threaded
+        assert sum(labels) == sum(i % 2 for i in range(2000)), threaded
