@@ -3,7 +3,7 @@ line per config (the root ``bench.py`` stays the driver's single headline
 number; this suite is for profiling the rest):
 
 * ``libsvm``    — sparse text → device batches (same as bench.py)
-* ``csv``       — dense HIGGS-style CSV → device batches
+* ``csv``       — dense HIGGS-style CSV → RowBlocks (host parse only)
 * ``libfm``     — field-aware sparse (Criteo-style) → device batches
 * ``recordio``  — .rec streaming: write then partitioned read MB/s
 * ``stream``    — raw SeekStream read MB/s at several buffer sizes
@@ -240,7 +240,6 @@ def bench_fm_train() -> dict:
 def bench_csv() -> dict:
     path = "/tmp/bench_suite.csv"
     _gen_csv(path)
-    import jax
     from dmlc_core_tpu.data import create_parser
     size_mb = os.path.getsize(path) / MB
     best = 0.0
@@ -562,19 +561,23 @@ def bench_sp_mesh8() -> dict:
 # no tunnel involved) come before the long device-bound train loop: a
 # wedged tunnel grant mid-fm_train (observed r03: >1h stall inside one
 # RPC) must not cost the configs that never needed the chip.
+# Order = priority under a short-lived grant: the tunnel can vanish
+# mid-suite (observed r04: grant lost between the 4th and 5th config), so
+# the two headline TPU configs run FIRST and the host-only configs (which
+# never touch the tunnel) run last.
 ALL = {
     "libsvm": bench_libsvm,
-    "csv": bench_csv,
+    "fm_train": bench_fm_train,
     "libfm": bench_libfm,
     "sharded": bench_sharded,
-    "recordio": bench_recordio,
-    "stream": bench_stream,
+    "allreduce": bench_allreduce,
     "remote_ingest": bench_remote_ingest,
     "ingest_scale": bench_ingest_scale,
+    "csv": bench_csv,
+    "recordio": bench_recordio,
+    "stream": bench_stream,
     "allreduce_mesh8": bench_allreduce_mesh8,
     "sp_mesh8": bench_sp_mesh8,
-    "allreduce": bench_allreduce,
-    "fm_train": bench_fm_train,
 }
 
 
@@ -583,7 +586,12 @@ ALL = {
 # stamped "cpu_mesh8" so a by-design virtual-mesh number is never mistaken
 # for an ingest config that silently fell back to CPU (VERDICT r2 weak#2).
 CPU_MESH = {"allreduce_mesh8", "sp_mesh8"}
-HOST_ONLY = {"stream"}      # raw host IO: no device at all
+# Raw host IO / parse-only configs: no device work at all, so they skip
+# backend init entirely (stamped "host").  csv + recordio moved here in r04:
+# they were stamped "tpu" only because jax had initialised with the grant,
+# and that init is exactly where a lost grant wedges a child for its whole
+# timeout (observed 23:39 r04: recordio hung in axon client init).
+HOST_ONLY = {"stream", "csv", "recordio"}
 # superseded in the default order (ingest_scale measures workers_2 too);
 # still runnable by explicit name
 DEFAULT_SKIP = {"remote_ingest"}
@@ -677,6 +685,7 @@ def main() -> None:
             r = {"metric": name, "error": "skipped: TPU grant lost earlier"}
             results.append(r)
             print(json.dumps(r), flush=True)
+            write_artifact(platform_of(results))
             continue
         log(f"running {name} (isolated, timeout {timeout_s}s) ...")
         try:
@@ -697,6 +706,23 @@ def main() -> None:
         except subprocess.TimeoutExpired:
             r = {"metric": name,
                  "error": f"timeout after {timeout_s}s (wedged tunnel?)"}
+            # a timed-out TPU config usually means the grant vanished and
+            # the child wedged in backend init (r04: recordio hung 1500s
+            # this way).  A short re-probe (probe_tpu retries once, so up
+            # to 2x DMLC_REPROBE_S against a dead tunnel) decides: tunnel
+            # dead → skip the remaining TPU configs instead of wedging
+            # 1500s each — the loop's next pass re-runs them on a grant.
+            # Only when we HAD a grant: on a deliberate-CPU run the
+            # timeout is just a slow config, not a lost tunnel.
+            if (name not in CPU_MESH | HOST_ONLY
+                    and env.get("DMLC_TPU_OK") == "1"):
+                import bench
+                if bench.probe_tpu(timeout_s=int(
+                        os.environ.get("DMLC_REPROBE_S", "120"))):
+                    r["error"] += "; TPU still up (slow config)"
+                else:
+                    tpu_lost = True
+                    r["error"] += "; re-probe: grant confirmed lost"
         results.append(r)
         print(json.dumps(r), flush=True)
         write_artifact(platform_of(results))
