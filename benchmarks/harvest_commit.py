@@ -36,12 +36,60 @@ def _load(path: str):
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _merge_suite(old: dict, new: dict) -> dict:
+    """Per-config union of two suite artifacts: a grant can die mid-suite,
+    so artifacts from different windows cover different configs.  A fresher
+    measured entry beats an older one; a measured entry NEVER loses to an
+    error/skip entry (a later short window must not erase an earlier
+    window's real numbers)."""
+    if not isinstance(old.get("results"), list):
+        return new
+    if not isinstance(new.get("results"), list):
+        # unparseable/mid-rewrite source: keep the old artifact untouched
+        # and tell the caller nothing new landed
+        return old
+    merged: dict[str, dict] = {r["metric"]: r for r in old["results"]
+                               if isinstance(r, dict) and "metric" in r}
+    order = list(merged)
+    for r in new["results"]:
+        if not (isinstance(r, dict) and "metric" in r):
+            continue
+        prev = merged.get(r["metric"])
+        if prev is not None and "error" in r and "error" not in prev:
+            continue
+        if r["metric"] not in merged:
+            order.append(r["metric"])
+        merged[r["metric"]] = r
+    results = [merged[m] for m in order]
+    # same platform-collapse rule as bench_suite.platform_of (keep in sync)
+    plats = sorted({r["platform"] for r in results if "platform" in r})
+    platform = "tpu" if "tpu" in plats else "+".join(plats) or "none"
+    # extra top-level keys (e.g. provenance notes) survive the merge;
+    # fresher values win on collision
+    extras = {k: v for d in (old, new) for k, v in d.items()
+              if k not in ("platform", "results")}
+    return {**extras, "platform": platform, "results": results}
+
+
 def main() -> int:
     tag = sys.argv[1] if len(sys.argv) > 1 else "r03"
     found = {}
     for src, dst_t in ARTIFACTS.items():
         if os.path.exists(src) and os.path.getsize(src) > 2:
             dst = os.path.join(REPO, dst_t.format(tag=tag))
+            if dst_t.startswith("BENCH_suite") and os.path.exists(dst):
+                fresh = _load(src)
+                data = _merge_suite(_load(dst), fresh)
+                with open(dst, "w") as f:
+                    json.dump(data, f, indent=1)
+                found[os.path.basename(dst)] = data
+                if isinstance(fresh.get("results"), list):
+                    print(f"merged {src} -> {os.path.basename(dst)}")
+                else:
+                    print(f"SOURCE UNPARSEABLE {src} "
+                          f"({fresh.get('error')}) — kept existing "
+                          f"{os.path.basename(dst)} unchanged")
+                continue
             shutil.copyfile(src, dst)
             found[os.path.basename(dst)] = _load(src)
             print(f"copied {src} -> {os.path.basename(dst)}")
