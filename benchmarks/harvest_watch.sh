@@ -2,14 +2,17 @@
 # Companion to harvest_loop.sh: when a completed harvest lands (root bench
 # + suite artifacts in /tmp), snapshot them into the repo with
 # harvest_commit.py and commit.  Artifact-only commits — no code.
+# Usage: harvest_watch.sh [round_tag]   (default r04)
 set -u
+TAG="${1:-r04}"
 cd "$(dirname "$0")/.."
 while [ ! -f /tmp/harvest_stop ]; do
     if [ -s /tmp/bench_tpu.json ] && [ -s /tmp/bench_suite_tpu.json ]; then
-        python benchmarks/harvest_commit.py r03 >>/tmp/harvest_watch.log 2>&1
-        git add BENCH_tpu_r03.json BENCH_tpu_3x_r03.json TPU_DIAG_r03.json \
-                TPU_MICRO_r03.json BENCH_suite_r03.json 2>/dev/null
-        git commit -q -m "On-chip harvest artifacts (late tunnel re-grant)" \
+        python benchmarks/harvest_commit.py "$TAG" >>/tmp/harvest_watch.log 2>&1
+        git add "BENCH_tpu_${TAG}.json" "BENCH_tpu_3x_${TAG}.json" \
+                "TPU_DIAG_${TAG}.json" "TPU_MICRO_${TAG}.json" \
+                "BENCH_suite_${TAG}.json" 2>/dev/null
+        git commit -q -m "On-chip harvest artifacts (${TAG} granted window)" \
             >>/tmp/harvest_watch.log 2>&1
         echo "$(date -u +%H:%M:%S) committed harvest artifacts" \
             >>/tmp/harvest_watch.log
