@@ -64,6 +64,42 @@ def test_probe_flow_pinned_by_env(bench_mod, capfd, monkeypatch):
     assert mean > 0
 
 
+def test_harvest_commit_suite_merge():
+    """Suite artifacts from different grant windows merge per-config: a
+    measured entry never loses to a later error/skip entry, fresher
+    measured entries win, extra top-level keys survive, and an
+    unparseable source leaves the existing artifact untouched."""
+    spec = importlib.util.spec_from_file_location(
+        "harvest_commit_under_test",
+        os.path.join(REPO, "benchmarks", "harvest_commit.py"))
+    hc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(hc)
+    old = {"provenance": "window1", "platform": "tpu", "results": [
+        {"metric": "libsvm", "value": 300.0, "platform": "tpu"},
+        {"metric": "csv", "value": 400.0, "platform": "host"}]}
+    new = {"platform": "cpu", "results": [
+        {"metric": "libsvm", "error": "timeout"},          # must NOT win
+        {"metric": "csv", "value": 430.0, "platform": "host"},  # fresher
+        {"metric": "fm_train", "value": 7000, "platform": "tpu"}]}
+    m = hc._merge_suite(old, new)
+    assert m["provenance"] == "window1"
+    assert m["platform"] == "tpu"
+    by = {r["metric"]: r for r in m["results"]}
+    assert by["libsvm"]["value"] == 300.0 and "error" not in by["libsvm"]
+    assert by["csv"]["value"] == 430.0
+    assert by["fm_train"]["value"] == 7000
+    # order: old configs first, new appended
+    assert [r["metric"] for r in m["results"]] == ["libsvm", "csv",
+                                                   "fm_train"]
+    # unparseable/mid-rewrite source: old artifact returned unchanged
+    assert hc._merge_suite(old, {"error": "JSONDecodeError"}) is old
+    # malformed old: fresh artifact wins wholesale
+    assert hc._merge_suite({}, new) is new
+    # an error entry may land where nothing was measured before
+    m2 = hc._merge_suite({"platform": "tpu", "results": []}, new)
+    assert "error" in {r["metric"]: r for r in m2["results"]}["libsvm"]
+
+
 def test_suite_hang_isolation(tmp_path):
     """A wedged config child (simulated 1h sleep — the r3 tunnel wedge) is
     killed by the per-config timeout and the NEXT config still runs and
