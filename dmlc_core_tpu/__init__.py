@@ -25,6 +25,6 @@ A brand-new JAX/XLA/Pallas-first design (not a port) providing:
 Reference: Luo-Liang/dmlc-core (C++11), surveyed in /root/repo/SURVEY.md.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 from . import utils  # noqa: F401
