@@ -140,7 +140,8 @@ def main() -> int:
                     and v["pallas_us"] < v["xla_us"]]
             if wins:
                 print(f"→ pallas wins at K∈{{{','.join(wins)}}}: consider "
-                      "lowering DMLC_PALLAS_MIN_D from measurement")
+                      "flipping the _pallas_profitable default from "
+                      "measurement")
             elif all(v["pallas_us"] is None for v in eb.values()):
                 print("→ pallas never lowered on hardware: keep XLA default")
         sp = m.get("sp_1dev", {})

@@ -34,14 +34,23 @@ def log(msg: str) -> None:
 T0 = time.monotonic()
 
 
-def timed(fn, *args, warmup: int = 2, iters: int = 10) -> float:
+def timed_fb(fn, y0, *rest, warmup: int = 2, iters: int = 3) -> float:
+    """Feedback timing: each dispatch consumes the PREVIOUS dispatch's
+    output (fn must map its first arg to a same-shaped output), so the
+    tunnel runtime cannot dedupe repeated identical (program, args)
+    executions.  r04 evidence that ``timed`` alone is not enough: three
+    identical mm_chain dispatches read 54855 TFLOP/s on a ~394-peak v5e —
+    the chain defeated elision WITHIN a dispatch, while the repeat
+    dispatches were still collapsed."""
     import jax
+    y = y0
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        y = fn(y, *rest)
+    jax.block_until_ready(y)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
+        y = fn(y, *rest)
+    jax.block_until_ready(y)
     return (time.perf_counter() - t0) / iters
 
 
@@ -95,7 +104,7 @@ def main() -> int:
             return ((acc @ a) * jnp.bfloat16(0.125)).astype(jnp.bfloat16)
         return jax.lax.fori_loop(0, chain_len, body, a)
 
-    dt = timed(mm_chain, x, iters=3) / chain_len
+    dt = timed_fb(mm_chain, x, iters=3) / chain_len
     result["matmul_bf16_4096_tflops"] = round(2 * n**3 / dt / 1e12, 2)
     log(f"matmul: {result['matmul_bf16_4096_tflops']} TFLOP/s")
 
@@ -126,10 +135,23 @@ def main() -> int:
                 # true, so the actual indices are unchanged.
                 bump = (v[:, :1] > jnp.float32(1e30)).astype(jnp.int32)
                 out = f(ids + bump, v, table)
-                lead = (out[0] if outs > 1 else out)[:, :1]
-                return v + lead * jnp.float32(1e-30)
+                # the carry must consume EVERY output column: r04's
+                # out[:, :1] carry let XLA dead-code-eliminate the other
+                # 127 gather columns and read 2.8us for a 16MB gather
+                if outs > 1:
+                    lead = (out[0].sum(axis=1, keepdims=True)
+                            + out[1].sum(axis=1, keepdims=True))
+                else:
+                    lead = out.sum(axis=1, keepdims=True)
+                # the perturbation must survive f32 addition: 1e-30*lead
+                # underflows below ulp(1.0)~1.2e-7 and makes the carry a
+                # bitwise identity, re-enabling the dispatch dedupe this
+                # feedback exists to defeat.  1e-6*lead (~1e-5 at these
+                # magnitudes) actually changes v while leaving the timed
+                # math unaffected.
+                return v + lead * jnp.float32(1e-6)
             return jax.lax.fori_loop(0, chain_steps, body, v0)
-        return timed(run, vals, ids, table, iters=3) / chain_steps
+        return timed_fb(run, vals, ids, table, iters=3) / chain_steps
 
     # --- embed_bag: pallas vs XLA across K regimes (VERDICT #10) ---
     try:
@@ -225,11 +247,18 @@ def main() -> int:
                             ("ulysses", make_ulysses_attention)):
             try:
                 fn = maker(mesh1, "sp", causal=True)
+                # tolerance sized for TPU, not CPU: TPU matmuls default to
+                # bf16-mantissa passes, so the ring's blockwise softmax
+                # reassociation can differ from dense by ~1 bf16 ulp
+                # (TPU_MICRO_r04 measured max 5.4e-3 abs on 0.009% of
+                # elements at the old 2e-3 — numerics, not a routing bug)
                 np.testing.assert_allclose(np.asarray(fn(q, k_, v)),
-                                           np.asarray(ref), rtol=2e-3,
-                                           atol=2e-3)
-                sp[name + "_us"] = round(timed(fn, q, k_, v, iters=3) * 1e6,
-                                         1)
+                                           np.asarray(ref), rtol=1e-2,
+                                           atol=1e-2)
+                # feedback out->q: attention output is q-shaped, so each
+                # dispatch differs and cannot be deduped by the runtime
+                sp[name + "_us"] = round(
+                    timed_fb(fn, q, k_, v, iters=3) * 1e6, 1)
                 log(f"sp {name}: {sp[name + '_us']}us (matches dense)")
             except Exception as e:  # noqa: BLE001
                 sp[name + "_error"] = f"{type(e).__name__}: {e}"
@@ -262,7 +291,10 @@ def main() -> int:
         np.testing.assert_allclose(np.asarray(ys), np.asarray(expect),
                                    rtol=2e-4, atol=2e-4)
         result["pp_1dev"] = {
-            "us": round(timed(run, params, xs, iters=3) * 1e6, 1),
+            # ys is xs-shaped (square stages): feed it back so repeat
+            # dispatches differ (no runtime dedupe)
+            "us": round(timed_fb(lambda y, p: run(p, y), xs, params,
+                                 iters=3) * 1e6, 1),
             "shape": f"S1 M{M} mb{MB} F{F}"}
         log(f"pp 1-dev GPipe tick: {result['pp_1dev']['us']}us "
             "(matches direct)")

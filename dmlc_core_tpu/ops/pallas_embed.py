@@ -71,20 +71,19 @@ def _pallas_profitable(B: int, K: int, D: int, fused: bool) -> bool:
     step, so the default verdict is a pure function of the call shape —
     no wall-clock probes whose outcome can differ across hosts/runs.
 
-    Heuristic: the kernel's win is skipping the ``[B·K, D]`` gathered HBM
-    intermediate; its cost is one small DMA per (row, k).  Tiny D makes
-    those DMAs latency-bound (a D<lane-width row can't fill a 128-lane
-    transfer), so require ``D >= DMLC_PALLAS_MIN_D`` (default 64) and a
-    batch tall enough to amortize grid launch (``B >= 64``).
-
-    Opt-in timed auto-tune (``DMLC_EMBED_AUTOTUNE=1``) restores the r3
-    behavior for single-host benchmarking, where cross-host divergence
-    cannot happen and measured truth beats the heuristic."""
+    Measured truth (TPU_MICRO_r04.json, TPU v5 lite): the per-(row,k)
+    512-byte DMAs are latency-bound and the kernel loses to XLA's
+    gather+einsum by orders of magnitude at every shape that has run on
+    hardware (K=8, D=128: pallas 8394us vs xla 2.8us).  XLA's native
+    gather is simply good on TPU for these widths, so the deterministic
+    default is **always XLA**; the pallas engine stays available via
+    ``DMLC_EMBED_ENGINE=pallas`` (pin) or ``DMLC_EMBED_AUTOTUNE=1``
+    (wall-clock probe — single-host bench use only, nondeterministic
+    across hosts)."""
     import os
     if os.environ.get("DMLC_EMBED_AUTOTUNE", "0") == "1":
         return _pallas_faster_timed(B, K, D, fused)
-    min_d = int(os.environ.get("DMLC_PALLAS_MIN_D", "64"))
-    return D >= min_d and B >= 64
+    return False
 
 
 def _pallas_faster_timed(B: int, K: int, D: int, fused: bool) -> bool:
@@ -258,6 +257,35 @@ def embed_bag_reference(ids: jax.Array, vals: jax.Array, table: jax.Array,
 # prefetch in SMEM so they need no blocked layout at all.
 _ROWS = 8
 
+# DMA ring depth: in-flight table-row fetches per row pipeline.  r4 hardware
+# timing showed the 2-slot double buffer is latency-bound (one ~512B DMA
+# in flight at a time); an 8-deep ring keeps up to 7 fetches in flight.
+_SLOTS = 8
+
+# Scalar-prefetch budget, in i32/f32 elements PER OPERAND.  ids+vals ride
+# SMEM (1 MB/core on v5e): B*K beyond this overflows — the exact failure
+# TPU_MICRO_r04 captured on hardware ("Allocation (size=8388608) would
+# exceed memory (size=1048576)", K>=64 at B=4096).  32768 elements
+# (128 KB x 2 operands) is the largest config PROVEN to compile and run
+# on Mosaic (K=8, B=4096, 2026-07-31 window); batches larger than the cap
+# are split into independent pallas_call chunks outside the kernel.
+_SMEM_SCALARS_CAP = 32768
+
+
+def _chunk_rows(K: int) -> int:
+    """Rows per pallas_call so that rows*K scalars stay under the SMEM cap
+    (multiple of _ROWS so chunk grids keep full output blocks).
+
+    DMLC_PALLAS_SMEM_SCALARS is read at TRACE time: jit caches are keyed
+    on shapes, so changing the env after a shape has been traced does not
+    re-chunk that shape for the rest of the process — set it before the
+    first call."""
+    import os
+    cap = int(os.environ.get("DMLC_PALLAS_SMEM_SCALARS",
+                             str(_SMEM_SCALARS_CAP)))
+    rows = max(cap // max(K, 1), _ROWS)
+    return max((rows // _ROWS) * _ROWS, _ROWS)
+
 
 def _kernel(ids_ref, vals_ref, table_ref, out_ref, buf, sems, *, K: int,
             D: int, B: int, square: bool):
@@ -273,14 +301,17 @@ def _kernel(ids_ref, vals_ref, table_ref, out_ref, buf, sems, *, K: int,
             return pltpu.make_async_copy(
                 table_ref.at[pl.ds(idx, 1), :], buf.at[slot], sems.at[slot])
 
-        cp(0, 0).start()            # prologue: fill slot 0
+        for s in range(min(_SLOTS - 1, K)):   # prologue: fill the ring
+            cp(s, s).start()
 
         def body(k, acc, base=base, cp=cp):
-            slot = jax.lax.rem(k, 2)
-
-            @pl.when(k + 1 < K)
-            def _start_next():
-                cp(k + 1, jax.lax.rem(k + 1, 2)).start()
+            slot = jax.lax.rem(k, _SLOTS)
+            # refill the slot freed at k-1 with the fetch for k+_SLOTS-1,
+            # keeping _SLOTS-1 DMAs in flight
+            @pl.when(k + _SLOTS - 1 < K)
+            def _start_ahead():
+                kn = k + _SLOTS - 1
+                cp(kn, jax.lax.rem(kn, _SLOTS)).start()
 
             cp(k, slot).wait()
             g = buf[slot]                    # (1, D)
@@ -303,15 +334,17 @@ def _fm_kernel(ids_ref, vals_ref, table_ref, out1_ref, out2_ref, buf, sems,
             return pltpu.make_async_copy(
                 table_ref.at[pl.ds(idx, 1), :], buf.at[slot], sems.at[slot])
 
-        cp(0, 0).start()
+        for s in range(min(_SLOTS - 1, K)):
+            cp(s, s).start()
 
         def body(k, accs, base=base, cp=cp):
             a1, a2 = accs
-            slot = jax.lax.rem(k, 2)
+            slot = jax.lax.rem(k, _SLOTS)
 
-            @pl.when(k + 1 < K)
-            def _start_next():
-                cp(k + 1, jax.lax.rem(k + 1, 2)).start()
+            @pl.when(k + _SLOTS - 1 < K)
+            def _start_ahead():
+                kn = k + _SLOTS - 1
+                cp(kn, jax.lax.rem(kn, _SLOTS)).start()
 
             cp(k, slot).wait()
             g = buf[slot]                    # (1, D)
@@ -324,10 +357,8 @@ def _fm_kernel(ids_ref, vals_ref, table_ref, out1_ref, out2_ref, buf, sems,
         out2_ref[pl.ds(r, 1), :] = a2
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def fm_terms_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
-                    interpret: bool = False):
-    """One DMA pass per row, BOTH FM reductions: (Σ v·x, Σ v²·x²)."""
+def _fm_terms_pallas_one(ids, vals, table, interpret: bool):
+    """Single-chunk fused FM kernel: ids/vals SMALL ENOUGH for SMEM."""
     B, K = ids.shape
     F, D = table.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -337,8 +368,8 @@ def fm_terms_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
         out_specs=[pl.BlockSpec((_ROWS, D), lambda b, ids, vals: (b, 0)),
                    pl.BlockSpec((_ROWS, D), lambda b, ids, vals: (b, 0))],
         scratch_shapes=[
-            pltpu.VMEM((2, 1, D), jnp.float32),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((_SLOTS, 1, D), jnp.float32),
+            pltpu.SemaphoreType.DMA((_SLOTS,)),
         ],
     )
     kernel = functools.partial(_fm_kernel, K=K, D=D, B=B)
@@ -352,11 +383,27 @@ def fm_terms_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
       vals.reshape(-1).astype(jnp.float32), table)
 
 
-@functools.partial(jax.jit, static_argnames=("square", "interpret"))
-def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
-                     square: bool = False,
-                     interpret: bool = False) -> jax.Array:
-    """Double-buffered DMA embedding bag.  ids,vals: [B,K]; table: [F,D] → [B,D]."""
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fm_terms_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
+                    interpret: bool = False):
+    """One DMA pass per row, BOTH FM reductions: (Σ v·x, Σ v²·x²).
+
+    Batches whose flat ids exceed the SMEM scalar-prefetch budget are split
+    into independent row-chunk pallas_calls (TPU_MICRO_r04: B·K ≥ 256Ki
+    scalars is a hard Mosaic OOM on v5e's 1 MB SMEM)."""
+    B, K = ids.shape
+    rows = _chunk_rows(K)
+    if B <= rows:
+        return _fm_terms_pallas_one(ids, vals, table, interpret)
+    outs = [_fm_terms_pallas_one(ids[s:s + rows], vals[s:s + rows],
+                                 table, interpret)
+            for s in range(0, B, rows)]
+    return (jnp.concatenate([o[0] for o in outs], axis=0),
+            jnp.concatenate([o[1] for o in outs], axis=0))
+
+
+def _embed_bag_pallas_one(ids, vals, table, square: bool, interpret: bool):
+    """Single-chunk kernel invocation (ids/vals fit the SMEM budget)."""
     B, K = ids.shape
     F, D = table.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -365,8 +412,8 @@ def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],    # table in HBM
         out_specs=pl.BlockSpec((_ROWS, D), lambda b, ids, vals: (b, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, 1, D), jnp.float32),  # double-buffer slots
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((_SLOTS, 1, D), jnp.float32),  # DMA ring slots
+            pltpu.SemaphoreType.DMA((_SLOTS,)),
         ],
     )
     kernel = functools.partial(_kernel, K=K, D=D, B=B, square=square)
@@ -377,3 +424,22 @@ def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
         interpret=interpret,
     )(ids.reshape(-1).astype(jnp.int32),
       vals.reshape(-1).astype(jnp.float32), table)
+
+
+@functools.partial(jax.jit, static_argnames=("square", "interpret"))
+def embed_bag_pallas(ids: jax.Array, vals: jax.Array, table: jax.Array,
+                     square: bool = False,
+                     interpret: bool = False) -> jax.Array:
+    """Ring-buffered DMA embedding bag.  ids,vals: [B,K]; table: [F,D] → [B,D].
+
+    Splits oversized batches into SMEM-sized row chunks (see
+    ``_chunk_rows``); each chunk is an independent pallas_call, concatenated
+    on the way out.  Chunk count is static, so this stays jit-compatible."""
+    B, K = ids.shape
+    rows = _chunk_rows(K)
+    if B <= rows:
+        return _embed_bag_pallas_one(ids, vals, table, square, interpret)
+    return jnp.concatenate(
+        [_embed_bag_pallas_one(ids[s:s + rows], vals[s:s + rows], table,
+                               square, interpret)
+         for s in range(0, B, rows)], axis=0)

@@ -80,19 +80,42 @@ def test_pallas_embed_bag_interpret_matches_reference():
 
 def test_engine_dispatch_deterministic(monkeypatch):
     """Default dispatch is a pure function of shape (ADVICE r3: every host
-    on a shared mesh must pick the same engine): no timing, threshold on D
-    and B, env-tunable."""
+    on a shared mesh must pick the same engine) and, post-TPU_MICRO_r04,
+    always XLA: on-hardware timing showed the DMA kernel loses at every
+    shape that has ever run (latency-bound 512B fetches), so pallas is
+    opt-in via DMLC_EMBED_ENGINE=pallas or DMLC_EMBED_AUTOTUNE=1."""
     from dmlc_core_tpu.ops import pallas_embed as pe
 
     monkeypatch.delenv("DMLC_EMBED_AUTOTUNE", raising=False)
-    monkeypatch.delenv("DMLC_PALLAS_MIN_D", raising=False)
-    assert pe._pallas_profitable(1024, 32, 64, fused=False) is True
-    assert pe._pallas_profitable(1024, 32, 8, fused=False) is False   # tiny D
-    assert pe._pallas_profitable(8, 32, 512, fused=False) is False    # tiny B
-    monkeypatch.setenv("DMLC_PALLAS_MIN_D", "256")
-    assert pe._pallas_profitable(1024, 32, 64, fused=False) is False
-    # same inputs, same verdict — repeat-call determinism
-    assert pe._pallas_profitable(1024, 32, 64, fused=False) is False
+    for shape in ((1024, 32, 64), (1024, 32, 8), (8, 32, 512)):
+        assert pe._pallas_profitable(*shape, fused=False) is False
+        # same inputs, same verdict — repeat-call determinism
+        assert pe._pallas_profitable(*shape, fused=False) is False
+
+
+def test_pallas_embed_chunked_matches_reference(monkeypatch):
+    """Batches whose flat ids/vals exceed the SMEM scalar-prefetch budget
+    split into independent row-chunk pallas_calls (TPU_MICRO_r04: 1MB+
+    scalar operands are a hard Mosaic OOM on v5e).  Force a tiny cap so
+    the chunk path runs at test scale; a non-multiple tail chunk included."""
+    from dmlc_core_tpu.ops import pallas_embed as pe
+
+    monkeypatch.setenv("DMLC_PALLAS_SMEM_SCALARS", "64")   # → 8-row chunks
+    rng = np.random.default_rng(5)
+    B, K, F, D = 44, 8, 64, 128          # 5 full chunks + 4-row tail
+    assert pe._chunk_rows(K) == 8
+    ids = jnp.array(rng.integers(0, F, (B, K)), jnp.int32)
+    vals = jnp.array(rng.random((B, K)), jnp.float32)
+    table = jnp.array(rng.random((F, D)), jnp.float32)
+    ref = pe.embed_bag_reference(ids, vals, table)
+    out = pe.embed_bag_pallas(ids, vals, table, interpret=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    s1, s2 = pe.fm_terms_pallas(ids, vals, table, interpret=True)
+    g = table[ids]
+    np.testing.assert_allclose(
+        s1, jnp.einsum("bk,bkd->bd", vals, g), rtol=1e-5)
+    np.testing.assert_allclose(
+        s2, jnp.einsum("bk,bkd->bd", vals * vals, g * g), rtol=1e-5)
 
 
 def test_engine_env_pin(monkeypatch):
