@@ -564,20 +564,30 @@ def bench_sp_mesh8() -> dict:
 # Order = priority under a short-lived grant: the tunnel can vanish
 # mid-suite (observed r04: grant lost between the 4th and 5th config), so
 # the two headline TPU configs run FIRST and the host-only configs (which
-# never touch the tunnel) run last.
+# never touch the tunnel) run last.  DMLC_SUITE_PRIORITY reorders at run
+# time (see main) without forking this registry.
+#
+# Each entry registers (config fn, headline metric).  Error/skip rows must
+# carry the SAME metric key as the success path, or harvest_commit's
+# cross-window merge can't pair them: a measured libfm_ingest_to_device
+# from window 1 would sit beside a spurious "libfm" error row from window 2
+# forever (observed r04).  allreduce's registered key is its 1-device
+# metric — the only case reachable in harvest (the tunnel exposes one chip;
+# a plain host exposes one cpu device); a manual multi-device run emits
+# allreduce_bus_bw, a deliberately distinct key.
 ALL = {
-    "libsvm": bench_libsvm,
-    "fm_train": bench_fm_train,
-    "libfm": bench_libfm,
-    "sharded": bench_sharded,
-    "allreduce": bench_allreduce,
-    "remote_ingest": bench_remote_ingest,
-    "ingest_scale": bench_ingest_scale,
-    "csv": bench_csv,
-    "recordio": bench_recordio,
-    "stream": bench_stream,
-    "allreduce_mesh8": bench_allreduce_mesh8,
-    "sp_mesh8": bench_sp_mesh8,
+    "libsvm": (bench_libsvm, "libsvm_ingest_to_device"),
+    "fm_train": (bench_fm_train, "fm_train_stream"),
+    "libfm": (bench_libfm, "libfm_ingest_to_device"),
+    "sharded": (bench_sharded, "libfm_sharded4_ingest"),
+    "allreduce": (bench_allreduce, "allreduce_singleton_d2d_bw"),
+    "remote_ingest": (bench_remote_ingest, "remote_ingest_2workers"),
+    "ingest_scale": (bench_ingest_scale, "ingest_worker_scaling"),
+    "csv": (bench_csv, "csv_parse_rowblocks"),
+    "recordio": (bench_recordio, "recordio_partitioned_read"),
+    "stream": (bench_stream, "stream_read"),
+    "allreduce_mesh8": (bench_allreduce_mesh8, "allreduce_mesh8_psum_wall"),
+    "sp_mesh8": (bench_sp_mesh8, "sp_mesh8_attention_wall"),
 }
 
 
@@ -604,8 +614,12 @@ if os.environ.get("DMLC_SUITE_TEST_HANG") == "1":
         time.sleep(3600)
         return {"metric": "_hang"}
 
-    ALL["_hang"] = _bench_hang
+    ALL["_hang"] = (_bench_hang, "_hang")
     HOST_ONLY.add("_hang")
+
+
+# derived, never hand-maintained: the registry is the single source of truth
+METRIC_OF = {name: metric for name, (_, metric) in ALL.items()}
 
 
 def run_one(name: str) -> None:
@@ -634,11 +648,31 @@ def run_one(name: str) -> None:
         bench.require_tpu_or_exit(platform)
     log(f"{name}: running on platform={platform}")
     try:
-        r = ALL[name]()
+        r = ALL[name][0]()
     except Exception as e:  # noqa: BLE001 - report and continue
-        r = {"metric": name, "error": str(e)}
+        r = {"metric": METRIC_OF.get(name, name), "error": str(e)}
     r["platform"] = platform
     print(json.dumps(r), flush=True)
+
+
+def resolve_picks(argv) -> list:
+    """Config run list: explicit argv wins verbatim; otherwise the registry
+    default order, optionally reordered by DMLC_SUITE_PRIORITY (harvest
+    knob: listed configs run first so a short-lived grant reaches the
+    never-measured ones, the REST keep their default order — the registry
+    stays the single source of truth, so configs added later still run
+    even if the env var goes stale; unknown names fail loudly)."""
+    picks = list(argv) or [n for n in ALL if n not in DEFAULT_SKIP]
+    prio = [p for p in os.environ.get("DMLC_SUITE_PRIORITY", "").split(",")
+            if p]
+    if prio and not argv:
+        unknown = [p for p in prio if p not in ALL]
+        if unknown:
+            raise SystemExit(f"DMLC_SUITE_PRIORITY names unknown configs: "
+                             f"{unknown} (have: {list(ALL)})")
+        picks = [p for p in prio if p in picks] + [p for p in picks
+                                                   if p not in prio]
+    return picks
 
 
 def main() -> None:
@@ -646,7 +680,7 @@ def main() -> None:
     if argv[:1] == ["--one"]:
         run_one(argv[1])
         return
-    picks = argv or [n for n in ALL if n not in DEFAULT_SKIP]
+    picks = resolve_picks(argv)
     # each config runs in its own timeout-bounded subprocess: a wedged
     # tunnel RPC (observed r03: one h2d pending >1h inside fm_train) costs
     # that config, not the rest of the suite — and the claim is released
@@ -682,7 +716,8 @@ def main() -> None:
             env["DMLC_FORCE_CPU"] = "1"
     for name in picks:
         if tpu_lost and name not in CPU_MESH | HOST_ONLY:
-            r = {"metric": name, "error": "skipped: TPU grant lost earlier"}
+            r = {"metric": METRIC_OF.get(name, name),
+                 "error": "skipped: TPU grant lost earlier"}
             results.append(r)
             print(json.dumps(r), flush=True)
             write_artifact(platform_of(results))
@@ -696,15 +731,16 @@ def main() -> None:
             line = next((ln for ln in reversed(p.stdout.strip().splitlines())
                          if ln.startswith("{")), None)
             if p.returncode == 9:
-                r = {"metric": name, "error": "no TPU grant (rc 9)"}
+                r = {"metric": METRIC_OF.get(name, name),
+                     "error": "no TPU grant (rc 9)"}
                 tpu_lost = True      # don't re-pay the probe wait per config
             elif line is None:
-                r = {"metric": name,
+                r = {"metric": METRIC_OF.get(name, name),
                      "error": f"no JSON from config (rc {p.returncode})"}
             else:
                 r = json.loads(line)
         except subprocess.TimeoutExpired:
-            r = {"metric": name,
+            r = {"metric": METRIC_OF.get(name, name),
                  "error": f"timeout after {timeout_s}s (wedged tunnel?)"}
             # a timed-out TPU config usually means the grant vanished and
             # the child wedged in backend init (r04: recordio hung 1500s

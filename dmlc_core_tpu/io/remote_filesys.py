@@ -626,15 +626,14 @@ class _WebHDFSReadStream(RangedReadStream):
     hdfsPread positional read, `hdfs_filesys.cc:31-55`, onto REST)."""
 
     def __init__(self, scheme: str, netloc: str, path: str, size: int,
-                 user: Optional[str]) -> None:
+                 auth: Dict[str, str]) -> None:
         super().__init__(scheme, netloc, path, size=size)
-        self._user = user
+        self._auth = auth
 
     def _fetch(self, start: int, end_excl: int) -> bytes:
         q = {"op": "OPEN", "offset": str(start),
              "length": str(end_excl - start), "noredirect": "true"}
-        if self._user:
-            q["user.name"] = self._user
+        q.update(self._auth)
         qs = urllib.parse.urlencode(q)
         status, hdrs, data = _http_request(
             self._scheme, self._netloc, "GET", f"{self._path_qs}?{qs}", {})
@@ -651,7 +650,13 @@ class WebHDFSFileSystem(FileSystem):
     """``hdfs://host:port/path`` over WebHDFS REST (reference wraps libhdfs
     JNI, `hdfs_filesys.cc`; same surface, no JVM dependency).
 
-    Env: ``DMLC_WEBHDFS_SCHEME`` (default http), ``HADOOP_USER_NAME``.
+    Env: ``DMLC_WEBHDFS_SCHEME`` (default http), ``HADOOP_USER_NAME``,
+    ``DMLC_WEBHDFS_TOKEN`` — a Hadoop delegation token appended as
+    ``delegation=`` to every request.  This is the standard way into a
+    kerberized cluster without SPNEGO on the client: obtain the token
+    out-of-band (``hdfs fetchdt`` after kinit, or from the YARN AM's
+    credentials) and export it.  When the token is set, ``user.name`` is
+    omitted — Hadoop rejects requests carrying both.
     The URI host is the namenode ``host:port`` (reference connect,
     `hdfs_filesys.cc:94`).
     """
@@ -661,16 +666,21 @@ class WebHDFSFileSystem(FileSystem):
         path = urllib.parse.quote(uri.name, safe="/")
         return scheme, uri.host, f"/webhdfs/v1{path}"
 
-    def _user(self) -> Optional[str]:
-        return os.environ.get("HADOOP_USER_NAME")
+    @staticmethod
+    def _auth_params() -> Dict[str, str]:
+        """delegation token > user.name > nothing (simple-auth clusters)."""
+        token = os.environ.get("DMLC_WEBHDFS_TOKEN")
+        if token:
+            return {"delegation": token}
+        user = os.environ.get("HADOOP_USER_NAME")
+        return {"user.name": user} if user else {}
 
     def _op(self, uri: URI, method: str, op: str,
             extra: Optional[Dict[str, str]] = None,
             body: bytes = b"") -> Tuple[int, Dict[str, str], bytes]:
         scheme, netloc, path = self._base(uri)
         q = {"op": op}
-        if self._user():
-            q["user.name"] = self._user()  # type: ignore[assignment]
+        q.update(self._auth_params())
         q.update(extra or {})
         qs = urllib.parse.urlencode(q)
         return _http_request(scheme, netloc, method, f"{path}?{qs}", {}, body)
@@ -715,7 +725,7 @@ class WebHDFSFileSystem(FileSystem):
             info = self.get_path_info(uri)
             scheme, netloc, path = self._base(uri)
             return _WebHDFSReadStream(scheme, netloc, path, info.size,
-                                      self._user())
+                                      self._auth_params())
         check(mode == "w", "webhdfs supports modes 'r' and 'w' only")
         part = int(os.environ.get("DMLC_WEBHDFS_PART_SIZE", str(8 << 20)))
         return _WebHDFSWriteStream(self, uri, max(1, part))

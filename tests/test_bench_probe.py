@@ -100,6 +100,43 @@ def test_harvest_commit_suite_merge():
     assert "error" in {r["metric"]: r for r in m2["results"]}["libsvm"]
 
 
+def test_suite_error_rows_use_headline_metric_keys():
+    """Error/skip rows must carry the config's HEADLINE metric name, not
+    the config name: the merge pairs rows by metric key, so a "libfm"
+    error row beside a measured "libfm_ingest_to_device" row would never
+    be suppressed by the measured entry (observed in the r04 artifact).
+    METRIC_OF is derived from the registry, so the real risk is a
+    registered name drifting from what the config fn emits — cross-check
+    the cheap host-only config end-to-end."""
+    import benchmarks.bench_suite as bs
+
+    assert set(bs.METRIC_OF) == set(bs.ALL)
+    r = bs.bench_stream()
+    assert r["metric"] == bs.METRIC_OF["stream"]
+
+
+def test_suite_priority_env_reorders_without_forking_registry(monkeypatch):
+    """DMLC_SUITE_PRIORITY puts listed configs first and keeps the rest in
+    default order, so a harvest knob can't silently drop configs added to
+    the registry later; unknown names fail loudly; explicit argv wins."""
+    import benchmarks.bench_suite as bs
+
+    default = [n for n in bs.ALL if n not in bs.DEFAULT_SKIP]
+    monkeypatch.delenv("DMLC_SUITE_PRIORITY", raising=False)
+    assert bs.resolve_picks([]) == default
+    monkeypatch.setenv("DMLC_SUITE_PRIORITY", "allreduce,ingest_scale")
+    got = bs.resolve_picks([])
+    assert got[:2] == ["allreduce", "ingest_scale"]
+    assert sorted(got) == sorted(default)          # nothing dropped/added
+    assert [p for p in got[2:]] == [p for p in default
+                                    if p not in got[:2]]  # rest keep order
+    assert bs.resolve_picks(["csv"]) == ["csv"]    # argv wins verbatim
+    monkeypatch.setenv("DMLC_SUITE_PRIORITY", "nonesuch")
+    import pytest as _pytest
+    with _pytest.raises(SystemExit):
+        bs.resolve_picks([])
+
+
 def test_suite_hang_isolation(tmp_path):
     """A wedged config child (simulated 1h sleep — the r3 tunnel wedge) is
     killed by the per-config timeout and the NEXT config still runs and

@@ -227,6 +227,7 @@ class _FakeWebHDFSHandler(BaseHTTPRequestHandler):
     datanode role."""
     files = {}       # "/path" -> bytes
     data_requests = []  # (method, path) seen by the fake datanode
+    namenode_queries = []  # (method, query dict) seen by the fake namenode
 
     def log_message(self, *a):
         pass
@@ -249,6 +250,7 @@ class _FakeWebHDFSHandler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         path = parsed.path[len("/webhdfs/v1"):]
+        self.namenode_queries.append(("GET", q))
         op = q.get("op")
         if op == "GETFILESTATUS":
             if path not in self.files:
@@ -298,6 +300,7 @@ class _FakeWebHDFSHandler(BaseHTTPRequestHandler):
         # namenode: RENAME is answered inline; CREATE points at the datanode
         path = parsed.path[len("/webhdfs/v1"):]
         q = dict(urllib.parse.parse_qsl(parsed.query))
+        self.namenode_queries.append(("PUT", q))
         if q.get("op") == "RENAME":
             dest = q.get("destination", "")
             ok = path in self.files
@@ -382,6 +385,7 @@ def s3_server(monkeypatch):
 def hdfs_server():
     _FakeWebHDFSHandler.files = {}
     _FakeWebHDFSHandler.data_requests = []
+    _FakeWebHDFSHandler.namenode_queries = []
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeWebHDFSHandler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -622,6 +626,33 @@ def test_webhdfs_streaming_write_appends(hdfs_server, monkeypatch):
     reqs = [r for r in h.data_requests if r[1] == "/out/big.bin"]
     assert len(reqs) == 3                      # 1024 + 1024 + 512
     assert reqs[0][0] == "PUT" and {r[0] for r in reqs[1:]} == {"POST"}
+
+
+def test_webhdfs_delegation_token(hdfs_server, monkeypatch):
+    """DMLC_WEBHDFS_TOKEN rides every namenode request as ``delegation=``
+    and suppresses ``user.name`` (Hadoop rejects both together) — the
+    kerberized-cluster path: fetch the token out-of-band, export it."""
+    srv, h = hdfs_server
+    host = f"127.0.0.1:{srv.server_address[1]}"
+    monkeypatch.setenv("HADOOP_USER_NAME", "alice")     # must be overridden
+    monkeypatch.setenv("DMLC_WEBHDFS_TOKEN", "HAAEdG9r")
+    h.files["/secure/f.bin"] = b"secret bytes"
+    s = open_seek_stream_for_read(f"hdfs://{host}/secure/f.bin")
+    assert s.read() == b"secret bytes"
+    with open_stream(f"hdfs://{host}/secure/out.bin", "w") as w:
+        w.write(b"tokenized write")
+    assert h.files["/secure/out.bin"] == b"tokenized write"
+    assert h.namenode_queries, "fake namenode saw no requests"
+    for method, q in h.namenode_queries:
+        assert q.get("delegation") == "HAAEdG9r", (method, q)
+        assert "user.name" not in q, (method, q)
+    # without the token, user.name comes back
+    monkeypatch.delenv("DMLC_WEBHDFS_TOKEN")
+    h.namenode_queries.clear()
+    get_filesystem(URI(f"hdfs://{host}/secure/f.bin")).get_path_info(
+        URI(f"hdfs://{host}/secure/f.bin"))
+    assert all(q.get("user.name") == "alice" and "delegation" not in q
+               for _, q in h.namenode_queries)
 
 
 def test_webhdfs_write(hdfs_server):
