@@ -312,8 +312,12 @@ def measure_ours(platform_override: str = "", interleave=None):
     else:
         (pt, cm, shape), = combos
         run_once(pt, cm, *shape)  # warm-up: compile/caches
+    # 5 timed pairs on the tunnelled device, 3 on cpu: the link drifts
+    # 1.7-2.6x within a window and r04's 3-run phase landed entirely inside
+    # one collapse (137-187 MB/s timed vs 467 probe minutes earlier) — more
+    # pairs cost ~1 min of grant and bound the weather's leverage
     runs = []
-    for _ in range(3):
+    for _ in range(5 if platform == "tpu" else 3):
         runs.append(run_once(pt, cm, *shape))
         if interleave is not None:
             # reference run INSIDE the same minute as ours: the shared
