@@ -65,6 +65,25 @@ micro() {
 }
 
 suite() {
+    # propagate the root bench's probe-winning transfer config to the
+    # suite's ingest configs (they honor these envs; without them each
+    # config runs pt=1 defaults — 3.5x slower than the tuned shape on the
+    # 04:5x verified 35 MB/s link: 20 vs 72 MB/s)
+    if [ -s /tmp/bench_tpu.json ]; then
+        eval "$(python - <<'PYEOF'
+import json
+try:
+    d = json.load(open("/tmp/bench_tpu.json"))
+    # build both lines BEFORE printing: a missing key must fall back to
+    # defaults atomically, never eval a half-propagated config
+    out = (f"export DMLC_BENCH_PUT_THREADS={int(d['put_threads'])}\n"
+           f"export DMLC_BENCH_COMPACT={1 if d['wire_compact'] else 0}")
+    print(out)
+except Exception:
+    pass
+PYEOF
+)"
+    fi
     # priority knob, not an explicit list: configs with NO on-chip
     # measurement yet run first (harvest_commit merges across windows, so
     # re-running an already-measured config only refreshes it — but a
