@@ -158,6 +158,36 @@ def require_tpu_or_exit(platform: str) -> None:
         sys.exit(9)
 
 
+def measure_link_verified(mb: int = 16, reps: int = 3) -> float:
+    """Verified single-stream h2d rate: per-rep mutated bytes (the tunnel
+    runtime dedupes identical puts) and a d2h value read of EVERY put
+    handle as the only accepted completion proof (ready-futures resolve
+    early — see consume_batch; same policy as tpu_diag.bench_put_bw /
+    bench_put_streams, the canonical link probes).  The per-handle reads
+    sit inside the window, so this is a conservative lower bound (~1 RTT
+    per rep).  Returns MB/s, or 0.0 if anything fails (the caller treats
+    the link measurement as optional context)."""
+    try:
+        import jax
+        import numpy as np
+        dev = jax.devices()[0]
+        buf = np.arange(mb * (1 << 20) // 4, dtype=np.int32)
+        h = jax.device_put(buf, dev)                       # warm
+        int(np.asarray(h[:1])[0])
+        t0 = time.perf_counter()
+        handles = []
+        for rep in range(reps):
+            buf[rep] = -rep - 1
+            handles.append(jax.device_put(buf, dev))
+        for h in handles:                 # completion proof, every put
+            int(np.asarray(h[:1])[0])
+        dt = time.perf_counter() - t0
+        return reps * mb / dt
+    except Exception as e:  # noqa: BLE001
+        log(f"link probe failed ({type(e).__name__}: {e}) — omitting")
+        return 0.0
+
+
 def consume_batch(acc, batch):
     """Fold one device batch into a 1-element on-device accumulator.
     Timed ingest loops thread every batch through this so that
@@ -275,7 +305,10 @@ def measure_ours(platform_override: str = "", interleave=None):
                 f"{len(blob) / (1 << 20) / dt:.1f} MB/s")
     pt_env = os.environ.get("DMLC_BENCH_PUT_THREADS")
     cm_env = os.environ.get("DMLC_BENCH_COMPACT")
-    pts = [int(pt_env)] if pt_env else [1, 4]
+    # pt=2 joined the grid after the hardened diag showed 2 streams are
+    # the verified-link sweet spot (43.1 vs 34.5 MB/s 1-stream, 33.9 at 4
+    # — TPU_DIAG_r04 04:4x window)
+    pts = [int(pt_env)] if pt_env else [1, 2, 4]
     cms = [cm_env != "0"] if cm_env is not None else [True, False]
     shapes = [(batch_rows, nnz_cap)]
     if platform == "cpu":
@@ -412,7 +445,7 @@ def main() -> None:
     baseline = sum(bases) / len(bases)
     log("baseline samples: " + ", ".join(f"{b:.1f}" for b in bases)
         + f" MB/s → using {baseline:.1f}")
-    print(json.dumps({
+    out = {
         "metric": "libsvm_ingest_to_device_batches",
         "value": round(value, 2),
         "unit": "MB/s",
@@ -426,7 +459,34 @@ def main() -> None:
         # cpu path only (0.0 under DMLC_REQUIRE_TPU): recorded so
         # value/mean(recorded baselines) reproduces vs_baseline exactly
         "baseline_preprobe": round(base1, 1),
-    }))
+    }
+    if platform == "tpu":
+        # daemon thread + bounded join: the probe is optional context, and
+        # this link's documented failure mode is a HANG (r03: one RPC
+        # pending >1h) — a wedged tunnel here must not forfeit the
+        # driver's JSON line for an otherwise-complete run
+        import threading
+        box = [0.0]
+
+        def _probe():
+            box[0] = measure_link_verified()
+
+        th = threading.Thread(target=_probe, daemon=True)
+        th.start()
+        th.join(timeout=90)
+        link = box[0] if not th.is_alive() else 0.0
+        if th.is_alive():
+            log("link probe still running at 90s — omitting")
+        if link > 0:
+            # context the ratio needs on tunnel hardware: the reference
+            # binary parses host-locally and never crosses a link, so when
+            # the verified link rate is below the host parse rate,
+            # vs_baseline reports link weather, not pipeline quality
+            # (docs/perf.md "What the read-back fix re-based").  The
+            # driver-recorded artifact carries the evidence inline.
+            out["link_mbps_verified"] = round(link, 1)
+            out["value_over_link"] = round(value / link, 2)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

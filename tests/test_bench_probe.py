@@ -40,15 +40,15 @@ def test_probe_flow_tpu_configspace_on_cpu(bench_mod, capfd):
     # tpu mode runs 5 timed pairs (drift-bounding, bench.py) vs cpu's 3
     assert len(runs) == 5 and all(r > 0 for r in runs)
     assert mean > 0
-    # the full config space was screened: 2 pt × 2 compact × 3 shapes
+    # the full config space was screened: 3 pt × 2 compact × 3 shapes
     assert "config probe:" in err
     probe_line = [ln for ln in err.splitlines() if "config probe:" in ln][0]
-    assert probe_line.count("pt=") >= 12, probe_line
+    assert probe_line.count("pt=") >= 18, probe_line
     for frag in ("rows=16384", "rows=49152", "rows=147456",
                  "compact=1", "compact=0"):
         assert frag in probe_line, (frag, probe_line)
     # the winner is one of the probed configs
-    assert pt in (1, 4) and cm in (True, False)
+    assert pt in (1, 2, 4) and cm in (True, False)
     assert rows in (16384, 49152, 147456)
 
 
@@ -162,3 +162,42 @@ def test_suite_hang_isolation(tmp_path):
     hang, stream = data["results"]
     assert hang["metric"] == "_hang" and "timeout" in hang["error"]
     assert "error" not in stream and stream.get("unit") == "MB/s"
+
+
+def test_consume_batch_completion_accumulator(bench_mod):
+    """The timed-ingest completion proof: every batch folds one element
+    into an on-device accumulator, and prove_consumed forces a VALUE read
+    — the only sync the tunnel runtime cannot fake (docs/perf.md
+    'Benchmarking against a tunnel runtime')."""
+    import jax.numpy as jnp
+
+    acc = None
+    total = 0.0
+    for i in range(5):
+        batch = {"vals": jnp.full((3, 4), float(i + 1))}
+        acc = bench_mod.consume_batch(acc, batch)
+        total += float(i + 1)
+    assert float(acc) == total          # first element of each batch
+    bench_mod.prove_consumed(acc)       # must not raise
+    bench_mod.prove_consumed(None)      # empty stream: no-op
+
+
+def test_measure_link_verified_cpu(bench_mod):
+    """The link probe must survive any backend (it is optional context in
+    the bench JSON): on CPU it measures host 'puts' and returns > 0; it
+    must never raise."""
+    mbps = bench_mod.measure_link_verified(mb=1, reps=2)
+    assert mbps > 0
+
+
+def test_train_configs_registered_with_metric_keys():
+    """deepfm_train/ffm_train joined the registry (VERDICT r3 #3): their
+    error rows must pair with measured rows across harvest windows, which
+    the merge does by metric key."""
+    import benchmarks.bench_suite as bs
+
+    assert bs.METRIC_OF["deepfm_train"] == "deepfm_train_stream"
+    assert bs.METRIC_OF["ffm_train"] == "ffm_train_stream"
+    # never accidentally host-only or cpu-mesh: these need the chip
+    assert "deepfm_train" not in bs.HOST_ONLY | bs.CPU_MESH
+    assert "ffm_train" not in bs.HOST_ONLY | bs.CPU_MESH
