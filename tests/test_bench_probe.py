@@ -201,3 +201,13 @@ def test_train_configs_registered_with_metric_keys():
     # never accidentally host-only or cpu-mesh: these need the chip
     assert "deepfm_train" not in bs.HOST_ONLY | bs.CPU_MESH
     assert "ffm_train" not in bs.HOST_ONLY | bs.CPU_MESH
+
+
+def test_cache_config_registered_host_only():
+    """cache_build_replay reproduces the reference's disk_row_iter
+    self-report (BASELINE.md instrumentation table); it is pure host/disk
+    and must never wait on a tunnel probe."""
+    import benchmarks.bench_suite as bs
+
+    assert bs.METRIC_OF["cache"] == "cache_build_replay"
+    assert "cache" in bs.HOST_ONLY
