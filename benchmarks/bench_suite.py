@@ -306,6 +306,21 @@ def bench_deepfm_train() -> dict:
             "feed_rows_s": round(feed_s, 0), "final_loss": round(loss, 4)}
 
 
+def bench_dcn_train() -> dict:
+    """DCNv2 end-to-end training stream: one sparse gather then L dense
+    [D,D] cross matmuls per step — the family member whose per-step work
+    is almost entirely MXU."""
+    from dmlc_core_tpu.models.dcn import DCNv2
+
+    path = "/tmp/bench_suite.libsvm"
+    _gen_libsvm(path)
+    rows_s, mbps, feed_s, loss = _train_rate(
+        DCNv2(num_features=1 << 20, dim=32, layers=3), path, "libsvm")
+    return {"metric": "dcn_train_stream", "value": round(rows_s, 0),
+            "unit": "rows/s", "text_mbps": round(mbps, 1),
+            "feed_rows_s": round(feed_s, 0), "final_loss": round(loss, 4)}
+
+
 def bench_ffm_train() -> dict:
     """FieldAwareFM training stream over libfm data with the per-value
     field ids shipped to the device (fields=True path — the libfm third
@@ -741,6 +756,7 @@ ALL = {
     "fm_train": (bench_fm_train, "fm_train_stream"),
     "deepfm_train": (bench_deepfm_train, "deepfm_train_stream"),
     "ffm_train": (bench_ffm_train, "ffm_train_stream"),
+    "dcn_train": (bench_dcn_train, "dcn_train_stream"),
     "libfm": (bench_libfm, "libfm_ingest_to_device"),
     "sharded": (bench_sharded, "libfm_sharded4_ingest"),
     "allreduce": (bench_allreduce, "allreduce_singleton_d2d_bw"),

@@ -4,6 +4,7 @@ from .sparse import (SparseLogReg, FactorizationMachine,  # noqa: F401
                      weighted_bce, weighted_mse)
 from .ffm import FieldAwareFM  # noqa: F401
 from .deep import DeepFM  # noqa: F401
+from .dcn import DCNv2  # noqa: F401
 from .ftrl import ftrl, FTRLState  # noqa: F401
 from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F401
                     param_shardings, shard_params, fit_stream,
@@ -12,7 +13,7 @@ from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F4
 
 __all__ = [
     "SparseLogReg", "FactorizationMachine", "FieldAwareFM", "DeepFM",
-    "weighted_bce", "weighted_mse",
+    "DCNv2", "weighted_bce", "weighted_mse",
     "make_train_step", "make_eval_step", "batch_sharding", "param_shardings",
     "shard_params", "fit_stream", "streaming_auc", "auc_from_histograms",
     "evaluate_stream",

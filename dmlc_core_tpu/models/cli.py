@@ -54,6 +54,13 @@ def _deepfm(p: "TrainParams"):
                   layers=p.layers, l2=p.l2, task=p.task)
 
 
+@MODEL_REGISTRY.register("dcn", "deep & cross network v2")
+def _dcn(p: "TrainParams"):
+    from .dcn import DCNv2
+    return DCNv2(num_features=p.features, dim=p.dim,
+                 layers=p.layers, l2=p.l2, task=p.task)
+
+
 class TrainParams(Parameter):
     """All knobs of a training run (printable via ``--help``/doc_string)."""
 
@@ -75,15 +82,19 @@ class TrainParams(Parameter):
                    help="input format ('auto': ?format= URI arg, then file "
                         "suffix .libsvm/.libfm/.csv, then libsvm; ffm "
                         "implies libfm)")
+    # enum derives from the registry (decorators above run before this
+    # class body), so registering a model IS adding it to the CLI — a
+    # hardcoded list silently orphaned 'dcn' once (caught in r4 review)
     model = field(str, default="fm",
-                  enum=["logreg", "fm", "ffm", "deepfm"],
+                  enum=sorted(MODEL_REGISTRY.list_names()),
                   help="registered model name")
     features = field(int, default=1 << 20, lower_bound=1,
                      help="feature-space size (ids hashed into it)")
     fields = field(int, default=40, lower_bound=1,
                    help="field count (ffm)")
     dim = field(int, default=16, lower_bound=1, help="factor dimension")
-    layers = field(int, default=2, lower_bound=1, help="tower depth (deepfm)")
+    layers = field(int, default=2, lower_bound=1,
+                   help="depth: deepfm tower / dcn cross layers")
     task = field(str, default="binary", enum=["binary", "regression"])
     epochs = field(int, default=1, lower_bound=1)
     batch_rows = field(int, default=4096, lower_bound=1)

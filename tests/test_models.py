@@ -274,3 +274,97 @@ def test_evaluate_stream_helper(tmp_path):
     loader.close()
     assert r["accuracy"] > 0.85 and 0.85 < r["auc"] <= 1.0, r
     assert r["weight"] == 600
+
+
+def test_dcn_learns_interactions(tmp_path):
+    """The cross network must capture a pure pairwise interaction (XOR on
+    two one-hot groups) that the linear term cannot — same bar as the FM
+    interaction test, met by learned cross weights instead of a fixed
+    inner-product form."""
+    from dmlc_core_tpu.models.dcn import DCNv2
+
+    rng = np.random.default_rng(4)
+    path = str(tmp_path / "xor.libsvm")
+    with open(path, "w") as fh:
+        for _ in range(4000):
+            a, b = rng.integers(0, 2), rng.integers(0, 2)
+            y = a ^ b
+            feats = [f"{0 if a else 1}:1", f"{2 if b else 3}:1"]
+            fh.write(f"{y} " + " ".join(feats) + "\n")
+    loader = DeviceLoader(create_parser(path), batch_rows=256, nnz_cap=1024)
+    model = DCNv2(num_features=4, dim=8, layers=2)
+    params, _ = fit_stream(model, loader, epochs=6,
+                           optimizer=optax.adam(0.1), log_every=0)
+    ev = make_eval_step(model)
+    loader.before_first()
+    corr = tot = 0.0
+    for b in loader:
+        c, t = ev(params, b)
+        corr += float(c)
+        tot += float(t)
+    loader.close()
+    assert corr / tot > 0.95
+
+
+def test_dcn_cross_layer_closed_form():
+    """One cross layer is x0*(x0@W + b) + x0 exactly (DCNv2 definition) —
+    pin the scan against a hand-computed numpy reference so a future
+    stacking/scan refactor cannot silently reorder the recurrence."""
+    from dmlc_core_tpu.models.dcn import DCNv2
+
+    rng = np.random.default_rng(5)
+    B, D = 4, 6
+    x0 = rng.standard_normal((B, D)).astype(np.float32)
+    w1 = rng.standard_normal((D, D)).astype(np.float32)
+    b1 = rng.standard_normal(D).astype(np.float32)
+    w2 = rng.standard_normal((D, D)).astype(np.float32)
+    b2 = rng.standard_normal(D).astype(np.float32)
+    cross = {"w": jnp.stack([w1, w2]), "b": jnp.stack([b1, b2])}
+    x1 = x0 * (x0 @ w1 + b1) + x0
+    x2 = x0 * (x1 @ w2 + b2) + x1            # note: x0, not x1, multiplies
+    got = DCNv2._cross(cross, jnp.asarray(x0))
+    np.testing.assert_allclose(np.asarray(got), x2, rtol=1e-5, atol=1e-5)
+
+
+def test_dcn_rowmajor_forward_matches_flat(tmp_path):
+    """Both batch layouts produce the same DCN scores on the same rows
+    (the family-wide contract, VERDICT r2 #3)."""
+    from dmlc_core_tpu.models.dcn import DCNv2
+
+    rng = np.random.default_rng(6)
+    path = tmp_path / "d.libsvm"
+    with open(path, "w") as f:
+        for i in range(200):
+            n = int(rng.integers(1, 6))
+            idx = sorted(rng.choice(512, n, replace=False).tolist())
+            f.write(f"{i % 2} " + " ".join(
+                f"{j}:{rng.random():.4f}" for j in idx) + "\n")
+    with DeviceLoader(create_parser(str(path)), batch_rows=64,
+                      nnz_cap=1024) as ld:
+        flat_batches = list(ld)
+    with DeviceLoader(create_parser(str(path)), batch_rows=64, nnz_cap=8,
+                      layout="rowmajor") as ld:
+        row_batches = list(ld)
+    model = DCNv2(num_features=512, dim=8, layers=2)
+    params = model.init(jax.random.PRNGKey(0))
+    keys = jax.random.split(jax.random.PRNGKey(7), len(params))
+    params = {k: jax.tree_util.tree_map(
+        lambda v, key=key: v + 0.1 * jax.random.normal(key, v.shape, v.dtype),
+        v) for (k, v), key in zip(sorted(params.items()), keys)}
+    for fb, rb in zip(flat_batches, row_batches):
+        np.testing.assert_allclose(
+            np.asarray(model.forward(params, fb)),
+            np.asarray(model.forward(params, rb)),
+            rtol=2e-4, atol=2e-5)
+
+
+def test_dcn_registered_in_cli():
+    """Registered AND reachable: the CLI enum derives from the registry,
+    so a registered model must validate as a TrainParams.model value (a
+    hardcoded enum once orphaned dcn — r4 review catch)."""
+    from dmlc_core_tpu.models.cli import MODEL_REGISTRY, TrainParams
+
+    assert MODEL_REGISTRY.find("dcn") is not None
+    p = TrainParams()
+    p.init({"data": "x.libsvm", "model": "dcn"})
+    assert p.model == "dcn"
