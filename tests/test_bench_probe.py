@@ -211,3 +211,21 @@ def test_cache_config_registered_host_only():
 
     assert bs.METRIC_OF["cache"] == "cache_build_replay"
     assert "cache" in bs.HOST_ONLY
+
+
+def test_probe_deadline_truncates_screen(bench_mod, capfd, monkeypatch):
+    """DMLC_BENCH_DEADLINE_S bounds the config screen: the driver runs
+    bench.py under a finite timeout, and a truncated probe that proceeds
+    with best-so-far beats a killed process falling back to CPU numbers.
+    With an already-expired deadline the probe screens nothing, falls to
+    the default config, and the timed runs still complete."""
+    monkeypatch.setenv("DMLC_BENCH_DEADLINE_S", "0")
+    mean, runs, (pt, cm, rows), platform = bench_mod.measure_ours(
+        platform_override="tpu")
+    err = capfd.readouterr().err
+    assert "probe deadline hit" in err
+    assert "no combos screened" in err
+    assert mean > 0 and len(runs) == 5
+    # fallback = best-guess-first combo (pt=4, compact first on "tpu"),
+    # not a hardcoded worst guess
+    assert (pt, cm) == (4, True)
