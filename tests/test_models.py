@@ -74,73 +74,73 @@ def test_fm_learns_interactions(tmp_path):
     assert corr / tot > 0.95
 
 
-def test_sharded_step_matches_single_device(tmp_path):
+def _run_sharded(model, path, mesh_arg, table_shard="dim"):
+    """One training pass under the family sharding recipe — the shared
+    harness of every sharded-vs-single equivalence test (loader args,
+    recipe application, step loop live HERE once)."""
+    opt = optax.sgd(0.1)
+    loader = DeviceLoader(create_parser(path), batch_rows=64, nnz_cap=1024,
+                          sharding=batch_sharding(mesh_arg))
+    params = model.init(jax.random.PRNGKey(0))
+    params = shard_params(params, param_shardings(
+        model, params, mesh_arg, table_shard=table_shard))
+    opt_state = opt.init(params)
+    step = make_train_step(model, opt, mesh_arg, donate=False)
+    losses = []
+    for batch in loader:
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    loader.close()
+    return losses, params
+
+
+def _mesh_4x2_or_skip():
     devices = jax.devices()
     if len(devices) < 8:
         pytest.skip("needs 8 virtual cpu devices")
-    mesh = Mesh(np.array(devices).reshape(4, 2), ("dp", "mp"))
+    return Mesh(np.array(devices).reshape(4, 2), ("dp", "mp"))
+
+
+def _dcn_factory():
+    from dmlc_core_tpu.models.dcn import DCNv2
+
+    return DCNv2(num_features=64, dim=8, layers=2)
+
+
+@pytest.mark.parametrize("model_factory", [
+    lambda: FactorizationMachine(num_features=64, dim=8),
+    _dcn_factory,
+], ids=["fm", "dcn"])
+def test_sharded_step_matches_single_device(model_factory, tmp_path):
+    """dp batch + dim-sharded factor table: per-step losses must match the
+    single-device run for every family member (nested DCN cross params
+    included), and v really is sharded over mp."""
+    mesh = _mesh_4x2_or_skip()
     rng = np.random.default_rng(2)
     path = str(tmp_path / "s.libsvm")
     write_linear_dataset(path, rng, n=512)
-
-    model = FactorizationMachine(num_features=64, dim=8)
-    opt = optax.sgd(0.1)
-
-    def run(mesh_arg):
-        loader = DeviceLoader(create_parser(path), batch_rows=64, nnz_cap=1024,
-                              sharding=batch_sharding(mesh_arg))
-        params = model.init(jax.random.PRNGKey(0))
-        params = shard_params(params, param_shardings(model, params, mesh_arg))
-        opt_state = opt.init(params)
-        step = make_train_step(model, opt, mesh_arg, donate=False)
-        losses = []
-        for batch in loader:
-            params, opt_state, loss = step(params, opt_state, batch)
-            losses.append(float(loss))
-        loader.close()
-        return losses, params
-
-    losses_single, _ = run(None)
-    losses_mesh, params_mesh = run(mesh)
+    model = model_factory()
+    losses_single, _ = _run_sharded(model, path, None)
+    losses_mesh, params_mesh = _run_sharded(model, path, mesh)
     np.testing.assert_allclose(losses_single, losses_mesh, rtol=2e-4, atol=2e-5)
     # the factor table really is sharded over mp
-    v_shard = params_mesh["v"].sharding
-    assert v_shard.spec == P(None, "mp")
+    assert params_mesh["v"].sharding.spec == P(None, "mp")
 
 
 def test_row_sharded_table_matches_single_device(tmp_path):
     """table_shard='rows' (ps/ep-style feature sharding, SURVEY §5.8):
     losses match the single-device run bit-for-tolerance and each chip
     holds a feature slice of BOTH v and w."""
-    devices = jax.devices()
-    if len(devices) < 8:
-        pytest.skip("needs 8 virtual cpu devices")
-    mesh = Mesh(np.array(devices).reshape(4, 2), ("dp", "mp"))
+    mesh = _mesh_4x2_or_skip()
     rng = np.random.default_rng(4)
     path = str(tmp_path / "r.libsvm")
     write_linear_dataset(path, rng, n=512)
 
     model = FactorizationMachine(num_features=64, dim=8)
-    opt = optax.sgd(0.1)
 
-    def run(mesh_arg, table_shard):
-        loader = DeviceLoader(create_parser(path), batch_rows=64,
-                              nnz_cap=1024,
-                              sharding=batch_sharding(mesh_arg))
-        params = model.init(jax.random.PRNGKey(0))
-        params = shard_params(params, param_shardings(
-            model, params, mesh_arg, table_shard=table_shard))
-        opt_state = opt.init(params)
-        step = make_train_step(model, opt, mesh_arg, donate=False)
-        losses = []
-        for batch in loader:
-            params, opt_state, loss = step(params, opt_state, batch)
-            losses.append(float(loss))
-        loader.close()
-        return losses, params
-
-    losses_single, _ = run(None, "dim")
-    losses_rows, params_rows = run(mesh, "rows")
+    losses_single, _ = _run_sharded(model, path, None)
+    losses_rows, params_rows = _run_sharded(model, path, mesh,
+                                            table_shard="rows")
     np.testing.assert_allclose(losses_single, losses_rows,
                                rtol=2e-4, atol=2e-5)
     assert params_rows["v"].sharding.spec == P("mp", None)
@@ -368,3 +368,4 @@ def test_dcn_registered_in_cli():
     p = TrainParams()
     p.init({"data": "x.libsvm", "model": "dcn"})
     assert p.model == "dcn"
+
