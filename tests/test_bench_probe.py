@@ -229,3 +229,15 @@ def test_probe_deadline_truncates_screen(bench_mod, capfd, monkeypatch):
     # fallback = best-guess-first combo (pt=4, compact first on "tpu"),
     # not a hardcoded worst guess
     assert (pt, cm) == (4, True)
+
+
+def test_integrity_config_bit_exact_on_cpu():
+    """The integrity config's checksum compare must pass on the local
+    backend (whose futures are truthful): a failure here means the
+    checksum plumbing itself is wrong, not the transport."""
+    import benchmarks.bench_suite as bs
+
+    assert bs.METRIC_OF["integrity"] == "ingest_integrity"
+    r = bs.bench_integrity()
+    assert r["value"] == 1.0, r.get("mismatch")
+    assert r["rows"] > 0 and r["nnz"] > 0
