@@ -207,3 +207,26 @@ def test_failure_injection_two_crashes_wide_cohort(tmp_path):
     assert out.stdout.count("INJECTED-CRASH") == 2
     assert out.stdout.count("SURVIVED") == 8
     assert out.stdout.count("attempt 1") == 2   # both reborn workers
+
+
+def test_train_dcn_example(tmp_path):
+    """examples/train_dcn.py runs the full ladder (URI → parse → device
+    batches → jitted DCN step → checkpoint) as a user would invoke it."""
+    import random
+    rnd = random.Random(1)
+    data = tmp_path / "d.libsvm"
+    with open(data, "w") as f:
+        for _ in range(600):
+            k = rnd.randint(1, 6)
+            ent = " ".join(f"{rnd.randint(0, 255)}:{rnd.random():.3f}"
+                           for _ in range(k))
+            f.write(f"{rnd.randint(0, 1)} {ent}\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_dcn.py"),
+         f"file://{data}", "--features", "256", "--dim", "8",
+         "--layers", "2", "--batch-rows", "128", "--nnz-cap", "2048",
+         "--ckpt-dir", str(tmp_path / "ck")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done:" in out.stdout
