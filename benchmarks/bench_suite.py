@@ -355,71 +355,98 @@ def bench_integrity() -> dict:
     from dmlc_core_tpu.data import create_parser
     from dmlc_core_tpu.pipeline import DeviceLoader
 
-    path = "/tmp/bench_suite.libsvm"
-    _gen_libsvm(path)
     M32 = 0xFFFFFFFF
 
     def wsum(a) -> int:                  # wrapping 32-bit reference sum
         return int(np.sum(np.asarray(a).astype(np.int64)) & M32)
 
     bits = np.float32(1.0).view(np.int32)          # weights default
-    host = {"ids": 0, "vals": 0, "labels": 0, "weights": 0,
-            "nnz": 0, "rows": 0}
-    p = create_parser(f"file://{path}", 0, 1, "libsvm")
-    try:
-        for c in p:
-            blk = c.get_block()
-            # slice the CSR payload via offsets, exactly like pack_flat
-            # does: a view-backed block (offsets[0] > 0, or arrays longer
-            # than the block's span) must not leak out-of-block elements
-            # into the host checksum — that would be a false corruption
-            # alarm, not a detection
-            lo, hi = int(blk.offsets[0]), int(blk.offsets[-1])
-            host["ids"] = (host["ids"] + wsum(blk.indices[lo:hi])) & M32
-            host["vals"] = (host["vals"] + wsum(
-                blk.values[lo:hi].view(np.int32))) & M32
-            host["labels"] = (host["labels"]
-                              + wsum(blk.labels.view(np.int32))) & M32
-            w = (blk.weights.view(np.int32) if blk.weights is not None
-                 else np.full(blk.size, bits, np.int32))
-            host["weights"] = (host["weights"] + wsum(w)) & M32
-            host["nnz"] += hi - lo
-            host["rows"] += blk.size
-    finally:
-        p.close()
 
-    @jax.jit
-    def batch_sums(b):
-        i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
-        return (jnp.sum(b["ids"]), jnp.sum(i32(b["vals"])),
-                jnp.sum(i32(b["labels"])), jnp.sum(i32(b["weights"])),
-                b["row_ptr"][-1])
+    def check_one(path: str, fmt: str, want_fields: bool) -> dict:
+        keys = ("ids", "vals", "labels", "weights") + (
+            ("fields",) if want_fields else ())
+        host = dict.fromkeys(keys + ("nnz", "rows"), 0)
+        p = create_parser(f"file://{path}", 0, 1, fmt)
+        try:
+            for c in p:
+                blk = c.get_block()
+                # slice the CSR payload via offsets, exactly like
+                # pack_flat does: a view-backed block must not leak
+                # out-of-block elements into the host checksum — that
+                # would be a false corruption alarm, not a detection
+                lo, hi = int(blk.offsets[0]), int(blk.offsets[-1])
+                host["ids"] = (host["ids"]
+                               + wsum(blk.indices[lo:hi])) & M32
+                host["vals"] = (host["vals"] + wsum(
+                    blk.values[lo:hi].view(np.int32))) & M32
+                host["labels"] = (host["labels"]
+                                  + wsum(blk.labels.view(np.int32))) & M32
+                w = (blk.weights.view(np.int32) if blk.weights is not None
+                     else np.full(blk.size, bits, np.int32))
+                host["weights"] = (host["weights"] + wsum(w)) & M32
+                if want_fields:
+                    host["fields"] = (host["fields"]
+                                      + wsum(blk.fields[lo:hi])) & M32
+                host["nnz"] += hi - lo
+                host["rows"] += blk.size
+        finally:
+            p.close()
 
-    dev = {"ids": 0, "vals": 0, "labels": 0, "weights": 0, "nnz": 0}
-    # nnz_cap sized so no row is truncated (host ref has no truncation)
-    loader = DeviceLoader(create_parser(f"file://{path}", 0, 1, "libsvm"),
-                          batch_rows=4096, nnz_cap=262144, prefetch=4,
-                          put_threads=4, wire_compact=True)
-    try:
-        for b in loader:
-            s = [int(np.asarray(x)) for x in batch_sums(b)]
-            for k, v in zip(("ids", "vals", "labels", "weights"), s):
-                dev[k] = (dev[k] + (v & M32)) & M32
-            dev["nnz"] += s[4]
-        rows = loader.stats.rows
-    finally:
-        loader.close()
+        @jax.jit
+        def batch_sums(b):
+            i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)  # noqa: E731
+            out = [jnp.sum(b["ids"]), jnp.sum(i32(b["vals"])),
+                   jnp.sum(i32(b["labels"])), jnp.sum(i32(b["weights"]))]
+            if want_fields:
+                out.append(jnp.sum(b["fields"]))
+            if "row_ptr" in b:
+                out.append(b["row_ptr"][-1])
+            else:
+                # per-array path ships segments, not row_ptr; padding
+                # entries point at the scratch row (== batch_rows)
+                out.append(jnp.sum(
+                    (b["segments"] < b["labels"].shape[0])
+                    .astype(jnp.int32)))
+            return tuple(out)
 
-    fields = ("ids", "vals", "labels", "weights", "nnz")
-    mismatch = {k: {"host": host[k], "device": dev[k]}
-                for k in fields if host[k] != dev[k]}
-    if rows != host["rows"]:
-        mismatch["rows"] = {"host": host["rows"], "device": rows}
-    r = {"metric": "ingest_integrity", "value": 0.0 if mismatch else 1.0,
-         "unit": "ok", "rows": host["rows"], "nnz": host["nnz"]}
-    if mismatch:
-        r["mismatch"] = mismatch
-    return r
+        dev = dict.fromkeys(keys + ("nnz",), 0)
+        # nnz_cap sized so no row is truncated (host has no truncation)
+        loader = DeviceLoader(create_parser(f"file://{path}", 0, 1, fmt),
+                              batch_rows=4096, nnz_cap=262144, prefetch=4,
+                              put_threads=4, wire_compact=not want_fields,
+                              fields=want_fields)
+        try:
+            for b in loader:
+                s = [int(np.asarray(x)) for x in batch_sums(b)]
+                for k, v in zip(keys, s):
+                    dev[k] = (dev[k] + (v & M32)) & M32
+                dev["nnz"] += s[-1]
+            rows = loader.stats.rows
+        finally:
+            loader.close()
+
+        mismatch = {k: {"host": host[k], "device": dev[k]}
+                    for k in keys + ("nnz",) if host[k] != dev[k]}
+        if rows != host["rows"]:
+            mismatch["rows"] = {"host": host["rows"], "device": rows}
+        out = {"ok": not mismatch, "rows": host["rows"],
+               "nnz": host["nnz"]}
+        if mismatch:
+            out["mismatch"] = mismatch
+        return out
+
+    libsvm = "/tmp/bench_suite.libsvm"
+    libfm = "/tmp/bench_suite.libfm"
+    _gen_libsvm(libsvm)
+    _gen_libsvm(libfm, libfm=True)
+    # two sub-checks cover every transfer path: fused compact wire
+    # (libsvm) and the per-array fields path (libfm, fields=True — field
+    # arrays bypass the fused wire by design)
+    res = {"libsvm_compact": check_one(libsvm, "libsvm", False),
+           "libfm_fields": check_one(libfm, "libfm", True)}
+    ok = all(v["ok"] for v in res.values())
+    return {"metric": "ingest_integrity", "value": 1.0 if ok else 0.0,
+            "unit": "ok", "paths": res}
 
 
 def bench_cache_build() -> dict:

@@ -239,5 +239,8 @@ def test_integrity_config_bit_exact_on_cpu():
 
     assert bs.METRIC_OF["integrity"] == "ingest_integrity"
     r = bs.bench_integrity()
-    assert r["value"] == 1.0, r.get("mismatch")
-    assert r["rows"] > 0 and r["nnz"] > 0
+    assert r["value"] == 1.0, r.get("paths")
+    for name in ("libsvm_compact", "libfm_fields"):
+        sub = r["paths"][name]
+        assert sub["ok"], (name, sub.get("mismatch"))
+        assert sub["rows"] > 0 and sub["nnz"] > 0
