@@ -90,7 +90,7 @@ PYEOF
     # short grant must reach the never-measured ones before it dies).
     # The suite registry stays the source of truth for WHICH configs run.
     DMLC_BENCH_SUITE_OUT=/tmp/bench_suite_tpu.json \
-        DMLC_SUITE_PRIORITY="${DMLC_SUITE_PRIORITY:-dcn_train,deepfm_train,ffm_train,allreduce,ingest_scale,fm_train}" \
+        DMLC_SUITE_PRIORITY="${DMLC_SUITE_PRIORITY:-integrity,dcn_train,deepfm_train,ffm_train,allreduce,ingest_scale,fm_train}" \
         timeout 5400 python benchmarks/bench_suite.py >>"$LOG" 2>&1
 }
 
