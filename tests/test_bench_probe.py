@@ -272,3 +272,25 @@ def test_allreduce_multidevice_branch_on_virtual_mesh():
     assert r["metric"] == "allreduce_bus_bw"
     assert r["devices"] == 8
     assert r["value"] > 0 and r["rtt_ms"] >= 0
+
+
+def test_harvest_priority_default_matches_registry(monkeypatch):
+    """harvest_run.sh's DMLC_SUITE_PRIORITY default must name only
+    registered configs: resolve_picks SystemExits on unknown names, which
+    inside a granted window would kill the whole suite step.  The string
+    lives in shell, the registry in python — this test is the drift
+    guard (the string changed three times in r4 alone)."""
+    import re
+
+    import benchmarks.bench_suite as bs
+
+    sh = open(os.path.join(REPO, "benchmarks", "harvest_run.sh")).read()
+    m = re.search(r"DMLC_SUITE_PRIORITY:-([a-z0-9_,]+)", sh)
+    assert m, "priority default not found in harvest_run.sh"
+    names = m.group(1).split(",")
+    unknown = [n for n in names if n not in bs.ALL]
+    assert not unknown, f"harvest_run.sh priority names unknown: {unknown}"
+    # and the env path actually accepts it end-to-end
+    monkeypatch.setenv("DMLC_SUITE_PRIORITY", m.group(1))
+    got = bs.resolve_picks([])
+    assert got[:len(names)] == names
