@@ -1,6 +1,8 @@
-"""Memory-safety tier for the native library (SURVEY §5 race/sanitizer
-analog): build dmlc_native.cpp with -fsanitize=address and drive every
-hot path in a subprocess.  The reference gets this from sanitizer CI
+"""Memory-safety + UB tier for the native library (SURVEY §5
+race/sanitizer analog): build dmlc_native.cpp with
+-fsanitize=address,undefined (UB aborts — no recover) and drive every
+hot path in a subprocess.  The SWAR fast paths type-pun 8-byte windows;
+UBSan guards the pun staying on the memcpy idiom.  The reference gets this from sanitizer CI
 builds of its C++ core; here the single-TU build makes it a regular
 test wherever g++ + libasan exist (CI runners included)."""
 
@@ -30,7 +32,8 @@ def test_native_hot_paths_asan_clean(tmp_path):
         pytest.skip("g++/libasan unavailable")
     so = tmp_path / "libdmlc_native_asan.so"
     build = subprocess.run(
-        ["g++", "-fsanitize=address", "-O1", "-std=c++17", "-shared",
+        ["g++", "-fsanitize=address,undefined",
+         "-fno-sanitize-recover=undefined", "-O1", "-std=c++17", "-shared",
          "-fPIC", "-fno-omit-frame-pointer", "-fopenmp", SRC, "-o", str(so)],
         capture_output=True, text=True, timeout=300)
     assert build.returncode == 0, build.stderr[-2000:]
