@@ -15,6 +15,7 @@
 //
 // Build: g++ -O3 -std=c++17 -fopenmp -shared -fPIC dmlc_native.cpp -o libdmlc_native.so
 
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -573,10 +574,36 @@ int parse_parallel(const char* data, int64_t len, bool want_fields, int nthreads
 #endif
   std::vector<const char*> cuts = line_aligned_cuts(data, len, nt);
   std::vector<ThreadBlock> blocks(nt);
+#if defined(__SANITIZE_THREAD__)
+  // TSAN-only: explicit release/acquire edges mirroring BOTH OpenMP
+  // barriers.  The fork barrier (main's cuts/blocks writes → worker
+  // reads) and the join barrier (worker block writes → main's merge
+  // reads) live in uninstrumented libgomp, so TSAN cannot see either
+  // and reported the whole parse as 64 races.  The real omp barriers
+  // already order everything — these atomics only re-express that
+  // ordering in tool-visible form, so production builds compile none of
+  // it.  Single loads suffice (no spinning): the omp join guarantees
+  // the acquire load observes the last release fetch_add, and the RMW
+  // release sequence makes every worker's edge visible from it.
+  std::atomic<int> tsan_published{0};
+  std::atomic<int> tsan_done{0};
+  tsan_published.store(1, std::memory_order_release);
+#define DMLC_TSAN_WORKER_ENTER() \
+    (void)tsan_published.load(std::memory_order_acquire)
+#define DMLC_TSAN_WORKER_EXIT() \
+    tsan_done.fetch_add(1, std::memory_order_release)
+#define DMLC_TSAN_MAIN_JOIN() \
+    (void)tsan_done.load(std::memory_order_acquire)
+#else
+#define DMLC_TSAN_WORKER_ENTER() ((void)0)
+#define DMLC_TSAN_WORKER_EXIT() ((void)0)
+#define DMLC_TSAN_MAIN_JOIN() ((void)0)
+#endif
 #if defined(_OPENMP)
 #pragma omp parallel for num_threads(nt) schedule(static, 1)
 #endif
   for (int t = 0; t < nt; ++t) {
+    DMLC_TSAN_WORKER_ENTER();
     // pre-size the per-row arrays (~80 chars per row is a safe lower
     // bound); the sparse range parsers size their own per-value scratch
     int64_t range = cuts[t + 1] - cuts[t];
@@ -584,7 +611,12 @@ int parse_parallel(const char* data, int64_t len, bool want_fields, int nthreads
     blocks[t].weights.reserve(range / 64);
     blocks[t].offsets.reserve(range / 64);
     range_fn(cuts[t], cuts[t + 1], &blocks[t]);
+    DMLC_TSAN_WORKER_EXIT();
   }
+  DMLC_TSAN_MAIN_JOIN();
+#undef DMLC_TSAN_WORKER_ENTER
+#undef DMLC_TSAN_WORKER_EXIT
+#undef DMLC_TSAN_MAIN_JOIN
   // merge
   int64_t n_rows = 0, n_values = 0;
   uint64_t max_index = 0;
