@@ -89,4 +89,28 @@ for fmt in ("libsvm", "libfm"):
         sp.close()
         assert n > 0
 print("sppack OK")
+
+# 4) raw garbage: random bytes (NULs, no structure, no trailing newline),
+# pathological token shapes, and huge digit runs — the parsers must
+# survive arbitrary input with bad-line accounting, never memory errors
+for seed in range(8):
+    grng = np.random.default_rng(seed)
+    junk = grng.integers(0, 256, int(grng.integers(1, 200000)),
+                         dtype=np.uint8).tobytes()
+    nat.parse_libsvm(junk)
+    nat.parse_libfm(junk)
+    nat.parse_csv(junk)
+    sp = nat.SpPacker(64, 512, id_mod=1 << 16, fmt="libsvm")
+    for _ in sp.feed_text(junk):
+        pass
+    sp.flush()
+    sp.close()
+evil = (b"0 " + b"9" * 4096 + b":" + b"1" * 4096 + b"\n"
+        b"1 :::::::\n"
+        b"0 " + b" " * 8192 + b"\n"
+        b"1 5:1e" + b"9" * 64 + b"\n"
+        b"0 -1:-0.0 18446744073709551615:5e-324\n")
+for fn in (nat.parse_libsvm, nat.parse_libfm, nat.parse_csv):
+    fn(evil)
+print("garbage-fuzz OK")
 print("ASAN-NATIVE-COMPLETE")
