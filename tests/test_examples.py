@@ -9,6 +9,24 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+
+def _write_synth_libsvm(path, seed: int, rows: int = 600,
+                        libfm: bool = False) -> None:
+    """Shared synthetic corpus for the example integration tests (one
+    place to tweak row count / id range / nnz shape for all of them)."""
+    import random
+    rnd = random.Random(seed)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            k = rnd.randint(1, 6)
+            if libfm:
+                ent = " ".join(f"{rnd.randint(0, 4)}:{rnd.randint(0, 200)}:"
+                               f"{rnd.random():.3f}" for _ in range(k))
+            else:
+                ent = " ".join(f"{rnd.randint(0, 255)}:{rnd.random():.3f}"
+                               for _ in range(k))
+            f.write(f"{rnd.randint(0, 1)} {ent}\n")
+
 def test_distributed_logreg_example(tmp_path):
     data = tmp_path / "d.libsvm"
     import random
@@ -157,15 +175,8 @@ def test_checkpoint_resume_after_midjob_kill_converges(tmp_path):
 
 def test_train_ffm_example(tmp_path):
     """The FFM example end-to-end on a small libfm file (single process)."""
-    import random
-    rnd = random.Random(0)
     data = tmp_path / "t.libfm"
-    with open(data, "w") as f:
-        for _ in range(600):
-            k = rnd.randint(1, 5)
-            ent = " ".join(f"{rnd.randint(0, 4)}:{rnd.randint(0, 200)}:"
-                           f"{rnd.random():.3f}" for _ in range(k))
-            f.write(f"{rnd.randint(0, 1)} {ent}\n")
+    _write_synth_libsvm(data, seed=0, libfm=True)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "train_ffm.py"),
          f"file://{data}", "--features", "256", "--fields", "5",
@@ -212,15 +223,8 @@ def test_failure_injection_two_crashes_wide_cohort(tmp_path):
 def test_train_dcn_example(tmp_path):
     """examples/train_dcn.py runs the full ladder (URI → parse → device
     batches → jitted DCN step → checkpoint) as a user would invoke it."""
-    import random
-    rnd = random.Random(1)
     data = tmp_path / "d.libsvm"
-    with open(data, "w") as f:
-        for _ in range(600):
-            k = rnd.randint(1, 6)
-            ent = " ".join(f"{rnd.randint(0, 255)}:{rnd.random():.3f}"
-                           for _ in range(k))
-            f.write(f"{rnd.randint(0, 1)} {ent}\n")
+    _write_synth_libsvm(data, seed=1)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "train_dcn.py"),
          f"file://{data}", "--features", "256", "--dim", "8",
@@ -228,5 +232,37 @@ def test_train_dcn_example(tmp_path):
          "--ckpt-dir", str(tmp_path / "ck")],
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done:" in out.stdout
+
+
+def test_train_fm_example(tmp_path):
+    """examples/train_fm.py — the original quick-start ladder — runs as a
+    user invokes it (every shipped example has an integration test)."""
+    data = tmp_path / "f.libsvm"
+    _write_synth_libsvm(data, seed=2)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "train_fm.py"),
+         f"file://{data}", "--features", "256", "--dim", "4",
+         "--batch-rows", "128", "--nnz-cap", "2048",
+         "--ckpt-dir", str(tmp_path / "ck")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done:" in out.stdout
+
+
+def test_mesh_train_fm_example(tmp_path):
+    """examples/mesh_train_fm.py on the 8-device virtual mesh (dp=4,mp=2):
+    sharded ingest + dim-sharded table through the example's own CLI."""
+    data = tmp_path / "m.libsvm"
+    _write_synth_libsvm(data, seed=3)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "mesh_train_fm.py"),
+         f"file://{data}", "--features", "256", "--dim", "8",
+         "--mesh", "dp=4,mp=2"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "done:" in out.stdout
