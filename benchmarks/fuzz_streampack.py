@@ -6,7 +6,10 @@ from dmlc_core_tpu.pipeline.device_loader import _fused_words_meta
 
 assert native.has_sppack()
 fails = 0
-for seed in range(50):
+import sys
+SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+OFFSET = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+for seed in range(OFFSET, OFFSET + SEEDS):
     rng = np.random.default_rng(seed)
     fmt = ["libsvm", "libfm", "csv"][seed % 3]
     compact = bool(seed % 2)
@@ -98,4 +101,5 @@ for seed in range(50):
         fails += 1
         print(f"SEED {seed} MISMATCH fmt={fmt} compact={compact} B={B} "
               f"CAP={CAP} idmod={idmod} a={len(a)} b={len(b)} sa={sa} sb={sb}")
-print(f"fuzz: 50 seeds, {fails} mismatches")
+print(f"fuzz: {SEEDS} seeds from {OFFSET}, {fails} mismatches")
+sys.exit(1 if fails else 0)
