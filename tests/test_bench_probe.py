@@ -225,7 +225,10 @@ def test_probe_deadline_truncates_screen(bench_mod, capfd, monkeypatch):
     err = capfd.readouterr().err
     assert "probe deadline hit" in err
     assert "no combos screened" in err
-    assert mean > 0 and len(runs) == 5
+    # past-deadline runs degrade from 5 timed pairs to 3: measured pairs
+    # inside the driver's budget beat a killed process with no JSON
+    assert "3 pairs instead of 5" in err
+    assert mean > 0 and len(runs) == 3
     # fallback = best-guess-first combo (pt=4, compact first on "tpu"),
     # not a hardcoded worst guess
     assert (pt, cm) == (4, True)
