@@ -101,51 +101,94 @@ def measure_reference() -> float:
         return 0.0
 
 
-def probe_tpu(timeout_s: int = 0) -> bool:
-    """Check TPU usability in a subprocess so a wedged tunnel can't hang us.
+def _probe_subprocess(code: str, timeout_s: int, label: str) -> bool:
+    """Run one probe snippet in a subprocess (a wedged tunnel can't hang
+    us); True iff it printed a non-cpu platform and exited 0."""
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s, env=env)
+        plat = (out.stdout.strip().splitlines()[-1]
+                if out.stdout.strip() else "")
+        ok = out.returncode == 0 and plat not in ("", "cpu")
+        log(f"tpu probe [{label}]: rc={out.returncode} "
+            f"platform={plat!r} → {'TPU' if ok else 'no grant'}")
+        if not ok and out.stderr:
+            log("probe stderr tail: " + out.stderr[-500:])
+        return ok
+    except subprocess.TimeoutExpired as e:
+        tail = ""
+        if e.stderr:
+            err = e.stderr
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            tail = "; stderr tail: " + err[-500:]
+        log(f"tpu probe [{label}] timed out after {timeout_s}s{tail}")
+        return False
 
-    The axon tunnel's claim can queue for MINUTES behind other tenants
-    (round-1 postmortem: a 120s probe timed out and the whole round fell
-    back to CPU), so the default budget is generous and env-overridable
-    (``DMLC_TPU_PROBE_S``, 0 disables the probe entirely via
-    ``DMLC_FORCE_CPU=1``), the probe is retried once, and the subprocess
-    stderr is surfaced for diagnosis instead of swallowed."""
+
+# tiny-put grant check: device discovery + one 4-byte put + a VALUE read
+# (the only completion proof the tunnel honors) — no matmul, no jit compile
+_GRANT_CODE = ("import jax, numpy as np;"
+               "d=jax.devices();"
+               "h=jax.device_put(np.int32(7), d[0]);"
+               "assert int(np.asarray(h))==7;"
+               "print(d[0].platform)")
+_FULL_CODE = ("import jax, jax.numpy as jnp;"
+              "d=jax.devices();"
+              "x=jnp.ones((256,256));"
+              "(x@x).block_until_ready();"
+              "print(d[0].platform)")
+
+
+def probe_tpu(timeout_s: int = 0) -> bool:
+    """Two-stage TPU probe (VERDICT r4 #5: a driver run must either land
+    on TPU or fall back in minutes, not ~20).
+
+    Stage 1 — fast-fail grant check: tiny put + value read, SHORT attempts
+    (``DMLC_TPU_PROBE_FAST_S``, default 60 s each) looped until a total
+    fast window (``DMLC_TPU_PROBE_FAST_TOTAL_S``, default 240 s) runs out.
+    A dead tunnel fails in ≤~4 min instead of eating two 600 s heavy-probe
+    timeouts (r4's official artifact fell back to CPU exactly that way),
+    while a claim QUEUED behind other tenants — the round-1 postmortem
+    case — still lands any time inside the window, because each attempt
+    re-enters the claim queue rather than giving up after one try.  Set
+    ``DMLC_TPU_PROBE_FAST_S=0`` to skip straight to the patient probe
+    (the harvest loop's retry cadence makes its own budget via
+    ``DMLC_TPU_PROBE_S``).
+
+    Stage 2 — full check (compile + matmul) under the patient budget
+    (``DMLC_TPU_PROBE_S``, default 600 s): only runs once stage 1 proved a
+    grant exists, so its budget is spent on compile/queue time, not on
+    discovering a dead link."""
     if os.environ.get("DMLC_FORCE_CPU") == "1":
         log("DMLC_FORCE_CPU=1 → skipping TPU probe")
         return False
     if timeout_s <= 0:
         timeout_s = int(os.environ.get("DMLC_TPU_PROBE_S", "600"))
-    code = ("import jax, jax.numpy as jnp;"
-            "d=jax.devices();"
-            "x=jnp.ones((256,256));"
-            "(x@x).block_until_ready();"
-            "print(d[0].platform)")
-    env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
+    fast_s = int(os.environ.get("DMLC_TPU_PROBE_FAST_S", "60"))
+    if fast_s > 0:
+        fast_total = float(os.environ.get("DMLC_TPU_PROBE_FAST_TOTAL_S",
+                                          "240"))
+        fast_deadline = time.monotonic() + fast_total
+        granted = False
+        attempt = 0
+        while not granted:
+            attempt += 1
+            budget = min(fast_s, max(5, int(fast_deadline
+                                            - time.monotonic())))
+            granted = _probe_subprocess(
+                _GRANT_CODE, budget, f"grant-check {attempt}")
+            if not granted and time.monotonic() >= fast_deadline:
+                log(f"→ CPU fallback (no grant in {attempt} checks over "
+                    f"{fast_total:.0f}s fast window)")
+                return False
     for attempt in range(2):
-        try:
-            out = subprocess.run([sys.executable, "-c", code],
-                                 capture_output=True, text=True,
-                                 timeout=timeout_s, env=env)
-            plat = (out.stdout.strip().splitlines()[-1]
-                    if out.stdout.strip() else "")
-            ok = out.returncode == 0 and plat not in ("", "cpu")
-            log(f"tpu probe (attempt {attempt + 1}): rc={out.returncode} "
-                f"platform={plat!r} → {'TPU' if ok else 'CPU fallback'}")
-            if not ok and out.stderr:
-                log("probe stderr tail: " + out.stderr[-500:])
-            if ok:
-                return True
-        except subprocess.TimeoutExpired as e:
-            tail = ""
-            if e.stderr:
-                err = e.stderr
-                if isinstance(err, bytes):
-                    err = err.decode(errors="replace")
-                tail = "; stderr tail: " + err[-500:]
-            log(f"tpu probe attempt {attempt + 1} timed out after "
-                f"{timeout_s}s{tail}")
+        if _probe_subprocess(_FULL_CODE, timeout_s, f"full {attempt + 1}"):
+            return True
     log("→ CPU fallback")
     return False
 
@@ -173,16 +216,25 @@ def measure_link_verified(mb: int = 16, reps: int = 3) -> float:
         import jax
         import numpy as np
         dev = jax.devices()[0]
-        buf = np.arange(mb * (1 << 20) // 4, dtype=np.int32)
-        h = jax.device_put(buf, dev)                       # warm
+        base = np.arange(mb * (1 << 20) // 4, dtype=np.int32)
+        h = jax.device_put(base, dev)                      # warm
         int(np.asarray(h[:1])[0])
-        t0 = time.perf_counter()
-        handles = []
+        # one IMMUTABLE host array per rep: mutating a shared buffer
+        # between async puts would let a zero-copy/aliasing runtime
+        # snapshot a later rep's bytes into an earlier in-flight put,
+        # weakening the distinct-bytes dedupe defense; per-rep arrays
+        # stay untouched until their completion read
+        bufs = []
         for rep in range(reps):
-            buf[rep] = -rep - 1
-            handles.append(jax.device_put(buf, dev))
-        for h in handles:                 # completion proof, every put
-            int(np.asarray(h[:1])[0])
+            b = base.copy()
+            b[0] = -rep - 1
+            bufs.append(b)
+        t0 = time.perf_counter()
+        handles = [jax.device_put(b, dev) for b in bufs]
+        for rep, h in enumerate(handles):  # completion proof, every put
+            if int(np.asarray(h[:1])[0]) != -rep - 1:
+                log("link probe: sentinel mismatch — dedupe suspected")
+                return 0.0
         dt = time.perf_counter() - t0
         return reps * mb / dt
     except Exception as e:  # noqa: BLE001
@@ -426,6 +478,19 @@ def measure_ours(platform_override: str = "", interleave=None):
     spread = (max(runs) - min(runs)) / max(runs)
     log(f"  timed runs (pt={pt}, compact={int(cm)}, rows={shape[0]}): "
         + ", ".join(f"{r:.1f}" for r in runs) + f" MB/s, spread {spread:.0%}")
+    # persist the winner (VERDICT r4 #2): DeviceLoader's "auto" knobs and
+    # the suite's ingest configs inherit it so untuned defaults stop
+    # wasting the probe's findings (r4: 20.2 vs 72 MB/s in one window)
+    if not platform_override:  # never persist from an override/test run
+        try:
+            from dmlc_core_tpu.pipeline.tuned import save_tuned
+            save_tuned({"platform": platform, "put_threads": pt,
+                        "wire_compact": cm, "batch_rows": shape[0],
+                        "nnz_cap": shape[1],
+                        "mbps": round(sum(runs) / len(runs), 1)})
+            log(f"  tuned config persisted for platform={platform}")
+        except Exception as e:  # noqa: BLE001 — tuning is advisory
+            log(f"  tuned-config persist failed: {e}")
     return sum(runs) / len(runs), runs, (pt, cm, shape[0]), platform
 
 

@@ -1,0 +1,159 @@
+"""Distribution-matched real-dataset generators (VERDICT r4 #4).
+
+`BASELINE.md` lists a1a, HIGGS, and Criteo-shaped configs to reproduce —
+the reference's own perf instrumentation runs on real files
+(`/root/reference/test/libsvm_parser_test.cc:24-35`).  This image has
+**zero egress**, so the real files cannot be downloaded; these generators
+reproduce the structural properties that make each dataset a meaningfully
+different benchmark from the uniform-synthetic corpus
+(`bench_suite._gen_libsvm`), and every config that consumes them records
+``"data": "<name>-shaped"`` so nobody mistakes them for the originals.
+
+* :func:`gen_a1a` — Adult/a1a shape: 123 binary one-hot features in 14
+  attribute groups, ~14 features/row, value always 1, ids strictly
+  ascending one-per-group (the real file's defining property for parser
+  and wire: tiny rows, dense id reuse, value dictionary of size 1).
+* :func:`gen_higgs_csv` — HIGGS shape: label + 28 continuous physics
+  features per CSV row (21 "low-level" detector values, mixture-of-
+  gaussian/exponential, 7 "high-level" invariant masses ≈ 1.0 ± 0.4),
+  full float precision — the dense-parse stress the uniform corpus
+  (5 significant digits, 29 cols) already approximates but with HIGGS's
+  column count and value distribution.
+* :func:`gen_criteo_libfm` — Criteo shape: 39 fields (13 numeric + 26
+  categorical), one feature per present field, **field-clustered id
+  space** (field f owns the contiguous range [base_f, base_f + V_f)),
+  per-field Zipf popularity over log-uniform vocabulary sizes up to 1M,
+  ~3% missing fields.  This is the corpus wire-v4's delta-coded ids were
+  deferred to (`NOTES_r04.md` item 3): within a row, ids ascend through
+  the field bases, so deltas are bounded by vocabulary spans instead of
+  the full id space.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+MB = 1 << 20
+
+# Adult's 14 attributes one-hot to 123 binary columns in a1a.  Exact
+# per-attribute arity of the encoding (5 age bins, 8 workclass, ...,
+# 41 native-country) is approximated; the sum is pinned to a1a's 123.
+A1A_GROUPS = [5, 8, 16, 7, 14, 6, 5, 2, 2, 2, 5, 10, 41]
+assert sum(A1A_GROUPS) == 123
+
+
+def gen_a1a(path: str, rows: int = 1605, seed: int = 7) -> None:
+    """a1a-shaped tiny corpus (the real a1a train split is 1,605 rows).
+
+    The label model's weight vector is drawn from a FIXED rng independent
+    of ``seed``, so two files generated with different seeds (train +
+    held-out eval split) share one ground truth — held-out metrics are
+    meaningful."""
+    if os.path.exists(path):
+        return
+    rng = np.random.default_rng(seed)
+    bases = np.concatenate([[0], np.cumsum(A1A_GROUPS)])[:-1]
+    # a sparse "true" weight vector makes the labels learnable, like the
+    # real task (~84% linear accuracy); weights on one-hot columns
+    w = np.random.default_rng(99).normal(0, 1.0, 123)
+    lines = []
+    for _ in range(rows):
+        ids = []
+        for g, (base, size) in enumerate(zip(bases, A1A_GROUPS)):
+            if rng.random() < 0.07:          # missing attribute
+                continue
+            # skewed within-group popularity (real categoricals are)
+            j = min(int(rng.exponential(size / 4)), size - 1)
+            ids.append(base + j)
+        score = w[ids].sum() + rng.normal(0, 1.0)
+        y = "+1" if score > 0 else "-1"
+        # libsvm ids are 1-based in the real file
+        lines.append(y + " " + " ".join(f"{i + 1}:1" for i in ids))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def gen_higgs_csv(path: str, target_mb: int = 48, seed: int = 7) -> None:
+    """HIGGS-shaped CSV: label,21 low-level,7 high-level columns."""
+    if os.path.exists(path) and os.path.getsize(path) >= target_mb * MB * 0.9:
+        return
+    rng = np.random.default_rng(seed)
+    with open(path, "wb") as f:
+        written = 0
+        while written < target_mb * MB:
+            n = 4096
+            # low-level: momenta/energies — positive, heavy-tailed — and
+            # angles in [-pi, pi] scaled to ~unit variance
+            mom = rng.gamma(2.0, 0.5, (n, 11)).astype(np.float32)
+            ang = rng.uniform(-1.7, 1.7, (n, 10)).astype(np.float32)
+            # high-level: reconstructed invariant masses ~ 1.0
+            masses = (1.0 + 0.4 * rng.standard_normal((n, 7))).astype(
+                np.float32).clip(0.05, None)
+            feats = np.concatenate([mom, ang, masses], axis=1)
+            # signal depends nonlinearly on the masses (as in the paper:
+            # high-level features carry most of the signal)
+            s = ((masses[:, 0] - 1.0) ** 2 + (masses[:, 3] - 1.0) ** 2
+                 < 0.25).astype(np.int32)
+            flip = rng.random(n) < 0.2
+            s = np.where(flip, 1 - s, s)
+            lines = [b"%d," % y + b",".join(b"%.7g" % v for v in row)
+                     for y, row in zip(s.tolist(), feats)]
+            blob = b"\n".join(lines) + b"\n"
+            f.write(blob)
+            written += len(blob)
+
+
+CRITEO_FIELDS = 39          # 13 numeric + 26 categorical
+
+
+def criteo_field_layout(seed: int = 7):
+    """(bases, sizes): field f owns ids [bases[f], bases[f]+sizes[f])."""
+    rng = np.random.default_rng(seed)
+    num_sizes = rng.integers(32, 1024, 13)          # bucketized numerics
+    cat_sizes = np.exp(rng.uniform(np.log(100), np.log(1_000_000),
+                                   26)).astype(np.int64)
+    sizes = np.concatenate([num_sizes, cat_sizes])
+    bases = np.concatenate([[0], np.cumsum(sizes)])[:-1]
+    return bases, sizes
+
+
+def _zipf_ids(rng, size: int, n: int) -> np.ndarray:
+    """Zipf-ish popularity over [0, size): rank = floor(size^u) biases the
+    draw toward low ranks without scipy."""
+    u = rng.random(n)
+    r = np.floor(np.power(float(size), u)).astype(np.int64) - 1
+    return np.clip(r, 0, size - 1)
+
+
+def gen_criteo_libfm(path: str, target_mb: int = 48, seed: int = 7) -> None:
+    """Criteo-shaped libfm: ``label field:id:value`` with field-clustered
+    ascending ids (the wire-v4 evaluation corpus)."""
+    if os.path.exists(path) and os.path.getsize(path) >= target_mb * MB * 0.9:
+        return
+    rng = np.random.default_rng(seed)
+    bases, sizes = criteo_field_layout(seed)
+    with open(path, "wb") as f:
+        written = 0
+        while written < target_mb * MB:
+            n = 2048
+            rows = [[] for _ in range(n)]
+            for fld in range(CRITEO_FIELDS):
+                present = rng.random(n) >= 0.03     # ~3% missing
+                ids = bases[fld] + _zipf_ids(rng, int(sizes[fld]), n)
+                if fld < 13:                        # numeric: count-like
+                    vals = np.round(np.exp(
+                        rng.uniform(0, 5, n))).astype(np.int64)
+                    for i in np.nonzero(present)[0]:
+                        rows[i].append(b"%d:%d:%d" % (fld, ids[i], vals[i]))
+                else:                               # categorical: value 1
+                    for i in np.nonzero(present)[0]:
+                        rows[i].append(b"%d:%d:1" % (fld, ids[i]))
+            labels = (rng.random(n) < 0.26)         # Criteo CTR base rate
+            blob = b"\n".join(
+                b"%d " % y + b" ".join(r)
+                for y, r in zip(labels.astype(np.int64).tolist(), rows)
+            ) + b"\n"
+            f.write(blob)
+            written += len(blob)

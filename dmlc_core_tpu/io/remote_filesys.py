@@ -59,11 +59,19 @@ _MAX_RETRY = 3
 
 def _http_request(scheme: str, netloc: str, method: str, path_qs: str,
                   headers: Dict[str, str], body: bytes = b"",
-                  timeout: float = 60.0) -> Tuple[int, Dict[str, str], bytes]:
-    """One HTTP round trip; retries only idempotent methods (a retried
-    POST/PUT could double-apply or fail after server-side success — e.g.
-    re-sending CompleteMultipartUpload for an already-completed id)."""
-    retries = _MAX_RETRY if method in ("GET", "HEAD") else 1
+                  timeout: float = 60.0,
+                  retries: Optional[int] = None
+                  ) -> Tuple[int, Dict[str, str], bytes]:
+    """One HTTP round trip; by default retries only idempotent methods (a
+    retried POST/PUT could double-apply or fail after server-side success —
+    e.g. re-sending CompleteMultipartUpload for an already-completed id).
+    Callers that KNOW a write is idempotent (UploadPart: same
+    partNumber+uploadId replaces the part; InitiateMultipartUpload: a
+    lost-response orphan id is lifecycle-cleaned) pass ``retries``
+    explicitly — the write-side analog of restart-on-seek
+    (`s3_filesys.cc:747-799`)."""
+    if retries is None:
+        retries = _MAX_RETRY if method in ("GET", "HEAD") else 1
     last_exc: Optional[Exception] = None
     for attempt in range(retries):
         conn = None
@@ -412,16 +420,25 @@ class _S3WriteStream(io.RawIOBase):
         return len(b)
 
     def _flush_part(self, data: bytes) -> None:
+        # Initiate and UploadPart retry on dropped connections / 5xx
+        # (reference `s3_filesys.cc:747-799` loops its curl calls the same
+        # way): re-PUTting partNumber+uploadId replaces the part — exactly
+        # idempotent — and a lost Initiate response only orphans an id for
+        # lifecycle cleanup.  CompleteMultipartUpload (close) stays
+        # single-shot: a blind re-send after server-side success returns
+        # NoSuchUpload and would turn a succeeded publish into an error.
         if self._upload_id is None:
             status, _, body = self._fs._request(
-                "POST", self._bucket, self._key, {"uploads": ""}, b"")
+                "POST", self._bucket, self._key, {"uploads": ""}, b"",
+                retries=_MAX_RETRY)
             check(status == 200, f"InitiateMultipartUpload: HTTP {status}")
             self._upload_id = ET.fromstring(body).findtext(".//{*}UploadId")
             check(bool(self._upload_id), "no UploadId in response")
         part_no = len(self._etags) + 1
         status, hdrs, _ = self._fs._request(
             "PUT", self._bucket, self._key,
-            {"partNumber": str(part_no), "uploadId": self._upload_id}, data)
+            {"partNumber": str(part_no), "uploadId": self._upload_id}, data,
+            retries=_MAX_RETRY)
         check(status == 200, f"UploadPart {part_no}: HTTP {status}")
         self._etags.append(hdrs.get("etag", f'"{part_no}"'))
 
@@ -482,7 +499,8 @@ class S3FileSystem(FileSystem):
         return _S3Config(self._env_prefix, self._service)
 
     def _request(self, method: str, bucket: str, key: str,
-                 query: Dict[str, str], body: bytes
+                 query: Dict[str, str], body: bytes,
+                 retries: Optional[int] = None
                  ) -> Tuple[int, Dict[str, str], bytes]:
         cfg = self.cfg
         scheme, netloc, prefix = cfg.resolve(bucket)
@@ -501,7 +519,8 @@ class S3FileSystem(FileSystem):
             for k, v in sorted(query.items()))
         wire_path = urllib.parse.quote(path, safe="/")
         path_qs = f"{wire_path}?{qs}" if qs else wire_path
-        return _http_request(scheme, netloc, method, path_qs, headers, body)
+        return _http_request(scheme, netloc, method, path_qs, headers, body,
+                             retries=retries)
 
     @staticmethod
     def _split(uri: URI) -> Tuple[str, str]:

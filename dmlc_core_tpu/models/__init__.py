@@ -9,12 +9,12 @@ from .ftrl import ftrl, FTRLState  # noqa: F401
 from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F401
                     param_shardings, shard_params, fit_stream,
                     streaming_auc, auc_from_histograms,
-                    evaluate_stream)
+                    evaluate_stream, make_train_step_fused, FusedTrainer)
 
 __all__ = [
     "SparseLogReg", "FactorizationMachine", "FieldAwareFM", "DeepFM",
     "DCNv2", "weighted_bce", "weighted_mse",
     "make_train_step", "make_eval_step", "batch_sharding", "param_shardings",
     "shard_params", "fit_stream", "streaming_auc", "auc_from_histograms",
-    "evaluate_stream",
+    "evaluate_stream", "make_train_step_fused", "FusedTrainer",
 ]

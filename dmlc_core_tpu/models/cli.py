@@ -82,11 +82,13 @@ class TrainParams(Parameter):
                    help="input format ('auto': ?format= URI arg, then file "
                         "suffix .libsvm/.libfm/.csv, then libsvm; ffm "
                         "implies libfm)")
-    # enum derives from the registry (decorators above run before this
-    # class body), so registering a model IS adding it to the CLI — a
-    # hardcoded list silently orphaned 'dcn' once (caught in r4 review)
+    # LAZY enum (callable, re-read per check): a hardcoded list silently
+    # orphaned 'dcn' once (r4 review), and a list snapshotted at class-body
+    # time would still reject models registered after this module imports
+    # (user plugins — ADVICE r4); deriving from the registry at check time
+    # makes registering a model the ONLY step to join the CLI
     model = field(str, default="fm",
-                  enum=sorted(MODEL_REGISTRY.list_names()),
+                  enum=lambda: sorted(MODEL_REGISTRY.list_names()),
                   help="registered model name")
     features = field(int, default=1 << 20, lower_bound=1,
                      help="feature-space size (ids hashed into it)")

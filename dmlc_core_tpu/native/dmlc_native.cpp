@@ -574,7 +574,19 @@ int parse_parallel(const char* data, int64_t len, bool want_fields, int nthreads
 #endif
   std::vector<const char*> cuts = line_aligned_cuts(data, len, nt);
   std::vector<ThreadBlock> blocks(nt);
-#if defined(__SANITIZE_THREAD__)
+// GCC defines __SANITIZE_THREAD__; clang's TSAN only advertises itself
+// via __has_feature(thread_sanitizer) — without the second clause a
+// clang TSAN build would compile no edges and resurface the 64
+// libgomp-barrier false positives these exist to suppress
+#if !defined(DMLC_TSAN_ENABLED) && defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define DMLC_TSAN_ENABLED 1
+#endif
+#endif
+#if defined(__SANITIZE_THREAD__) && !defined(DMLC_TSAN_ENABLED)
+#define DMLC_TSAN_ENABLED 1
+#endif
+#if defined(DMLC_TSAN_ENABLED)
   // TSAN-only: explicit release/acquire edges mirroring BOTH OpenMP
   // barriers.  The fork barrier (main's cuts/blocks writes → worker
   // reads) and the join barrier (worker block writes → main's merge

@@ -8,10 +8,12 @@ from .collectives import (allreduce, broadcast, allgather,  # noqa: F401
 from .tracker import (RabitTracker, PSTracker, compute_tree,  # noqa: F401
                       compute_ring)
 from .rabit import RabitContext  # noqa: F401
+from .elastic import ElasticJaxMesh  # noqa: F401
 
 __all__ = [
     "PSTracker",
     "make_mesh", "parse_mesh_spec", "data_parallel_mesh", "process_mesh_info",
     "allreduce", "broadcast", "allgather", "reduce_scatter", "MeshCollectives",
     "RabitTracker", "compute_tree", "compute_ring", "RabitContext",
+    "ElasticJaxMesh",
 ]

@@ -1,0 +1,238 @@
+"""Elastic rejoin for the JAX process mesh (SURVEY §7 hard part (c)).
+
+``jax.distributed`` has no native elasticity: one dead process wedges every
+collective in its generation, and the coordination service cannot admit a
+late joiner into a running cohort.  The reference faces the same problem
+for rabit and solves it through the always-up tracker: a reborn worker
+registers ``recover``, the tracker bumps the link generation, survivors
+re-link (`/root/reference/tracker/dmlc_tracker/tracker.py:279-291`).
+
+This module re-expresses that protocol for the JAX mesh, with a clean
+split of planes:
+
+* **control plane** — the rabit host collectives (brokered TCP via our
+  tracker) already survive process death: the reborn process re-registers
+  with ``recover`` and survivors re-link transparently inside
+  ``RabitContext._with_recovery``.  Generation AGREEMENT therefore rides a
+  rabit ``allreduce(max)``, which is exactly the piece of state that must
+  outlive the broken data plane.
+* **data plane** — generation ``g`` of the JAX mesh lives at coordinator
+  address ``host:base_port+g``.  Re-initialization is a full teardown:
+  ``jax.distributed.shutdown()`` + ``jax.extend.backend.clear_backends()``
+  + ``initialize()`` at the new generation's port with the SAME
+  process_id/world size.  (Donated/live device arrays die with the old
+  backend — callers restore state from their checkpoint, the same
+  contract as a reference worker reborn from ``LoadCheckPoint``.)
+
+Protocol (:meth:`ElasticJaxMesh.resync`): every process proposes a
+generation — survivors their current one, a reborn process (detected via
+``DMLC_NUM_ATTEMPT`` > 0, or any process whose last collective raised)
+current+1 — the rabit ``allreduce(max)`` agrees, and everyone at a lower
+generation tears down and re-initializes.  Calling ``resync`` between
+training phases is the sync-point pattern: cheap (one tiny host
+allreduce), and a death anywhere surfaces at the next sync point instead
+of wedging a device collective forever.
+
+Proven end-to-end in
+``tests/test_tracker_rabit.py::test_elastic_jax_mesh_rejoin_after_kill``:
+rank 2 of 3 is killed mid-job, relaunched with a bumped attempt, and the
+post-rejoin global-mesh reduction is bit-correct on every process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..utils import check, get_env, log_info, log_warning
+from .rabit import RabitContext
+
+__all__ = ["ElasticJaxMesh"]
+
+
+class ElasticJaxMesh:
+    """Generation-addressed ``jax.distributed`` membership with rejoin.
+
+    Parameters
+    ----------
+    ctx:        the process's :class:`RabitContext` (control plane).
+    base_port:  coordinator port of generation 0; generation ``g`` binds
+                ``base_port + g`` (a dead generation's socket may linger in
+                TIME_WAIT, so each generation gets a fresh port).
+    host:       coordinator host (process 0's address, default from
+                ``DMLC_ELASTIC_HOST`` or 127.0.0.1).
+    num_processes/process_id: mesh shape; default from the rabit context.
+    """
+
+    def __init__(self, ctx: RabitContext, base_port: int,
+                 host: str = "", num_processes: int = 0,
+                 process_id: Optional[int] = None) -> None:
+        self.ctx = ctx
+        self.base_port = int(base_port)
+        self.host = host or os.environ.get("DMLC_ELASTIC_HOST", "127.0.0.1")
+        self.num_processes = num_processes or ctx.world_size
+        self.process_id = ctx.rank if process_id is None else process_id
+        self.generation = -1            # not initialized yet
+        # a reborn process must drag the cohort forward: its previous
+        # incarnation died inside some generation g, so it proposes g+1.
+        # DMLC_NUM_ATTEMPT is the launcher's rebirth marker (every backend
+        # sets it on retry) — the same signal that flips rabit to recover.
+        self._dirty = get_env("DMLC_NUM_ATTEMPT", 0) > 0
+
+    # -- data-plane lifecycle --------------------------------------------
+    def _coordinator(self, gen: int) -> str:
+        return f"{self.host}:{self.base_port + gen}"
+
+    def _teardown(self, final: bool = False) -> None:
+        import jax
+        import jax.extend as jex
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # noqa: BLE001 — half-dead service
+            log_warning("elastic: shutdown of generation %d raised (%s) — "
+                        "proceeding", self.generation, e)
+            from jax._src import distributed as _dist
+            # clear the half-shut state so exit hooks / the re-init
+            # don't trip over a client the failed shutdown left behind
+            _dist.global_state.preemption_sync_manager = None
+            _dist.global_state.client = None
+            _dist.global_state.service = None
+        if not final:
+            # the old backend holds client handles into the dead
+            # coordination service; initialize() refuses to run while any
+            # backend lives
+            jex.backend.clear_backends()
+
+    def _barrier(self, tag: str) -> None:
+        """Control-plane rendezvous (cheap host allreduce; the rabit layer
+        re-links around dead/reborn peers on its own)."""
+        try:
+            self.ctx.allreduce(np.array([0], np.int64), "max")
+        except Exception as e:  # noqa: BLE001
+            log_warning("elastic: %s barrier failed (%s)", tag, e)
+
+    def ensure(self, gen: int) -> None:
+        """Make this process a member of mesh generation ``gen``.
+
+        COLLECTIVE: every cohort member must call this with the same
+        target generation (``resync`` guarantees it) — the teardown of
+        the previous generation is ORDERED over the control plane.
+        Follower clients must disconnect while the leader's coordination
+        service still lives: a heartbeat or ShutdownTask RPC that lands
+        on a torn-down service kills the whole process with an
+        uncatchable C++ ``LOG(FATAL)`` (client.h "Terminating process…"),
+        observed live when the leader rebuilt first.  The barriers are
+        cohort-wide, so a reborn member (nothing to tear down) still
+        paces the rendezvous and the rabit seq counters stay aligned.
+        """
+        check(gen >= 0, "generation must be >= 0")
+        if gen == self.generation:
+            return
+        import jax
+        # without this, the coordination client's error-polling thread
+        # LOG(FATAL)s the WHOLE process the moment any peer dies ("client.h
+        # Terminating process because the JAX distributed service detected
+        # fatal errors") — survivors must outlive a peer death to rejoin
+        jax.config.update("jax_enable_recoverability", True)
+        self._barrier("pre-rebuild")
+        if self.process_id != 0:
+            if self.generation >= 0:
+                self._teardown()
+            self._barrier("followers-down")
+        else:
+            self._barrier("followers-down")
+            if self.generation >= 0:
+                self._teardown()
+        log_info("elastic: joining mesh generation %d at %s "
+                 "(process %d/%d)", gen, self._coordinator(gen),
+                 self.process_id, self.num_processes)
+        # short heartbeat/shutdown budgets (env-tunable): a dead peer must
+        # be detected in seconds, and teardown of a broken generation must
+        # be BOUNDED — the default 300 s shutdown timeout lets the gen-g
+        # service (process 0) and a surviving client block each other long
+        # enough that the gen-g+1 rendezvous misses ITS window.  The next
+        # generation is a fresh service on a fresh port; nothing of the
+        # old one is worth waiting minutes for.
+        jax.distributed.initialize(
+            coordinator_address=self._coordinator(gen),
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+            heartbeat_timeout_seconds=int(
+                os.environ.get("DMLC_ELASTIC_HEARTBEAT_S", "10")),
+            shutdown_timeout_seconds=int(
+                os.environ.get("DMLC_ELASTIC_SHUTDOWN_S", "10")))
+        self.generation = gen
+        self._dirty = False
+
+    # -- failure handling -------------------------------------------------
+    def mark_failed(self) -> None:
+        """Record that a data-plane collective failed (caller caught the
+        exception); the next :meth:`resync` proposes a bump."""
+        self._dirty = True
+
+    def resync(self) -> bool:
+        """Sync point: agree on the cohort's generation over the control
+        plane and re-initialize if it moved.  Returns True iff the mesh
+        was rebuilt (callers then restore device state from checkpoint).
+
+        Two host ``allreduce(max)`` rounds — the rabit layer re-links
+        around dead/reborn peers on its own (tracker ``recover``), so this
+        works exactly when the data plane is broken:
+
+        1. *learn*: max over every process's current generation — a reborn
+           process arrives at generation -1 and must not guess the
+           cohort's position;
+        2. *agree*: dirty processes (reborn, or survivors whose last
+           device collective raised) propose cohort+1, the rest cohort;
+           the max wins and everyone below it rebuilds.
+        """
+        cohort = int(self.ctx.allreduce(
+            np.array([self.generation], np.int64), "max")[0])
+        propose = cohort + 1 if self._dirty else cohort
+        agreed = int(self.ctx.allreduce(
+            np.array([propose], np.int64), "max")[0])
+        agreed = max(agreed, 0)   # first-ever sync point: start at gen 0
+        if agreed == self.generation:
+            return False
+        self.ensure(agreed)
+        return True
+
+    def initialize(self) -> None:
+        """First join: generation 0, or — when reborn — whatever the
+        surviving cohort agrees at the sync point."""
+        if self._dirty:
+            # don't guess the cohort's current generation; ask it
+            self.resync()
+        else:
+            self.ensure(0)
+
+    def close(self) -> None:
+        """Graceful ORDERED cohort exit.
+
+        Recoverable-task mode skips the coordination service's
+        synchronized Shutdown barrier by design (the service says so in
+        its log), so an unordered exit races: the leader (process 0, who
+        HOSTS the service) can finish its own shutdown and exit while a
+        follower's ShutdownTask RPC is in flight — and the follower side
+        fails with an uncatchable C++ ``LOG(FATAL)`` (client.h
+        "Terminating process…"), killing the process after all its work
+        succeeded.  The control plane sequences the teardown instead:
+
+        1. barrier: everyone has finished computing;
+        2. followers disconnect (their ShutdownTask lands on a live
+           service);
+        3. barrier: followers confirm they are out;
+        4. the leader tears down client + service last.
+        """
+        if self.generation < 0:
+            return
+        self._barrier("pre-close")
+        if self.process_id != 0:
+            self._teardown(final=True)
+            self._barrier("followers-out")
+        else:
+            self._barrier("followers-out")
+            self._teardown(final=True)
+        self.generation = -1

@@ -96,6 +96,16 @@ case "${{DMLC_TASK_ID}}" in
     echo "dmlc wrapper: task id '${{DMLC_TASK_ID}}' is not a number" >&2
     exit 1;;
 esac
+# supervisor-side node blacklist (yarn_am: REST submissions cannot carry
+# an explicit node exclusion, so the wrapper enforces it — landing on a
+# blacklisted node fails fast and the retry places elsewhere)
+if [ -n "${{DMLC_BLACKLISTED_NODES:-}}" ]; then
+  case ",${{DMLC_BLACKLISTED_NODES}}," in
+    (*",$(hostname -s),"*|*",$(hostname -f 2>/dev/null || hostname),"*)
+      echo "dmlc wrapper: node $(hostname) is blacklisted — exiting" >&2
+      exit 1;;
+  esac
+fi
 if [ "${{DMLC_TASK_ID}}" -ge "{nproc}" ]; then
   echo "dmlc wrapper: task id '${{DMLC_TASK_ID}}' outside cohort of {nproc}" >&2
   exit 1

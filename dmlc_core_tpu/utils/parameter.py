@@ -71,7 +71,11 @@ class FieldEntry:
         self.upper = upper_bound
         if range is not None:
             self.lower, self.upper = range
-        self.enum = list(enum) if enum is not None else None
+        # a callable enum is a LAZY domain, re-evaluated at each check:
+        # registry-derived choice lists (e.g. the CLI's model enum) must
+        # see entries registered after this field's class body ran
+        self.enum = (enum if callable(enum) else list(enum)) \
+            if enum is not None else None
         self.aliases = list(aliases)
         self.optional = optional
         self.validate = validate
@@ -139,10 +143,12 @@ class FieldEntry:
                 f"(expect {self.dtype.__name__}): {e}") from None
         if v is None:
             return v
-        if self.enum is not None and v not in self.enum:
-            raise ParamError(
-                f"Invalid value {v!r} for parameter '{self.name}': "
-                f"expected one of {self.enum}")
+        if self.enum is not None:
+            domain = list(self.enum()) if callable(self.enum) else self.enum
+            if v not in domain:
+                raise ParamError(
+                    f"Invalid value {v!r} for parameter '{self.name}': "
+                    f"expected one of {domain}")
         # range semantics mirror reference set_range/set_lower_bound: inclusive
         # bounds, violation raises ParamError (`parameter.h:646-700`).
         if self.lower is not None and v < self.lower:
@@ -166,7 +172,8 @@ class FieldEntry:
         else:
             parts.append(f"(default={self.default!r})")
         if self.enum is not None:
-            parts.append(f"choices={self.enum}")
+            parts.append(f"choices="
+                         f"{list(self.enum()) if callable(self.enum) else self.enum}")
         if self.lower is not None or self.upper is not None:
             parts.append(f"range=[{self.lower}, {self.upper}]")
         head = " ".join(parts)

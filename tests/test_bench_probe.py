@@ -182,6 +182,39 @@ def test_consume_batch_completion_accumulator(bench_mod):
     bench_mod.prove_consumed(None)      # empty stream: no-op
 
 
+def test_probe_fast_fail_grant_check(bench_mod, capfd, monkeypatch):
+    """VERDICT r4 #5: a driver run against a dead/absent tunnel must fall
+    back in minutes, not ~20.  With the backend pinned to cpu the tiny-put
+    grant check reports 'cpu' immediately; probe_tpu must return False
+    WITHOUT ever reaching the patient full probe (whose 600 s budget is
+    the thing the fast-fail protects)."""
+    monkeypatch.delenv("DMLC_FORCE_CPU", raising=False)
+    # tiny budget: the probe child either reports platform=cpu instantly
+    # or hangs on a dead/queued tunnel claim — both must resolve to False
+    # within the fast-fail window, never reaching the patient full probe
+    monkeypatch.setenv("DMLC_TPU_PROBE_FAST_S", "5")
+    monkeypatch.setenv("DMLC_TPU_PROBE_FAST_TOTAL_S", "8")
+    import time as _t
+    t0 = _t.monotonic()
+    assert bench_mod.probe_tpu() is False
+    err = capfd.readouterr().err
+    assert "grant-check" in err
+    assert "[full" not in err           # fast-fail short-circuited
+    assert _t.monotonic() - t0 < 60
+
+
+def test_probe_fast_fail_disabled_env(bench_mod, capfd, monkeypatch):
+    """DMLC_TPU_PROBE_FAST_S=0 skips stage 1 (harvest-loop mode keeps its
+    own patient budget via DMLC_TPU_PROBE_S)."""
+    monkeypatch.delenv("DMLC_FORCE_CPU", raising=False)
+    monkeypatch.setenv("DMLC_TPU_PROBE_FAST_S", "0")
+    monkeypatch.setenv("DMLC_TPU_PROBE_S", "5")
+    assert bench_mod.probe_tpu() is False
+    err = capfd.readouterr().err
+    assert "grant-check" not in err
+    assert "[full" in err
+
+
 def test_measure_link_verified_cpu(bench_mod):
     """The link probe must survive any backend (it is optional context in
     the bench JSON): on CPU it measures host 'puts' and returns > 0; it

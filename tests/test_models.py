@@ -49,6 +49,84 @@ def test_logreg_learns(tmp_path):
     assert corr / tot > 0.88
 
 
+def _per_step_baseline(model, path, batch_rows, nnz_cap, n_epochs=1):
+    """The classic one-dispatch-per-step loop the fused trainer replaces."""
+    opt = optax.adam(0.05)
+    params = model.init(jax.random.PRNGKey(7))
+    opt_state = opt.init(params)
+    step = make_train_step(model, opt)
+    loader = DeviceLoader(create_parser(path), batch_rows=batch_rows,
+                          nnz_cap=nnz_cap)
+    try:
+        for _ in range(n_epochs):
+            for b in loader:
+                params, opt_state, loss = step(params, opt_state, b)
+            loader.before_first()
+    finally:
+        loader.close()
+    return params, float(loss)
+
+
+@pytest.mark.parametrize("k", [1, 4, 7])
+def test_fused_kstep_matches_per_step(tmp_path, k):
+    """lax.scan k-step dispatch follows the SAME SGD trajectory as the
+    per-step loop (stream order preserved across meta-change flushes and
+    the partial tail group)."""
+    from dmlc_core_tpu.models import FusedTrainer
+
+    rng = np.random.default_rng(3)
+    path = str(tmp_path / "lin.libsvm")
+    write_linear_dataset(path, rng, n=1100, f=60)  # 1100/128 -> tail batch
+    model = FactorizationMachine(num_features=60, dim=4)
+    ref_params, ref_loss = _per_step_baseline(model, path, 128, 2048)
+
+    opt = optax.adam(0.05)
+    loader = DeviceLoader(create_parser(path), batch_rows=128, nnz_cap=2048,
+                          emit="host")
+    try:
+        tr = FusedTrainer(model, opt, loader, k=k, seed=7)
+        loss = tr.run_epoch()
+    finally:
+        loader.close()
+    assert tr.steps == 9  # ceil(1100/128): every batch trained exactly once
+    for key in ref_params:
+        np.testing.assert_allclose(np.asarray(tr.params[key]),
+                                   np.asarray(ref_params[key]),
+                                   rtol=1e-5, atol=1e-6)
+    assert abs(loss - ref_loss) < 1e-4
+
+
+def test_fused_kstep_meta_change_flush(tmp_path):
+    """Rows with wildly different nnz force multiple packer buckets; the
+    trainer must flush on meta change and still train every batch once."""
+    from dmlc_core_tpu.models import FusedTrainer
+
+    rng = np.random.default_rng(4)
+    path = str(tmp_path / "var.libsvm")
+    with open(path, "w") as fh:
+        for i in range(600):
+            # alternate sparse / dense blocks to swing the nnz bucket
+            nnz = 2 if (i // 64) % 2 == 0 else 30
+            idx = np.sort(rng.choice(60, size=nnz, replace=False))
+            y = i % 2
+            fh.write(f"{y} " + " ".join(
+                f"{j}:{v:.3f}" for j, v in zip(idx, rng.random(nnz))) + "\n")
+    model = FactorizationMachine(num_features=60, dim=4)
+    ref_params, _ = _per_step_baseline(model, path, 64, 64 * 32)
+    loader = DeviceLoader(create_parser(path), batch_rows=64,
+                          nnz_cap=64 * 32, emit="host")
+    try:
+        tr = FusedTrainer(model, optax.adam(0.05), loader, k=4, seed=7)
+        tr.run_epoch()
+    finally:
+        loader.close()
+    assert tr.steps == 10  # ceil(600/64)
+    for key in ref_params:
+        np.testing.assert_allclose(np.asarray(tr.params[key]),
+                                   np.asarray(ref_params[key]),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_fm_learns_interactions(tmp_path):
     # labels depend ONLY on a feature pair interaction — linear can't fit it
     rng = np.random.default_rng(1)
@@ -368,4 +446,22 @@ def test_dcn_registered_in_cli():
     p = TrainParams()
     p.init({"data": "x.libsvm", "model": "dcn"})
     assert p.model == "dcn"
+
+
+def test_plugin_model_registered_after_import_validates():
+    """The model enum is LAZY (ADVICE r4): a model registered after
+    models.cli imported — a user plugin — must pass TrainParams
+    validation, not just MODEL_REGISTRY.find."""
+    from dmlc_core_tpu.models.cli import MODEL_REGISTRY, TrainParams
+
+    name = "plugin_model_under_test"
+    MODEL_REGISTRY.register(name, "late-registered plugin")(lambda p: None)
+    try:
+        p = TrainParams()
+        p.init({"data": "x.libsvm", "model": name})
+        assert p.model == name
+    finally:
+        MODEL_REGISTRY.remove(name)
+    with pytest.raises(Exception):
+        TrainParams().init({"data": "x.libsvm", "model": name})
 

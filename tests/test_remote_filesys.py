@@ -8,6 +8,7 @@ import hashlib
 import io
 import json
 import os
+import socket
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -84,17 +85,35 @@ class _RangeHTTPHandler(BaseHTTPRequestHandler):
 
 class _FakeS3Handler(BaseHTTPRequestHandler):
     """Minimal S3: GET/HEAD object (+Range), PUT object, multipart upload,
-    ListObjectsV2. Verifies every request carries a SigV4 Authorization."""
+    ListObjectsV2. Verifies every request carries a SigV4 Authorization.
+
+    Fault injection (VERDICT r4 #10): push op names onto ``fail_next``
+    ("initiate" | "part" | "complete") and the NEXT matching request is
+    severed after its body is read — the request reached the server, the
+    response never arrives, exactly a connection dropped mid-upload."""
     objects = {}          # "bucket/key" -> bytes
     uploads = {}          # upload_id -> {part_no: bytes}
     auth_seen = []
     next_upload = [0]
+    fail_next = []        # queue of ops to sever
+    part_attempts = []    # part numbers as the server saw them, in order
 
     def log_message(self, *a):
         pass
 
     def _record_auth(self):
         type(self).auth_seen.append(self.headers.get("Authorization", ""))
+
+    def _maybe_drop(self, op: str) -> bool:
+        if type(self).fail_next and type(self).fail_next[0] == op:
+            type(self).fail_next.pop(0)
+            self.close_connection = True
+            try:                      # sever with zero response bytes
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return True
+        return False
 
     def _obj_key(self):
         return urllib.parse.unquote(self.path.split("?")[0].lstrip("/"))
@@ -168,6 +187,9 @@ class _FakeS3Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         if "partNumber" in q:
+            type(self).part_attempts.append(int(q["partNumber"]))
+            if self._maybe_drop("part"):
+                return                # body consumed, response severed
             up = self.uploads.setdefault(q["uploadId"], {})
             up[int(q["partNumber"])] = body
             etag = hashlib.md5(body).hexdigest()
@@ -198,6 +220,8 @@ class _FakeS3Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         self.rfile.read(length)
         if "uploads" in q:
+            if self._maybe_drop("initiate"):
+                return
             self.next_upload[0] += 1
             uid = f"upload-{self.next_upload[0]}"
             self.uploads[uid] = {}
@@ -209,6 +233,8 @@ class _FakeS3Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         if "uploadId" in q:
+            if self._maybe_drop("complete"):
+                return
             parts = self.uploads.pop(q["uploadId"], {})
             data = b"".join(parts[i] for i in sorted(parts))
             self.objects[self._obj_key()] = data
@@ -369,6 +395,8 @@ def s3_server(monkeypatch):
     _FakeS3Handler.objects = {}
     _FakeS3Handler.uploads = {}
     _FakeS3Handler.auth_seen = []
+    _FakeS3Handler.fail_next = []
+    _FakeS3Handler.part_attempts = []
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3Handler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -521,6 +549,85 @@ def test_s3_multipart_upload(s3_server, monkeypatch):
     w.close()
     assert _FakeS3Handler.objects["bkt/big.bin"] == data
     assert _FakeS3Handler.uploads == {}  # upload completed and cleaned
+
+
+def test_s3_multipart_part_retry_on_dropped_connection(s3_server):
+    """VERDICT r4 #10 (write-side restart-on-seek): a connection severed
+    mid-UploadPart is retried — same partNumber+uploadId, so the re-PUT
+    replaces the part idempotently — and the final object is bit-exact."""
+    from dmlc_core_tpu.io import remote_filesys
+    fs = remote_filesys.S3FileSystem(part_size=1024)
+    data = os.urandom(5 * 1024 + 77)
+    _FakeS3Handler.fail_next = ["part"]        # sever the FIRST part PUT
+    with fs.open(URI("s3://bkt/retry.bin"), "w") as w:
+        w.write(data)
+    assert _FakeS3Handler.objects["bkt/retry.bin"] == data
+    assert _FakeS3Handler.uploads == {}
+    # part 1 reached the server twice (drop + retry); each part exactly
+    # once thereafter — no duplicated or skipped part numbers
+    assert _FakeS3Handler.part_attempts[:2] == [1, 1]
+    assert _FakeS3Handler.part_attempts[2:] == sorted(
+        set(_FakeS3Handler.part_attempts[2:]))
+    assert _FakeS3Handler.fail_next == []      # the fault actually fired
+
+
+def test_s3_multipart_initiate_retry_on_dropped_connection(s3_server):
+    """A severed InitiateMultipartUpload retries (the lost response only
+    orphans an upload id server-side) and the write still publishes."""
+    from dmlc_core_tpu.io import remote_filesys
+    fs = remote_filesys.S3FileSystem(part_size=1024)
+    data = os.urandom(3 * 1024)
+    _FakeS3Handler.fail_next = ["initiate"]
+    with fs.open(URI("s3://bkt/init.bin"), "w") as w:
+        w.write(data)
+    assert _FakeS3Handler.objects["bkt/init.bin"] == data
+    assert _FakeS3Handler.fail_next == []
+
+
+def test_s3_multipart_complete_fault_surfaces(s3_server):
+    """CompleteMultipartUpload is deliberately single-shot (a blind
+    re-send after server-side success errors NoSuchUpload): a severed
+    complete must surface as an error, never a silent fake success."""
+    from dmlc_core_tpu.io import remote_filesys
+    from dmlc_core_tpu.utils.logging import DMLCError
+    fs = remote_filesys.S3FileSystem(part_size=1024)
+    _FakeS3Handler.fail_next = ["complete"]
+    w = fs.open(URI("s3://bkt/cmpl.bin"), "w")
+    w.write(os.urandom(2048))
+    with pytest.raises(DMLCError):
+        w.close()
+    assert "bkt/cmpl.bin" not in _FakeS3Handler.objects
+
+
+def test_s3_abort_cleans_up_upload(s3_server):
+    """abort() mid-write: AbortMultipartUpload removes the pending parts
+    server-side and the object is never published (the crash-path analog
+    of the checkpoint atomic-publish discipline)."""
+    from dmlc_core_tpu.io import remote_filesys
+    fs = remote_filesys.S3FileSystem(part_size=1024)
+    w = fs.open(URI("s3://bkt/aborted.bin"), "w")
+    w.write(os.urandom(4096))          # at least one part uploaded
+    assert _FakeS3Handler.uploads      # upload open, parts pending
+    w.abort()
+    assert _FakeS3Handler.uploads == {}            # parts discarded
+    assert "bkt/aborted.bin" not in _FakeS3Handler.objects
+
+
+def test_s3_abort_after_part_fault(s3_server):
+    """Error path end-to-end: if a part ultimately fails (all retries
+    severed), the caller aborts; no object appears and the upload is
+    cleaned."""
+    from dmlc_core_tpu.io import remote_filesys
+    from dmlc_core_tpu.utils.logging import DMLCError
+    fs = remote_filesys.S3FileSystem(part_size=1024)
+    # sever the same part PUT more times than _MAX_RETRY allows
+    _FakeS3Handler.fail_next = ["part"] * 5
+    w = fs.open(URI("s3://bkt/doomed.bin"), "w")
+    with pytest.raises(DMLCError):
+        w.write(os.urandom(8 * 1024))
+    w.abort()
+    assert _FakeS3Handler.uploads == {}
+    assert "bkt/doomed.bin" not in _FakeS3Handler.objects
 
 
 def test_s3_seek_read(s3_server):

@@ -177,3 +177,120 @@ def test_pstracker_env_and_scheduler_spawn():
     t.start()
     assert t.join() == 0
     t.stop()
+
+
+ELASTIC_WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+# OVERRIDE (not append): under pytest the parent env carries conftest's
+# device_count=8 flag; 8 virtual devices per process would make a
+# 24-device mesh whose dp axis cannot divide this worker's tiny arrays
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge
+xla_bridge._backend_factories.pop("axon", None)
+import numpy as np
+from dmlc_core_tpu.parallel import ElasticJaxMesh, RabitContext
+
+attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
+base_port = int(os.environ["ELASTIC_BASE_PORT"])
+ctx = RabitContext.from_env()
+if attempt > 0:
+    # reference LoadCheckPoint contract: restoring fast-forwards the rabit
+    # seq so the reborn worker's control-plane frames align with survivors
+    state = ctx.load_checkpoint()
+    assert state == {"phase": 1}, state
+mesh = ElasticJaxMesh(ctx, base_port)
+mesh.initialize()
+from jax.experimental import multihost_utils
+if attempt == 0:
+    assert mesh.generation == 0
+    g = multihost_utils.process_allgather(
+        np.array([float(ctx.rank + 1)], np.float32))
+    assert float(g.sum()) == 6.0, g
+    # one control-plane collective so seq alignment is actually exercised
+    rows = ctx.allreduce(np.array([100.0], np.float32))
+    assert float(rows[0]) == 300.0
+    ctx.checkpoint({"phase": 1})
+    if ctx.rank == 2:
+        print("DYING", ctx.rank, flush=True)
+        os._exit(7)                      # crash: no shutdown, no goodbye
+    changed = mesh.resync()              # sync point: survivors rebuild
+    assert changed, "survivors must observe the bumped generation"
+assert mesh.generation == 1, mesh.generation
+# post-rejoin reduction over the REBUILT jax mesh: value read-back proves
+# the generation-1 collective is correct on every process
+g2 = multihost_utils.process_allgather(
+    np.array([10.0 * (ctx.rank + 1)], np.float32))
+assert float(g2.sum()) == 60.0, g2
+import jax.numpy as jnp
+total = float(jax.jit(jnp.sum)(
+    multihost_utils.host_local_array_to_global_array(
+        np.full((2, 2), float(ctx.rank + 1), np.float32),
+        jax.sharding.Mesh(np.array(jax.devices()), ("dp",)),
+        jax.sharding.PartitionSpec("dp"))))
+assert total == 2 * 2 * 6.0, total
+print("ELASTIC-OK", ctx.rank, mesh.generation, flush=True)
+mesh.close()
+ctx.shutdown()
+'''
+
+
+def test_elastic_jax_mesh_rejoin_after_kill(tmp_path):
+    """VERDICT r4 #9 (SURVEY §7 hard part (c)): kill one jax.distributed
+    process mid-job; the launcher respawns it (DMLC_NUM_ATTEMPT=1), the
+    cohort agrees a new mesh generation over the rabit control plane, every
+    process re-initializes, and a post-rejoin psum/allgather is correct."""
+    import socket as _socket
+    import subprocess
+    import sys
+
+    # two consecutive free ports: generation 0 and the post-rejoin gen 1
+    for _ in range(20):
+        s0, s1 = _socket.socket(), _socket.socket()
+        try:
+            s0.bind(("127.0.0.1", 0))
+            p = s0.getsockname()[1]
+            s1.bind(("127.0.0.1", p + 1))
+            break
+        except OSError:
+            continue
+        finally:
+            s0.close()
+            s1.close()
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(ELASTIC_WORKER)
+    tracker = RabitTracker(num_workers=3, host_ip="127.0.0.1")
+    tracker.start()
+    # generous timeouts: this 1-core host time-slices these 3 jax
+    # processes against whatever else runs (harvest probes, CI); the
+    # budgets only bound the failure case — a healthy run takes ~2 min
+    base_env = {**os.environ, **tracker.worker_envs(),
+                "PYTHONPATH": "/root/repo", "ELASTIC_BASE_PORT": str(p),
+                "DMLC_CHECKPOINT_DIR": str(tmp_path),
+                "DMLC_CONNECT_TIMEOUT": "120",
+                "DMLC_RECOVER_TIMEOUT": "300"}
+
+    def spawn(rank, att):
+        env = dict(base_env, DMLC_TASK_ID=str(rank),
+                   DMLC_NUM_ATTEMPT=str(att))
+        return subprocess.Popen([sys.executable, str(script)], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+    procs = {i: spawn(i, 0) for i in range(3)}
+    try:
+        assert procs[2].wait(timeout=300) == 7      # crashed as scripted
+        procs[2] = spawn(2, 1)                      # launcher-style retry
+        outs = {}
+        for i, pr in procs.items():
+            out, _ = pr.communicate(timeout=480)
+            outs[i] = out
+            assert pr.returncode == 0, (i, out[-2000:])
+        for i in range(3):
+            assert f"ELASTIC-OK {i} 1" in outs[i], outs[i][-1500:]
+    finally:
+        for pr in procs.values():
+            if pr.poll() is None:
+                pr.kill()
+        tracker.stop()
