@@ -302,3 +302,29 @@ def test_cli_trains_dcn(libsvm_file, tmp_path):
     assert "train AUC" in out.stdout, out.stdout
     auc = float(out.stdout.split("train AUC")[1].split()[0])
     assert auc > 0.7, out.stdout
+
+
+def test_cli_kstep_fused_matches_per_step(libsvm_file, tmp_path):
+    """kstep=N routes training through the fused k-step dispatch; the SGD
+    trajectory (and so the final loss/AUC) matches the per-step loop, and
+    periodic checkpointing still fires at the group-crossed cadence."""
+    ck = tmp_path / "ck_fused"
+    base = [f"data={libsvm_file}", "model=fm", "features=64", "dim=4",
+            "epochs=2", "batch_rows=128", "nnz_cap=2048", "lr=0.05",
+            "log_every=0", "seed=3"]
+    out1 = _run(base)
+    out4 = _run(base + ["kstep=4", f"ckpt_dir={ck}", "ckpt_every=3"])
+    assert out1.returncode == 0, out1.stderr[-2000:]
+    assert out4.returncode == 0, out4.stderr[-2000:]
+    loss1 = float(out1.stdout.split("final loss")[1].split()[0])
+    loss4 = float(out4.stdout.split("final loss")[1].split()[0])
+    assert abs(loss1 - loss4) < 1e-4, (loss1, loss4)
+    auc1 = float(out1.stdout.split("train AUC")[1].split()[0])
+    auc4 = float(out4.stdout.split("train AUC")[1].split()[0])
+    assert abs(auc1 - auc4) < 1e-3, (auc1, auc4)
+    assert "checkpoint step" in out4.stdout
+    assert any(ck.iterdir())
+    # both ran the same number of steps (2 epochs x ceil(800/128))
+    steps1 = out1.stdout.split("trained fm:")[1].split()[0]
+    steps4 = out4.stdout.split("trained fm:")[1].split()[0]
+    assert steps1 == steps4 == "14", (steps1, steps4)
