@@ -36,3 +36,16 @@ def test_readme_quickstart_runs(tmp_path):
     ns: dict = {}
     exec(compile(code, "README.quickstart", "exec"), ns)  # noqa: S102
     assert "loss" in ns and float(ns["loss"]) > 0
+
+    # the fused k-step block must stay executable too (same substitution
+    # discipline; it builds its own loader so it runs standalone after
+    # the quick-start's namespace)
+    assert len(blocks) >= 2, "README lost its FusedTrainer block"
+    code2 = blocks[1]
+    for old, new in subs.items():
+        if old in code2:
+            code2 = code2.replace(old, new)
+    assert "FusedTrainer" in code2
+    exec(compile(code2, "README.fused", "exec"), ns)  # noqa: S102
+    assert float(ns["loss"]) > 0
+    assert ns["trainer"].steps > 0
