@@ -121,8 +121,8 @@ class TrainParams(Parameter):
                        "loop; 8-16 recommended on TPU where per-dispatch "
                        "latency dominates small steps. Same SGD "
                        "trajectory either way. Ignored for ffm (fields "
-                       "ride outside the fused wire) and workers= "
-                       "ingest")
+                       "ride outside the fused wire); composes with "
+                       "workers= ingest")
     log_every = field(int, default=100)
 
 
@@ -294,7 +294,7 @@ def main(argv=None) -> int:
 
     # ONE loader, rewound between epochs (the fit_stream pattern): the
     # parser/transfer threads and pinned buffers are reused, not rebuilt
-    use_fused = p.kstep > 1 and not needs_fields and not p.workers
+    use_fused = p.kstep > 1 and not needs_fields
     if p.workers:
         if needs_fields:
             print("dmlc-train: workers= (fused wire) does not carry "
@@ -306,7 +306,8 @@ def main(argv=None) -> int:
         for tok in p.workers.split(","):
             host, _, port = tok.strip().rpartition(":")
             addrs.append((host, int(port)))
-        loader = RemoteIngestLoader(addrs, batch_rows=p.batch_rows)
+        loader = RemoteIngestLoader(addrs, batch_rows=p.batch_rows,
+                                    emit="host" if use_fused else "device")
     else:
         loader = _make_loader(p, p.data, fmt, needs_fields,
                               emit="host" if use_fused else "device")
@@ -395,8 +396,15 @@ def main(argv=None) -> int:
             if use_fused:
                 # the train loader emits host wire buffers; scoring needs
                 # device batches — a fresh device-mode loader over the
-                # same source
-                auc_loader = _make_loader(p, p.data, fmt, needs_fields)
+                # SAME source: the ingest workers when workers= is set
+                # (p.data may only be readable from the worker hosts), the
+                # local path otherwise
+                if p.workers:
+                    from ..pipeline import RemoteIngestLoader
+                    auc_loader = RemoteIngestLoader(
+                        addrs, batch_rows=p.batch_rows)
+                else:
+                    auc_loader = _make_loader(p, p.data, fmt, needs_fields)
             else:
                 auc_loader = loader
             try:

@@ -144,15 +144,23 @@ class RemoteIngestLoader:
     ``before_first()`` reconnects for the next epoch.  One reader thread
     per worker feeds a bounded queue; the transfer stage is the identical
     fused-buffer ``device_put`` + jitted decode the local loader uses.
+
+    ``emit="host"`` skips the transfer stage and yields the wire frames
+    as ``("fused", buf, meta, rows)`` items — the
+    :class:`~dmlc_core_tpu.models.train.FusedTrainer` contract, so k-step
+    fused training composes with disaggregated ingest (recycle consumed
+    buffers via :meth:`recycle`).
     """
 
     def __init__(self, addresses: Sequence[Tuple[str, int]],
                  batch_rows: int, prefetch: int = 4,
-                 connect_timeout: float = 60.0):
+                 connect_timeout: float = 60.0, emit: str = "device"):
         check(len(addresses) > 0, "need at least one ingest worker")
+        check(emit in ("device", "host"), f"bad emit {emit!r}")
         self.addresses = list(addresses)
         self.batch_rows = batch_rows
         self.connect_timeout = connect_timeout
+        self.emit = emit
         depth = max(2, int(prefetch))
         self._depth = depth
         self._closed = False
@@ -161,8 +169,11 @@ class RemoteIngestLoader:
             max_capacity=max(depth, len(self.addresses)))
         self._gen_lock = threading.Lock()
         self._frames.init(self._frame_source(), self._restart_readers)
-        self._iter: ThreadedIter = ThreadedIter(max_capacity=depth)
-        self._iter.init(self._transfer_next, self._reset_transfer)
+        if emit == "host":
+            self._iter = self._frames          # stage 1 only
+        else:
+            self._iter = ThreadedIter(max_capacity=depth)
+            self._iter.init(self._transfer_next, self._reset_transfer)
 
     # -- reader side: N sockets → one queue ---------------------------
     def _spawn_readers(self) -> dict:
@@ -289,18 +300,21 @@ class RemoteIngestLoader:
             self._cancel_readers(self._frame_holder["state"])
             self._frame_holder["state"] = None         # reconnect lazily
 
-    # -- transfer side (same as DeviceLoader's fused path) -------------
-    def _transfer_next(self, _cell):
-        item = self._frames.next()
-        if item is None:
-            return None
-        view, meta, rows, buf = item
+    def _check_frame(self, view, meta) -> None:
         expected = _fused_words_meta(self.batch_rows, int(meta))
         if expected != len(view):
             raise DMLCError(
                 f"ingest frame size mismatch: worker sent {len(view)} "
                 f"words but batch_rows={self.batch_rows} implies "
                 f"{expected} — trainer and worker batch_rows differ")
+
+    # -- transfer side (same as DeviceLoader's fused path) -------------
+    def _transfer_next(self, _cell):
+        item = self._frames.next()
+        if item is None:
+            return None
+        view, meta, rows, buf = item
+        self._check_frame(view, meta)
         self._maybe_bind()
         with self._m_h2d.time():
             out = _put_fused_buf(view, self.batch_rows, meta)
@@ -328,13 +342,29 @@ class RemoteIngestLoader:
     # -- consumer surface ----------------------------------------------
     def __iter__(self):
         while True:
-            b = self._iter.next()
+            b = self.next_batch()
             if b is None:
                 return
             yield b
 
     def next_batch(self):
-        return self._iter.next()
+        item = self._iter.next()
+        if item is None or self.emit == "device":
+            return item
+        # host mode: adapt the frame tuple to the FusedTrainer item
+        # contract — same size validation and telemetry as the transfer
+        # stage (a workers=+kstep run must not report zero ingest rows)
+        view, meta, rows, buf = item
+        self._check_frame(view, meta)
+        self._maybe_bind()
+        self._m_batches.add(1)
+        if rows is not None:
+            self._m_rows.add(rows)
+        return ("fused", buf, int(meta), rows)
+
+    def recycle(self, buf) -> None:
+        """Return a consumed host frame buffer (emit='host' mode)."""
+        self._pool.put(buf)
 
     def before_first(self) -> None:
         self._iter.before_first()
@@ -345,7 +375,8 @@ class RemoteIngestLoader:
             self._cancel_readers(self._frame_holder["state"])
             self._frame_holder["state"] = None
         self._frames.destroy()
-        self._iter.destroy()
+        if self._iter is not self._frames:
+            self._iter.destroy()
         self._pool.clear()
 
     def __enter__(self):

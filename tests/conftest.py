@@ -39,3 +39,37 @@ def _force_cpu_jax() -> None:
 
 
 _force_cpu_jax()
+
+
+# -- shared test helpers (imported by test modules via conftest) ----------
+
+def free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def start_ingest_worker(uri: str, part: int, nparts: int,
+                        fmt: str = "libsvm", *, port: int = 0,
+                        batch_rows: int = 64, nnz_cap: int = 1024,
+                        max_epochs: int = 1, **kw) -> int:
+    """Spawn one serve_ingest daemon thread; block until it listens and
+    return its port.  One home for the port-probe + ready-event dance
+    (used by test_ingest_service and the CLI workers= tests)."""
+    import threading
+
+    from dmlc_core_tpu.pipeline import serve_ingest
+    port = port or free_port()
+    ev = threading.Event()
+    threading.Thread(
+        target=serve_ingest,
+        args=(uri, part, nparts, fmt),
+        kwargs=dict(batch_rows=batch_rows, nnz_cap=nnz_cap, port=port,
+                    host="127.0.0.1", max_epochs=max_epochs,
+                    ready_event=ev, **kw),
+        daemon=True).start()
+    assert ev.wait(timeout=30), "ingest worker never became ready"
+    return port

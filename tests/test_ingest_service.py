@@ -14,12 +14,7 @@ jax = pytest.importorskip("jax")
 from dmlc_core_tpu.pipeline import RemoteIngestLoader, serve_ingest  # noqa: E402
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
+from conftest import free_port as _free_port  # noqa: E402  (shared helper)
 
 
 @pytest.fixture()
@@ -36,20 +31,10 @@ def libsvm_file(tmp_path):
 
 
 def _start_workers(uri, nparts, ports, max_epochs, **kw):
-    threads = []
+    from conftest import start_ingest_worker
     for part, port in enumerate(ports):
-        ev = threading.Event()
-        t = threading.Thread(
-            target=serve_ingest,
-            args=(uri, part, nparts, "libsvm"),
-            kwargs=dict(batch_rows=64, nnz_cap=1024, port=port,
-                        host="127.0.0.1", max_epochs=max_epochs,
-                        ready_event=ev, **kw),
-            daemon=True)
-        t.start()
-        assert ev.wait(timeout=30)
-        threads.append(t)
-    return threads
+        start_ingest_worker(uri, part, nparts, port=port,
+                            max_epochs=max_epochs, **kw)
 
 
 def _collect_rows(loader):

@@ -235,22 +235,10 @@ def test_cli_predict_mode_roundtrip(libsvm_file, tmp_path):
 
 def test_cli_trains_from_ingest_workers(libsvm_file, tmp_path):
     """workers= routes the CLI through the disaggregated ingest service."""
-    import socket
-    import threading
-    from dmlc_core_tpu.pipeline import serve_ingest
+    from conftest import start_ingest_worker
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    ev = threading.Event()
-    threading.Thread(
-        target=serve_ingest,
-        args=(f"file://{libsvm_file}", 0, 1, "libsvm"),
-        kwargs=dict(batch_rows=128, nnz_cap=2048, port=port,
-                    host="127.0.0.1", max_epochs=4, ready_event=ev),
-        daemon=True).start()
-    assert ev.wait(timeout=30)
+    port = start_ingest_worker(f"file://{libsvm_file}", 0, 1,
+                               batch_rows=128, nnz_cap=2048, max_epochs=4)
     out = _run([f"data={libsvm_file}", "model=fm", "features=64", "dim=4",
                 f"workers=127.0.0.1:{port}", "batch_rows=128",
                 "nnz_cap=2048", "epochs=2", "log_every=0", "eval_auc=0"])
@@ -328,3 +316,29 @@ def test_cli_kstep_fused_matches_per_step(libsvm_file, tmp_path):
     steps1 = out1.stdout.split("trained fm:")[1].split()[0]
     steps4 = out4.stdout.split("trained fm:")[1].split()[0]
     assert steps1 == steps4 == "14", (steps1, steps4)
+
+
+def test_cli_kstep_with_ingest_workers(libsvm_file, tmp_path):
+    """kstep=N composes with workers= : remote wire frames feed the fused
+    k-step trainer directly (no per-frame transfer stage), and the final
+    loss matches the per-step remote run's trajectory."""
+    from conftest import start_ingest_worker
+
+    def start_worker():
+        return start_ingest_worker(f"file://{libsvm_file}", 0, 1,
+                                   batch_rows=128, nnz_cap=2048,
+                                   max_epochs=2)
+
+    base = ["model=fm", "features=64", "dim=4", "batch_rows=128",
+            "nnz_cap=2048", "epochs=1", "log_every=0", "eval_auc=0",
+            "lr=0.05", "seed=3", f"data={libsvm_file}"]
+    out1 = _run(base + [f"workers=127.0.0.1:{start_worker()}"])
+    out4 = _run(base + [f"workers=127.0.0.1:{start_worker()}", "kstep=4"])
+    assert out1.returncode == 0, out1.stderr[-2000:]
+    assert out4.returncode == 0, out4.stderr[-2000:]
+    loss1 = float(out1.stdout.split("final loss")[1].split()[0])
+    loss4 = float(out4.stdout.split("final loss")[1].split()[0])
+    assert abs(loss1 - loss4) < 1e-4, (loss1, loss4)
+    steps1 = out1.stdout.split("trained fm:")[1].split()[0]
+    steps4 = out4.stdout.split("trained fm:")[1].split()[0]
+    assert steps1 == steps4 == "7", (steps1, steps4)
