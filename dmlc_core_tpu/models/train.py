@@ -345,10 +345,42 @@ def evaluate_stream(model, params, loader, *, mesh: Optional[Mesh] = None,
 def fit_stream(model, loader: DeviceLoader, *, epochs: int = 1,
                optimizer: Optional[optax.GradientTransformation] = None,
                mesh: Optional[Mesh] = None, seed: int = 0,
-               log_every: int = 100):
+               log_every: int = 100, kstep: Optional[int] = None):
     """Streaming training: one pass of the ingest pipeline per epoch
-    (bounded memory — the in-memory analog is BasicRowIter + full-batch)."""
+    (bounded memory — the in-memory analog is BasicRowIter + full-batch).
+
+    A loader built with ``emit="host"`` routes through the k-step fused
+    dispatch (:class:`FusedTrainer`, ``kstep`` steps — default 16 — per
+    device round trip; same SGD trajectory).  On that path ``history``
+    holds one end-of-epoch loss per epoch when ``log_every`` is nonzero
+    (per-step sampling cannot exist inside a fused dispatch), and
+    ``mesh`` is unsupported (single-chip optimization).  A
+    device-emitting loader runs the classic per-step loop; passing
+    ``kstep`` there raises rather than silently ignoring the requested
+    fusion."""
     optimizer = optimizer or optax.adam(1e-2)
+    if getattr(loader, "emit", "device") == "host":
+        if mesh is not None:
+            raise ValueError("fused k-step training is single-chip; use a "
+                             "device-emitting loader with mesh")
+        trainer = FusedTrainer(model, optimizer, loader,
+                               k=16 if kstep is None else kstep, seed=seed)
+        history = []
+        for epoch in range(epochs):
+            with Timer() as t:
+                loss = trainer.run_epoch()
+            loader.before_first()
+            if log_every:
+                history.append(loss)
+            log_info("epoch %d done in %.2fs (%d steps, loss %.5f)",
+                     epoch, t.elapsed, trainer.steps, loss)
+        return trainer.params, history
+    if kstep is not None:
+        raise ValueError(
+            "kstep requires a loader built with emit='host' (the fused "
+            "wire path); this loader emits device batches, so the k-step "
+            "dispatch cannot engage — dropping the request silently "
+            "would run one round trip per step")
     params = model.init(jax.random.PRNGKey(seed))
     shardings = param_shardings(model, params, mesh)
     params = shard_params(params, shardings)

@@ -465,3 +465,78 @@ def test_plugin_model_registered_after_import_validates():
     with pytest.raises(Exception):
         TrainParams().init({"data": "x.libsvm", "model": name})
 
+
+
+def test_fit_stream_host_loader_routes_through_fused(tmp_path):
+    """fit_stream on an emit='host' loader trains via the k-step fused
+    dispatch and learns the same task the per-step path does."""
+    rng = np.random.default_rng(5)
+    path = str(tmp_path / "fs.libsvm")
+    write_linear_dataset(path, rng, n=2500, f=60)
+    model = SparseLogReg(num_features=60)
+    loader = DeviceLoader(create_parser(path), batch_rows=256, nnz_cap=4096,
+                          emit="host")
+    try:
+        params, history = fit_stream(model, loader, epochs=4,
+                                     optimizer=optax.adam(0.05),
+                                     log_every=1, kstep=4)
+    finally:
+        loader.close()
+    assert len(history) == 4 and history[-1] < history[0]
+    # a device-emitting loader must REJECT kstep, not silently ignore it
+    dev_loader = DeviceLoader(create_parser(path), batch_rows=256,
+                              nnz_cap=4096)
+    try:
+        with pytest.raises(ValueError, match="emit='host'"):
+            fit_stream(model, dev_loader, epochs=1, kstep=4)
+    finally:
+        dev_loader.close()
+    ev_loader = DeviceLoader(create_parser(path), batch_rows=256,
+                             nnz_cap=4096)
+    ev = make_eval_step(model)
+    corr = tot = 0.0
+    for b in ev_loader:
+        c, t = ev(params, b)
+        corr += float(c)
+        tot += float(t)
+    ev_loader.close()
+    assert corr / tot > 0.85
+
+
+def test_fused_kstep_fuzz_random_shapes(tmp_path):
+    """Property fuzz: random row-count/nnz-distribution corpora × random k
+    — the fused trainer's step count always equals the per-step loop's,
+    and final params match bitwise-closely regardless of how bucket
+    boundaries and tail groups land."""
+    from dmlc_core_tpu.models import FusedTrainer
+
+    rng = np.random.default_rng(12)
+    for trial in range(4):
+        n = int(rng.integers(150, 900))
+        k = int(rng.integers(2, 9))
+        batch_rows = int(rng.choice([32, 64, 128]))
+        path = str(tmp_path / f"fz{trial}.libsvm")
+        with open(path, "w") as fh:
+            for i in range(n):
+                nnz = int(rng.integers(1, 24))
+                idx = np.sort(rng.choice(60, size=nnz, replace=False))
+                fh.write(f"{i % 2} " + " ".join(
+                    f"{j}:{v:.3f}"
+                    for j, v in zip(idx, rng.random(nnz))) + "\n")
+        model = FactorizationMachine(num_features=60, dim=4)
+        ref_params, _ = _per_step_baseline(model, path, batch_rows,
+                                           batch_rows * 24)
+        loader = DeviceLoader(create_parser(path), batch_rows=batch_rows,
+                              nnz_cap=batch_rows * 24, emit="host")
+        try:
+            tr = FusedTrainer(model, optax.adam(0.05), loader, k=k, seed=7)
+            tr.run_epoch()
+        finally:
+            loader.close()
+        expect_steps = -(-n // batch_rows)
+        assert tr.steps == expect_steps, (trial, n, batch_rows, k)
+        for key in ref_params:
+            np.testing.assert_allclose(
+                np.asarray(tr.params[key]), np.asarray(ref_params[key]),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"trial {trial} n={n} k={k} rows={batch_rows}")
