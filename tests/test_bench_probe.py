@@ -330,3 +330,34 @@ def test_harvest_priority_default_matches_registry(monkeypatch):
     monkeypatch.setenv("DMLC_SUITE_PRIORITY", m.group(1))
     got = bs.resolve_picks([])
     assert got[:len(names)] == names
+
+
+def test_tpu_micro_wire_builder_roundtrips_decoder():
+    """The wire-decode fusion bench's v3 buffer builder must round-trip
+    through the REAL decoder and drive the fused consume jit on CPU — a
+    builder bug must surface here, not during a scarce grant window."""
+    import jax
+    import numpy as np
+
+    from benchmarks.tpu_micro import build_v3_buffer
+    from dmlc_core_tpu.ops.csr import fm_pairwise
+    from dmlc_core_tpu.pipeline.device_loader import make_decoder
+
+    rows, nnz, w = 64, 2048, 20
+    buf, meta, ids, vals = build_v3_buffer(rows, nnz, w, seed=3)
+    decode = make_decoder(rows, meta)
+    d = jax.jit(decode)(buf)
+    np.testing.assert_array_equal(np.asarray(d["ids"]), ids.astype(np.int64))
+    np.testing.assert_array_equal(np.asarray(d["vals"]), vals)
+    # the fused decode+consume program lowers and runs
+    table = jax.random.normal(jax.random.PRNGKey(0), (1 << w, 16))
+
+    @jax.jit
+    def fused(b):
+        d2 = decode(b)
+        return fm_pairwise(d2["ids"], d2["vals"], d2["segments"], table,
+                           rows)
+
+    out = fused(buf)
+    assert out.shape == (rows,)
+    assert bool(np.isfinite(np.asarray(out)).all())
