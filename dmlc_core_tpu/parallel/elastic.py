@@ -66,10 +66,16 @@ class ElasticJaxMesh:
     num_processes/process_id: mesh shape; default from the rabit context.
     """
 
-    def __init__(self, ctx: RabitContext, base_port: int,
+    def __init__(self, ctx: RabitContext, base_port: int = 0,
                  host: str = "", num_processes: int = 0,
                  process_id: Optional[int] = None) -> None:
         self.ctx = ctx
+        if not base_port:
+            # the tpu launcher exports one base for the whole cohort so
+            # every process derives identical generation addresses
+            base_port = get_env("DMLC_ELASTIC_BASE_PORT", 0)
+            check(base_port > 0, "ElasticJaxMesh needs base_port (or the "
+                                 "launcher's DMLC_ELASTIC_BASE_PORT env)")
         self.base_port = int(base_port)
         self.host = host or os.environ.get("DMLC_ELASTIC_HOST", "127.0.0.1")
         self.num_processes = num_processes or ctx.world_size

@@ -93,6 +93,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-attempts", type=int,
                    default=int(os.environ.get("DMLC_MAX_ATTEMPT", "3")),
                    help="per-worker restart attempts before giving up")
+    p.add_argument("--elastic", action="store_true",
+                   default=os.environ.get("DMLC_ELASTIC") == "1",
+                   help="tpu cluster: respawn crashed workers with a "
+                        "bumped DMLC_NUM_ATTEMPT (pair worker code with "
+                        "ElasticJaxMesh — plain jax.distributed cannot "
+                        "admit a reborn process, so without elastic "
+                        "worker code a respawn would hang, which is why "
+                        "this is opt-in)")
     p.add_argument("--env", action="append", default=[],
                    metavar="K=V", help="extra env vars forwarded to workers")
     p.add_argument("--log-level", default="INFO")

@@ -64,9 +64,44 @@ def initialize_jax_from_env() -> None:
     )
 
 
+def _free_port_run(length: int, tries: int = 50) -> int:
+    """A base port with ``length`` CONSECUTIVE free ports above it:
+    elastic generation g binds base+g, so probing only the base would
+    leave post-crash generations to collide with whatever else is bound
+    in the ephemeral range (the rejoin would wedge at initialize)."""
+    for _ in range(tries):
+        socks = []
+        try:
+            s0 = socket.socket()
+            s0.bind(("", 0))
+            base = s0.getsockname()[1]
+            socks.append(s0)
+            for i in range(1, length + 1):
+                s = socket.socket()
+                s.bind(("", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise OSError(f"no run of {length + 1} consecutive free ports found")
+
+
 def submit(args, tracker_envs: Dict[str, str]) -> int:
     n = args.num_workers
     coord = jax_coordinator_env(n, host_ip=args.host_ip or "127.0.0.1")
+    elastic = bool(getattr(args, "elastic", False))
+    # elastic retry is OPT-IN: plain jax.distributed worker code cannot
+    # admit a reborn process (the coordination service has no elasticity),
+    # so respawning a crashed rank in a non-elastic job would trade a
+    # fast failure for attempts x init-timeout of hang.  With --elastic,
+    # worker code is expected to drive ElasticJaxMesh, whose generation g
+    # binds DMLC_ELASTIC_BASE_PORT + g — reserve a consecutive port run so
+    # post-crash generations don't collide with other services.
+    max_attempts = max(1, getattr(args, "max_attempts", 1)) if elastic else 1
+    elastic_base = str(_free_port_run(8)) if elastic else ""
     results = [0] * n
     threads = []
     for i in range(n):
@@ -81,9 +116,28 @@ def submit(args, tracker_envs: Dict[str, str]) -> int:
             "DMLC_NUM_WORKER": str(n),
             "DMLC_JOB_CLUSTER": "tpu",
         })
+        if elastic:
+            env["DMLC_ELASTIC_BASE_PORT"] = elastic_base
 
         def run(env=env, slot=i):
-            results[slot] = subprocess.call(args.command, env=env)
+            # per-slot retry with a bumped attempt counter — the launcher
+            # half of elastic rejoin: the reborn process registers rabit
+            # `recover` and (when using ElasticJaxMesh) drags the cohort
+            # to a fresh jax.distributed generation at its sync point.
+            # Mirrors the local launcher's retry contract.
+            attempt = 0
+            while True:
+                env_try = dict(env, DMLC_NUM_ATTEMPT=str(attempt))
+                rc = subprocess.call(args.command, env=env_try)
+                if rc == 0:
+                    results[slot] = 0
+                    return
+                attempt += 1
+                log_info("tpu worker %d exited rc=%d (attempt %d/%d)",
+                         slot, rc, attempt, max_attempts)
+                if attempt >= max_attempts:
+                    results[slot] = rc
+                    return
 
         t = threading.Thread(target=run, daemon=True)
         t.start()

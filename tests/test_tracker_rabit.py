@@ -194,14 +194,13 @@ import numpy as np
 from dmlc_core_tpu.parallel import ElasticJaxMesh, RabitContext
 
 attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
-base_port = int(os.environ["ELASTIC_BASE_PORT"])
 ctx = RabitContext.from_env()
 if attempt > 0:
     # reference LoadCheckPoint contract: restoring fast-forwards the rabit
     # seq so the reborn worker's control-plane frames align with survivors
     state = ctx.load_checkpoint()
     assert state == {"phase": 1}, state
-mesh = ElasticJaxMesh(ctx, base_port)
+mesh = ElasticJaxMesh(ctx)          # base port from DMLC_ELASTIC_BASE_PORT
 mesh.initialize()
 from jax.experimental import multihost_utils
 if attempt == 0:
@@ -267,7 +266,8 @@ def test_elastic_jax_mesh_rejoin_after_kill(tmp_path):
     # processes against whatever else runs (harvest probes, CI); the
     # budgets only bound the failure case — a healthy run takes ~2 min
     base_env = {**os.environ, **tracker.worker_envs(),
-                "PYTHONPATH": "/root/repo", "ELASTIC_BASE_PORT": str(p),
+                "PYTHONPATH": "/root/repo",
+                "DMLC_ELASTIC_BASE_PORT": str(p),
                 "DMLC_CHECKPOINT_DIR": str(tmp_path),
                 "DMLC_CONNECT_TIMEOUT": "120",
                 "DMLC_RECOVER_TIMEOUT": "300"}
@@ -294,3 +294,54 @@ def test_elastic_jax_mesh_rejoin_after_kill(tmp_path):
             if pr.poll() is None:
                 pr.kill()
         tracker.stop()
+
+
+def test_elastic_rejoin_through_tpu_launcher(tmp_path):
+    """The launcher half of elastic rejoin: `--cluster tpu --max-attempts 2`
+    respawns the crashed rank with DMLC_NUM_ATTEMPT=1 itself (no manual
+    respawn), the cohort resyncs to generation 1, and the job exits 0."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(ELASTIC_WORKER)
+    env = {**os.environ, "PYTHONPATH": "/root/repo",
+           "DMLC_CHECKPOINT_DIR": str(tmp_path),
+           "DMLC_CONNECT_TIMEOUT": "120", "DMLC_RECOVER_TIMEOUT": "300"}
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
+         "--cluster", "tpu", "-n", "3", "--max-attempts", "2",
+         "--elastic", "--host-ip", "127.0.0.1",
+         "--env", "PYTHONPATH=/root/repo",
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd="/root/repo")
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-2500:])
+    for i in range(3):
+        assert f"ELASTIC-OK {i} 1" in out.stdout, out.stdout[-2000:]
+
+
+def test_tpu_launcher_without_elastic_fails_fast(tmp_path):
+    """Without --elastic a crashed tpu worker is NOT respawned: plain
+    jax.distributed cannot admit a reborn process, so retry would hang —
+    the launcher must surface the failure immediately instead."""
+    import subprocess
+    import sys
+    import time as _t
+
+    script = tmp_path / "crash.py"
+    script.write_text(
+        "import os, sys\n"
+        "assert os.environ.get('DMLC_NUM_ATTEMPT', '0') == '0', "
+        "'non-elastic job must never see a retry attempt'\n"
+        "sys.exit(3 if os.environ['DMLC_TASK_ID'] == '1' else 0)\n")
+    t0 = _t.monotonic()
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
+         "--cluster", "tpu", "-n", "2", "--max-attempts", "3",
+         "--host-ip", "127.0.0.1", "--env", "PYTHONPATH=/root/repo",
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "PYTHONPATH": "/root/repo"}, cwd="/root/repo")
+    assert out.returncode == 3, (out.stdout[-800:], out.stderr[-1500:])
+    assert _t.monotonic() - t0 < 120
