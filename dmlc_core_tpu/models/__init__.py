@@ -9,7 +9,8 @@ from .ftrl import ftrl, FTRLState  # noqa: F401
 from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F401
                     param_shardings, shard_params, fit_stream,
                     streaming_auc, auc_from_histograms,
-                    evaluate_stream, make_train_step_fused, FusedTrainer)
+                    evaluate_stream, make_train_step_fused, FusedTrainer,
+                    make_train_step_kbatch, stack_batches)
 
 __all__ = [
     "SparseLogReg", "FactorizationMachine", "FieldAwareFM", "DeepFM",
@@ -17,4 +18,5 @@ __all__ = [
     "make_train_step", "make_eval_step", "batch_sharding", "param_shardings",
     "shard_params", "fit_stream", "streaming_auc", "auc_from_histograms",
     "evaluate_stream", "make_train_step_fused", "FusedTrainer",
+    "make_train_step_kbatch", "stack_batches",
 ]

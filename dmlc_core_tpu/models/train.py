@@ -35,7 +35,8 @@ from ..utils.timer import Timer
 __all__ = ["make_train_step", "make_eval_step", "batch_sharding",
            "param_shardings", "shard_params", "fit_stream", "TrainState",
            "streaming_auc", "auc_from_histograms", "evaluate_stream",
-           "make_train_step_fused", "FusedTrainer"]
+           "make_train_step_fused", "FusedTrainer",
+           "make_train_step_kbatch", "stack_batches"]
 
 TrainState = Tuple[Dict[str, jax.Array], Any]
 
@@ -97,16 +98,23 @@ def shard_params(params: Dict[str, jax.Array],
     return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
 
 
-def make_train_step(model, optimizer: optax.GradientTransformation,
-                    mesh: Optional[Mesh] = None, donate: bool = True):
-    """Build the jitted SGD step; with a mesh, inputs/outputs carry
-    NamedShardings and XLA inserts the dp gradient all-reduce."""
-
+def _sgd_step(model, optimizer):
+    """The ONE SGD update recipe every step builder closes over
+    (per-step, wire-fused scan, and kbatch scan must never drift)."""
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(model.loss)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
+    return step
+
+
+def make_train_step(model, optimizer: optax.GradientTransformation,
+                    mesh: Optional[Mesh] = None, donate: bool = True):
+    """Build the jitted SGD step; with a mesh, inputs/outputs carry
+    NamedShardings and XLA inserts the dp gradient all-reduce."""
+
+    step = _sgd_step(model, optimizer)
 
     if mesh is None:
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
@@ -145,13 +153,12 @@ def make_train_step_fused(model, optimizer: optax.GradientTransformation,
     """
     from ..pipeline.device_loader import make_decoder
     decode = make_decoder(rows, meta)
+    step = _sgd_step(model, optimizer)
 
     def body(carry, x):
         p, o = carry
         batch = decode(*x) if with_segments else decode(x)
-        loss, grads = jax.value_and_grad(model.loss)(p, batch)
-        updates, o = optimizer.update(grads, o, p)
-        p = optax.apply_updates(p, updates)
+        p, o, loss = step(p, o, batch)
         return (p, o), loss
 
     if with_segments:
@@ -275,6 +282,59 @@ class FusedTrainer:
         for item in self.loader:
             self.feed(item)
         return self.finish()
+
+
+def make_train_step_kbatch(model, optimizer: optax.GradientTransformation,
+                           mesh: Optional[Mesh] = None, donate: bool = True):
+    """k steps per dispatch over STACKED DEVICE BATCHES (leading axis k).
+
+    The mesh-composable sibling of :func:`make_train_step_fused`: instead
+    of scanning wire buffers (single-chip decode), it scans ordinary
+    batch dicts stacked leaf-wise — ``batches[leaf].shape == (k, ...)`` —
+    so the dp sharding applies to each leaf's SECOND axis
+    (``P(None, 'dp')``) and XLA inserts the per-step gradient all-reduce
+    inside the scan.  One dispatch runs k data-parallel SGD steps: the
+    per-dispatch round trip amortizes ×k on every chip of the mesh.
+
+    Returns ``kstep(params, opt_state, batches) -> (params, opt_state,
+    losses[k])``.  Stack host batches with :func:`stack_batches`.
+    """
+    step = _sgd_step(model, optimizer)
+
+    def kstep(params, opt_state, batches):
+        def body(carry, batch):
+            p, o, loss = step(*carry, batch)
+            return (p, o), loss
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), batches)
+        return params, opt_state, losses
+
+    if mesh is None:
+        return jax.jit(kstep, donate_argnums=(0, 1) if donate else ())
+    bs = NamedSharding(mesh, P(None, "dp"))    # (k, batch/nnz, ...)
+    return jax.jit(kstep, in_shardings=(None, None, bs),
+                   donate_argnums=(0, 1) if donate else ())
+
+
+def stack_batches(batches, sharding: Optional[NamedSharding] = None):
+    """Stack a list of same-shaped batch dicts leaf-wise along a new
+    leading k axis, for :func:`make_train_step_kbatch`.
+
+    Host (numpy) leaves stack on the HOST and ship as one ``device_put``
+    (optionally straight into ``sharding`` — ``jnp.stack`` would first
+    replicate the full stack on device 0 only for the meshed kstep to
+    reshard it); device leaves stack with ``jnp.stack``."""
+    keys = batches[0].keys()
+    out = {}
+    for k in keys:
+        leaves = [b[k] for b in batches]
+        if isinstance(leaves[0], np.ndarray):
+            stacked = np.stack(leaves)
+            out[k] = (jax.device_put(stacked, sharding)
+                      if sharding is not None else jax.device_put(stacked))
+        else:
+            out[k] = jnp.stack(leaves)
+    return out
 
 
 def make_eval_step(model, mesh: Optional[Mesh] = None):
