@@ -151,6 +151,15 @@ class ElasticJaxMesh:
             self._barrier("followers-down")
             if self.generation >= 0:
                 self._teardown()
+        if self.generation < 0:
+            # a process that COMPUTED before joining (a reborn rank redoes
+            # its epoch from checkpoint first — see initialize()'s rebirth
+            # caveat) has an initialized backend, and
+            # jax.distributed.initialize refuses to run after any jax
+            # call; clear it (live device arrays die — callers restore
+            # from their host-side checkpoint, the documented contract)
+            import jax.extend as jex
+            jex.backend.clear_backends()
         log_info("elastic: joining mesh generation %d at %s "
                  "(process %d/%d)", gen, self._coordinator(gen),
                  self.process_id, self.num_processes)
@@ -207,7 +216,19 @@ class ElasticJaxMesh:
 
     def initialize(self) -> None:
         """First join: generation 0, or — when reborn — whatever the
-        surviving cohort agrees at the sync point."""
+        surviving cohort agrees at the sync point.
+
+        REBIRTH CAVEAT: on rebirth this resyncs immediately, which is
+        only frame-aligned when the survivors' next control-plane
+        collective is ALSO resync (they crashed past their last sync
+        point).  If survivors run other collectives first (e.g. an
+        epoch-loss allreduce before their resync, as
+        ``examples/elastic_train.py`` does), a reborn process must SKIP
+        initialize(), redo its work from the checkpoint, run the same
+        collectives the survivors are blocked in, and let the shared
+        sync point's :meth:`resync` perform the join — mixing resync's
+        allreduce with a different collective at the same frame corrupts
+        both."""
         if self._dirty:
             # don't guess the cohort's current generation; ask it
             self.resync()

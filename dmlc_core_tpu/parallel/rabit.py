@@ -69,6 +69,24 @@ def _recv_blob(sock: socket.socket, seq: int) -> bytes:
     return payload
 
 
+def _enable_keepalive(sock: socket.socket) -> None:
+    """Bound dead-HOST detection on blocking peer links: a silent network
+    partition (no RST/FIN — NIC death, cable pull) would otherwise hang a
+    blocking recv forever, because the tracker-reset interrupter only
+    fires when a launcher respawns a worker that exited.  Kernel
+    keepalives (~60s idle + 6×10s probes where tunable) surface such a
+    partition as an OSError, which re-enters the normal recovery path."""
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    for opt, val in (("TCP_KEEPIDLE", 60), ("TCP_KEEPINTVL", 10),
+                     ("TCP_KEEPCNT", 6)):
+        if hasattr(socket, opt):
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP,
+                                getattr(socket, opt), val)
+            except OSError:
+                pass
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     out = bytearray()
     while len(out) < n:
@@ -168,6 +186,7 @@ class RabitContext:
                     self._handle_ctrl(conn)
                     continue
                 (gen,) = struct.unpack("<q", _recv_exact(conn, 8))
+                _enable_keepalive(conn)
                 with self._peer_lock:
                     old = self._peer_socks.get(peer_rank)
                     if old is not None:
@@ -266,6 +285,17 @@ class RabitContext:
         while time.monotonic() < deadline:
             try:
                 sock = socket.create_connection((host, port), timeout=5.0)
+                # the 5s budget is for CONNECTING only — left on the
+                # socket it becomes a 5s recv timeout that misdiagnoses a
+                # slow peer as dead (an elastic-reborn rank redoes a whole
+                # epoch before its first collective while survivors block
+                # in theirs).  Peer DEATH is detected by the tracker
+                # reset's shutdown(SHUT_RDWR), which interrupts a blocked
+                # recv (see _handle_ctrl) — accepted sockets are already
+                # blocking, so this also removes an asymmetry where only
+                # dial-direction links could time out
+                sock.settimeout(None)
+                _enable_keepalive(sock)
                 sock.sendall(struct.pack("<qq", self.rank, gen))
                 return sock
             except OSError as e:

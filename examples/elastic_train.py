@@ -1,0 +1,133 @@
+"""Elastic data-parallel training that SURVIVES a worker crash.
+
+Usage (the launcher respawns crashed ranks; ``--elastic`` is required)::
+
+    python -m dmlc_core_tpu.parallel.launcher.submit \
+        --cluster tpu -n 3 --elastic --max-attempts 2 -- \
+        python examples/elastic_train.py <uri> [--epochs E] \
+            [--crash-rank R --crash-epoch E]
+
+Each rank trains a FactorizationMachine on ITS partition of the input
+(the reference's ``ResetPartition(rank, n)`` contract), with two planes
+of fault tolerance working together:
+
+* **control plane** — rabit collectives through the tracker: epoch-loss
+  reduction, checkpoint (seq fast-forward on rebirth);
+* **data plane** — :class:`ElasticJaxMesh`: every epoch boundary is a
+  sync point (``resync``); when a rank dies mid-epoch, the launcher
+  respawns it with a bumped ``DMLC_NUM_ATTEMPT``, the reborn rank
+  restores its rabit checkpoint, and the WHOLE cohort rebuilds the
+  jax.distributed mesh at the next generation — training continues with
+  no manual intervention.
+
+``--crash-rank/--crash-epoch`` inject a one-shot crash (first attempt
+only) to demonstrate the rejoin live; tests drive exactly that path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("uri")
+    ap.add_argument("--features", type=int, default=1 << 16)
+    ap.add_argument("--dim", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-rows", type=int, default=128)
+    ap.add_argument("--crash-rank", type=int, default=-1)
+    ap.add_argument("--crash-epoch", type=int, default=-1)
+    args = ap.parse_args()
+
+    import jax
+    import optax
+
+    from dmlc_core_tpu.data import create_parser
+    from dmlc_core_tpu.models import FactorizationMachine, FusedTrainer
+    from dmlc_core_tpu.parallel import ElasticJaxMesh, RabitContext
+    from dmlc_core_tpu.pipeline import DeviceLoader
+
+    attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
+    ctx = RabitContext.from_env()
+    start_epoch = 0
+    saved = None
+    if attempt > 0:
+        saved = ctx.load_checkpoint()     # rabit seq fast-forwards here
+        if saved is not None:
+            start_epoch = saved["epoch"] + 1
+        print(f"rank {ctx.rank} reborn (attempt {attempt}), "
+              f"resuming at epoch {start_epoch}", flush=True)
+    mesh = ElasticJaxMesh(ctx)            # launcher provides the base port
+    if attempt == 0:
+        mesh.initialize()
+        # checkpoint the post-join position IMMEDIATELY: a rank that
+        # crashes during epoch 0 (before its first epoch checkpoint) must
+        # still restore a rabit seq that matches the survivors — who ran
+        # ensure(0)'s two control-plane barriers before epoch 0's first
+        # collective
+        ctx.checkpoint({"epoch": -1, "params": None, "opt_state": None})
+    # A REBORN rank must NOT initialize here: survivors are blocked in the
+    # epoch-loss allreduce, so the reborn's next collective must be that
+    # same allreduce (after re-running its epoch from the checkpoint) —
+    # the mesh join happens at the shared sync point's resync(), where
+    # the frame positions line up.  initialize()-on-rebirth is only
+    # correct when the survivors' next collective is also resync (the
+    # pattern tests/test_tracker_rabit.py's worker uses).
+
+    model = FactorizationMachine(num_features=args.features, dim=args.dim)
+    opt = optax.adam(5e-2)
+    to_dev = jax.tree_util.tree_map
+    params = (to_dev(jax.numpy.asarray, saved["params"]) if saved else None)
+    opt_state = (to_dev(jax.numpy.asarray, saved["opt_state"])
+                 if saved else None)
+
+    for epoch in range(start_epoch, args.epochs):
+        loader = DeviceLoader(
+            create_parser(args.uri, ctx.rank, ctx.world_size, "libsvm"),
+            batch_rows=args.batch_rows, nnz_cap=args.batch_rows * 16,
+            id_mod=args.features, emit="host")
+        trainer = FusedTrainer(model, opt, loader, k=8, params=params,
+                               opt_state=opt_state)
+        try:
+            loss = trainer.run_epoch()
+        finally:
+            loader.close()
+        params, opt_state = trainer.params, trainer.opt_state
+        if (attempt == 0 and ctx.rank == args.crash_rank
+                and epoch == args.crash_epoch):
+            print(f"rank {ctx.rank} CRASHING at epoch {epoch}", flush=True)
+            os._exit(7)
+        # Epoch sync point, in collective order: (1) loss reduction,
+        # (2) mesh resync — a death anywhere surfaces here and the data
+        # plane rebuilds — then (3) the rabit checkpoint LAST, so a
+        # reborn rank's restored seq equals the survivors' seq at the
+        # next epoch's entry (a checkpoint taken before resync would
+        # desynchronize the control-plane frame guard on rebirth).
+        # Host snapshots are taken BEFORE resync: a rebuild tears the
+        # backend down and live device arrays die with it.
+        host_params = to_dev(np.asarray, params)
+        host_opt = to_dev(np.asarray, opt_state)
+        mean_loss = float(ctx.allreduce(
+            np.array([loss], np.float64))[0]) / ctx.world_size
+        rebuilt = mesh.resync()
+        if rebuilt:
+            params = to_dev(jax.numpy.asarray, host_params)
+            opt_state = to_dev(jax.numpy.asarray, host_opt)
+        ctx.checkpoint({"epoch": epoch, "params": host_params,
+                        "opt_state": host_opt})
+        print(f"rank {ctx.rank} epoch {epoch} mean_loss {mean_loss:.5f}"
+              + (f" [mesh rebuilt -> gen {mesh.generation}]"
+                 if rebuilt else ""), flush=True)
+
+    print(f"rank {ctx.rank} DONE gen={mesh.generation}", flush=True)
+    mesh.close()
+    ctx.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -266,3 +266,42 @@ def test_mesh_train_fm_example(tmp_path):
              "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "done:" in out.stdout
+
+
+def test_example_elastic_train_survives_crash(tmp_path):
+    """examples/elastic_train.py: rank 2 crashes mid-job, the --elastic
+    launcher respawns it, the cohort rebuilds the jax mesh at generation
+    1, and training completes on every rank."""
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    data = tmp_path / "el.libsvm"
+    with open(data, "w") as f:
+        for i in range(900):
+            idx = np.sort(rng.choice(200, size=6, replace=False))
+            f.write(f"{i % 2} " + " ".join(
+                f"{j}:{rng.random():.4f}" for j in idx) + "\n")
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "DMLC_CHECKPOINT_DIR": str(tmp_path), "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "DMLC_CONNECT_TIMEOUT": "120", "DMLC_RECOVER_TIMEOUT": "300"}
+    out = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.parallel.launcher.submit",
+         "--cluster", "tpu", "-n", "3", "--elastic", "--max-attempts", "2",
+         "--host-ip", "127.0.0.1", "--env", f"PYTHONPATH={REPO}",
+         "--env", "JAX_PLATFORMS=cpu",
+         "--env", "XLA_FLAGS=--xla_force_host_platform_device_count=1",
+         "--", sys.executable,
+         os.path.join(REPO, "examples", "elastic_train.py"),
+         f"file://{data}", "--epochs", "3", "--features", "256",
+         "--crash-rank", "2", "--crash-epoch", "1"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2500:])
+    assert "CRASHING at epoch 1" in out.stdout
+    assert "reborn (attempt 1), resuming at epoch 1" in out.stdout
+    assert "mesh rebuilt -> gen 1" in out.stdout
+    for i in range(3):
+        assert f"rank {i} DONE gen=1" in out.stdout, out.stdout[-2000:]
