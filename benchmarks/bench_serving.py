@@ -1,0 +1,111 @@
+"""Serving benchmark: QPS + latency quantiles through the full stack.
+
+Drives client → TCP → server → micro-batcher → bucketed AOT engine on
+the CPU backend (the same path a TPU replica runs, minus the device) and
+emits one JSON artifact so future PRs can track the latency/throughput
+trajectory (committed as BENCH_serving_r{N}.json, same discipline as
+BENCH_capacity_r{N}.json).
+
+Sweeps the load axes that matter for a serving replica:
+
+  single        1 connection, depth 1 — pure round-trip latency floor
+  pipelined     1 connection, deep pipeline — micro-batcher amortization
+  concurrent    N connections — contended throughput (the capacity point)
+  overload      queue bound set tiny — verifies explicit shed, measures
+                goodput under 4x admission pressure
+
+Usage: python benchmarks/bench_serving.py [out.json]
+Env:   DMLC_SERVE_REQUESTS (default 2000), DMLC_SERVE_FEATURES (2^16),
+       DMLC_SERVE_MODEL (fm), DMLC_SERVE_DIM (16)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from dmlc_core_tpu.models.cli import MODEL_REGISTRY, TrainParams
+    from dmlc_core_tpu.serving import (InferenceEngine, PredictionServer,
+                                       run_load)
+    from dmlc_core_tpu.utils.metrics import metrics
+
+    requests = int(os.environ.get("DMLC_SERVE_REQUESTS", "2000"))
+    features = int(os.environ.get("DMLC_SERVE_FEATURES", str(1 << 16)))
+    model_name = os.environ.get("DMLC_SERVE_MODEL", "fm")
+    dim = int(os.environ.get("DMLC_SERVE_DIM", "16"))
+
+    p = TrainParams()
+    p.init({"data": "bench", "model": model_name,
+            "features": str(features), "dim": str(dim)})
+    model = MODEL_REGISTRY[p.model](p)
+    params = model.init(jax.random.PRNGKey(0))
+
+    report = {
+        "bench": "serving", "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(), "model": model_name,
+        "features": features, "dim": dim, "requests": requests,
+        "scenarios": {},
+    }
+
+    def scenario(name, *, max_queue=256, **load_kw):
+        metrics.reset()
+        engine = InferenceEngine(model, params, postprocess="sigmoid")
+        srv = PredictionServer(engine, max_queue=max_queue,
+                               warmup=True).start()
+        t0 = time.monotonic()
+        try:
+            rep = run_load(srv.host, srv.port, requests=requests,
+                           features=features, **load_kw)
+        finally:
+            srv.stop()
+        rep["compile_count"] = engine.compile_count
+        rep["warmup_plus_load_s"] = time.monotonic() - t0
+        snap = metrics.snapshot()
+        rep["server_latency_ms"] = {
+            k: snap["serving.latency_s"][k] * 1e3
+            for k in ("p50", "p95", "p99", "mean")}
+        rep["batch_occupancy"] = snap["serving.batcher.occupancy"]["value"]
+        report["scenarios"][name] = rep
+        log(f"{name}: qps={rep['qps']:.0f} "
+            f"p50={rep['latency_ms']['p50']:.2f}ms "
+            f"p99={rep['latency_ms']['p99']:.2f}ms ok={rep['ok']} "
+            f"shed={rep['overload']}")
+
+    scenario("single", concurrency=1, pipeline_depth=1)
+    scenario("pipelined", concurrency=1, pipeline_depth=32)
+    scenario("concurrent", concurrency=4, pipeline_depth=16)
+    scenario("overload", concurrency=8, pipeline_depth=32, max_queue=16)
+
+    ov = report["scenarios"]["overload"]
+    report["overload_shed_fraction"] = (
+        ov["overload"] / max(1, ov["ok"] + ov["overload"]))
+    # headline numbers: the concurrent scenario is the capacity point
+    cc = report["scenarios"]["concurrent"]
+    report["qps"] = cc["qps"]
+    report["latency_ms"] = cc["latency_ms"]
+
+    blob = json.dumps(report, indent=2)
+    print(blob)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(blob + "\n")
+        log(f"wrote {sys.argv[1]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

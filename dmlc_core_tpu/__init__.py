@@ -21,6 +21,9 @@ A brand-new JAX/XLA/Pallas-first design (not a port) providing:
                  (reference ``tracker/``).
 * ``models``   — streaming sparse models (logistic regression, factorization
                  machines) that train end-to-end from the ingest pipeline.
+* ``serving``  — online inference: shape-bucketed jit engine, dynamic
+                 micro-batching with admission control, checkpoint
+                 hot-reload, pipelined TCP serving + load generator.
 
 Reference: Luo-Liang/dmlc-core (C++11), surveyed in /root/repo/SURVEY.md.
 """

@@ -12,9 +12,22 @@ from .train import (make_train_step, make_eval_step, batch_sharding,  # noqa: F4
                     evaluate_stream, make_train_step_fused, FusedTrainer,
                     make_train_step_kbatch, stack_batches)
 
+def __getattr__(name):
+    # the name→model registry the CLI, serving server, and benchmarks all
+    # build zoo models through.  Lazy (PEP 562): an eager `from .cli
+    # import` here would make `python -m dmlc_core_tpu.models.cli` execute
+    # cli.py twice (package import + runpy __main__) and double-register
+    # every model
+    if name in ("MODEL_REGISTRY", "TrainParams"):
+        from . import cli
+        return getattr(cli, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "SparseLogReg", "FactorizationMachine", "FieldAwareFM", "DeepFM",
     "DCNv2", "weighted_bce", "weighted_mse",
+    "MODEL_REGISTRY", "TrainParams",
     "make_train_step", "make_eval_step", "batch_sharding", "param_shardings",
     "shard_params", "fit_stream", "streaming_auc", "auc_from_histograms",
     "evaluate_stream", "make_train_step_fused", "FusedTrainer",

@@ -99,12 +99,19 @@ class ElasticJaxMesh:
         except Exception as e:  # noqa: BLE001 — half-dead service
             log_warning("elastic: shutdown of generation %d raised (%s) — "
                         "proceeding", self.generation, e)
-            from jax._src import distributed as _dist
             # clear the half-shut state so exit hooks / the re-init
-            # don't trip over a client the failed shutdown left behind
-            _dist.global_state.preemption_sync_manager = None
-            _dist.global_state.client = None
-            _dist.global_state.service = None
+            # don't trip over a client the failed shutdown left behind.
+            # jax._src is private and moves across JAX releases: degrade
+            # to a warning rather than masking the real failure above
+            try:
+                from jax._src import distributed as _dist
+                state = getattr(_dist, "global_state", None)
+                for attr in ("preemption_sync_manager", "client", "service"):
+                    if state is not None and hasattr(state, attr):
+                        setattr(state, attr, None)
+            except Exception as e2:  # noqa: BLE001 — private-API drift
+                log_warning("elastic: could not clear jax distributed "
+                            "state (%s) — private API moved?", e2)
         if not final:
             # the old backend holds client handles into the dead
             # coordination service; initialize() refuses to run while any
@@ -140,8 +147,15 @@ class ElasticJaxMesh:
         # without this, the coordination client's error-polling thread
         # LOG(FATAL)s the WHOLE process the moment any peer dies ("client.h
         # Terminating process because the JAX distributed service detected
-        # fatal errors") — survivors must outlive a peer death to rejoin
-        jax.config.update("jax_enable_recoverability", True)
+        # fatal errors") — survivors must outlive a peer death to rejoin.
+        # the flag is version-dependent: degrade to a warning on JAX
+        # builds that dropped/renamed it instead of refusing to start
+        try:
+            jax.config.update("jax_enable_recoverability", True)
+        except Exception as e:  # noqa: BLE001 — flag absent in this JAX
+            log_warning("elastic: jax_enable_recoverability unavailable "
+                        "(%s) — peer-death survival depends on this JAX "
+                        "build's defaults", e)
         self._barrier("pre-rebuild")
         if self.process_id != 0:
             if self.generation >= 0:

@@ -139,6 +139,7 @@ class TaskSupervisor:
         self.blacklist: set = set()
         self.submitted_payloads: List[dict] = []  # telemetry/testability
         self._pending_submit: List[int] = []      # tasks awaiting (re)submit
+        self._reserved_app: Dict[int, str] = {}   # task -> unconfirmed app id
 
     # -- submission -------------------------------------------------------
     def _payload(self, t: TaskSpec, app_id: str) -> dict:
@@ -173,9 +174,30 @@ class TaskSupervisor:
         """Submit (or resubmit) one task's app.  A transient RM error must
         not crash the supervisor mid-job (the RM REST endpoint blips
         during failovers; ``rm_app_report`` degrades the same way): the
-        task parks in ``_pending_submit`` and retries next poll tick."""
+        task parks in ``_pending_submit`` and retries next poll tick.
+
+        The app id is reserved BEFORE the submit and remembered across
+        retries: a submit whose RESPONSE is lost (RM accepted, our read
+        timed out) must not resubmit under a fresh id — that launches the
+        same task twice, with the first copy running unsupervised.  On
+        retry we first ask the RM whether the reserved id already exists
+        and adopt it if so."""
         try:
-            app_id = self.client.new_application()
+            app_id = self._reserved_app.get(t.task_id)
+            if app_id is None:
+                app_id = self.client.new_application()
+                self._reserved_app[t.task_id] = app_id
+            else:
+                try:
+                    landed = bool(self.client.report(app_id).get("state"))
+                except Exception:  # noqa: BLE001 — RM has no such app
+                    landed = False
+                if landed:
+                    log_info("yarn: task %d submit of %s had landed — "
+                             "adopting, not resubmitting", t.task_id, app_id)
+                    self._reserved_app.pop(t.task_id, None)
+                    self.app_of[t.task_id] = app_id
+                    return
             payload = self._payload(t, app_id)
             self.client.submit(payload)
         except Exception as e:  # noqa: BLE001 — RM blip, retry next tick
@@ -184,6 +206,7 @@ class TaskSupervisor:
             if t.task_id not in self._pending_submit:
                 self._pending_submit.append(t.task_id)
             return
+        self._reserved_app.pop(t.task_id, None)
         self.submitted_payloads.append(payload)
         self.app_of[t.task_id] = app_id
         log_info("yarn: task %d attempt %d → %s", t.task_id,
@@ -248,6 +271,16 @@ class TaskSupervisor:
                         and report.get("finalStatus") == "SUCCEEDED"):
                     self.done[task_id] = app_id
                     log_info("yarn: task %d finished (%s)", task_id, app_id)
+                elif state == "KILLED":
+                    # only _abort() kills our apps, and it never returns to
+                    # this loop — so KILLED means an operator/preemption
+                    # outside the supervisor.  That is job-level intent,
+                    # not a container fault: abort without counting a node
+                    # failure (a kill must not blacklist a healthy node)
+                    log_warning("yarn: task %d app %s killed externally — "
+                                "aborting job", task_id, app_id)
+                    self._abort()
+                    return 1
                 elif not self._on_failure(task_id, report):
                     self._abort()
                     return 1

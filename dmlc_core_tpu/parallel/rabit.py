@@ -111,6 +111,14 @@ class RabitContext:
         self.jobid = jobid or f"job-{os.getpid()}-{socket.gethostname()}"
         self.connect_timeout = connect_timeout
         self.recover_timeout = recover_timeout
+        # long backstop recv timeout on PEER links: normally a dead peer is
+        # detected via the tracker reset's shutdown(SHUT_RDWR), but if the
+        # tracker itself is gone a fully-unbounded recv hangs the collective
+        # forever.  Sized well past recover_timeout so a slow-but-alive peer
+        # (an elastic-reborn rank redoing its epoch) is never misdiagnosed;
+        # DMLC_PEER_RECV_TIMEOUT tunes it, <= 0 restores unbounded recv
+        t = float(get_env("DMLC_PEER_RECV_TIMEOUT", 2.0 * recover_timeout))
+        self.peer_recv_timeout: Optional[float] = None if t <= 0 else t
         # listener for peer links
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -187,6 +195,8 @@ class RabitContext:
                     continue
                 (gen,) = struct.unpack("<q", _recv_exact(conn, 8))
                 _enable_keepalive(conn)
+                conn.settimeout(self.peer_recv_timeout)  # same backstop
+                # as dial-direction links (see _dial)
                 with self._peer_lock:
                     old = self._peer_socks.get(peer_rank)
                     if old is not None:
@@ -291,10 +301,13 @@ class RabitContext:
                 # epoch before its first collective while survivors block
                 # in theirs).  Peer DEATH is detected by the tracker
                 # reset's shutdown(SHUT_RDWR), which interrupts a blocked
-                # recv (see _handle_ctrl) — accepted sockets are already
-                # blocking, so this also removes an asymmetry where only
-                # dial-direction links could time out
-                sock.settimeout(None)
+                # recv (see _handle_ctrl); peer_recv_timeout is the long
+                # env-tunable backstop for when the tracker is gone too —
+                # a timeout flows the same OSError → "peer link lost" →
+                # recovery path as a closed link.  Accepted sockets get
+                # the identical setting in _accept_loop, so both link
+                # directions behave the same
+                sock.settimeout(self.peer_recv_timeout)
                 _enable_keepalive(sock)
                 sock.sendall(struct.pack("<qq", self.rank, gen))
                 return sock

@@ -1,0 +1,35 @@
+"""Online inference serving: checkpoint → low-latency predictions.
+
+The path from `models/train.py` + `utils/checkpoint.py` to production
+traffic (ROADMAP north star: "serves heavy traffic from millions of
+users"):
+
+* :mod:`engine`  — shape-bucketed AOT jit forward over the model zoo;
+  ragged CSR requests pad into a pre-compiled bucket ladder (no request
+  ever retraces) with atomic checkpoint hot-reload.
+* :mod:`batcher` — dynamic micro-batching (size OR delay trigger),
+  bounded admission with explicit overload rejection, per-request
+  deadlines, graceful drain.
+* :mod:`server` / :mod:`client` — pipelined length-prefixed TCP frames
+  (the `pipeline/ingest_service.py` wire idiom) carrying CSR requests
+  and float predictions, plus a load-generator mode for benchmarking.
+
+Everything reports into ``utils.metrics`` (QPS, queue depth, batch
+occupancy, p50/p95/p99 latency via the ``Histogram`` primitive).  See
+docs/serving.md.
+"""
+
+from .engine import (BucketLadder, InferenceEngine, RequestTooLarge,  # noqa: F401
+                     ShapeBucket)
+from .batcher import (DeadlineExceeded, MicroBatcher, Overloaded,  # noqa: F401
+                      Shutdown)
+from .server import PredictionServer  # noqa: F401
+from .client import (PredictClient, ServerOverloaded, ServerRejected,  # noqa: F401
+                     run_load)
+
+__all__ = [
+    "ShapeBucket", "BucketLadder", "InferenceEngine", "RequestTooLarge",
+    "MicroBatcher", "Overloaded", "DeadlineExceeded", "Shutdown",
+    "PredictionServer", "PredictClient", "ServerOverloaded",
+    "ServerRejected", "run_load",
+]

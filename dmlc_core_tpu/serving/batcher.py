@@ -1,0 +1,275 @@
+"""Dynamic micro-batcher: aggregate concurrent requests into engine calls.
+
+One engine call amortizes dispatch + padding over many requests, but
+waiting for a full batch trades latency for throughput.  The batcher cuts
+a micro-batch on whichever of the classic two triggers fires first:
+
+* **size** — queued rows/values would fill the largest shape bucket, or
+* **delay** — the OLDEST queued request has waited ``max_delay_s``.
+
+Under light load requests leave almost immediately (delay trigger with an
+almost-empty queue); under heavy load batches run full (size trigger) and
+the queue, not the wire, absorbs bursts.  The queue is **bounded**:
+admission control rejects with :class:`Overloaded` at submit time rather
+than queueing unboundedly — an overloaded replica must shed load in
+microseconds, not time out clients in seconds (the explicit-rejection
+half of every production serving stack).  Each request carries a
+deadline; requests that expire while queued are failed with
+:class:`DeadlineExceeded` *without* wasting an engine slot on an answer
+nobody is waiting for.
+
+``close(drain=True)`` stops admissions, lets the worker flush everything
+queued, and joins — the graceful half of shutdown; ``drain=False`` fails
+queued requests immediately (the process-is-dying half).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils.logging import DMLCError, check
+from ..utils.metrics import metrics
+from .engine import InferenceEngine, RequestTooLarge
+
+__all__ = ["MicroBatcher", "Overloaded", "DeadlineExceeded", "Shutdown"]
+
+
+class Overloaded(DMLCError):
+    """Bounded queue full: request rejected at admission."""
+
+
+class DeadlineExceeded(DMLCError):
+    """Request expired before the engine could run it."""
+
+
+class Shutdown(DMLCError):
+    """Batcher is shutting down; request not served."""
+
+
+class _Pending:
+    __slots__ = ("ids", "vals", "row_ptr", "rows", "nnz", "deadline",
+                 "t_enq", "future")
+
+    def __init__(self, ids, vals, row_ptr, deadline, t_enq):
+        self.ids = ids
+        self.vals = vals
+        self.row_ptr = row_ptr
+        self.rows = len(row_ptr) - 1
+        self.nnz = len(ids)
+        self.deadline = deadline
+        self.t_enq = t_enq
+        self.future: Future = Future()
+
+
+class MicroBatcher:
+    """max-batch-size OR max-queue-delay, whichever first.
+
+    ``max_batch_rows``/``max_batch_nnz`` default to the engine ladder's
+    largest bucket — a cut batch always fits a single engine call.
+    ``max_queue`` bounds ADMITTED requests (submit beyond it raises
+    :class:`Overloaded`).  ``default_deadline_s`` caps queue residency per
+    request unless the caller passes an explicit deadline.
+    """
+
+    def __init__(self, engine: InferenceEngine, *,
+                 max_delay_s: float = 0.002,
+                 max_batch_rows: int = 0, max_batch_nnz: int = 0,
+                 max_queue: int = 256,
+                 default_deadline_s: float = 1.0) -> None:
+        self.engine = engine
+        self.max_delay_s = float(max_delay_s)
+        self.max_batch_rows = int(max_batch_rows or engine.ladder.max_rows)
+        self.max_batch_nnz = int(max_batch_nnz or engine.ladder.max_nnz)
+        check(self.max_batch_rows <= engine.ladder.max_rows
+              and self.max_batch_nnz <= engine.ladder.max_nnz,
+              "batch budget exceeds the engine's largest bucket")
+        self.max_queue = int(max_queue)
+        self.default_deadline_s = float(default_deadline_s)
+        self._q: List[_Pending] = []
+        self._cv = threading.Condition()
+        self._closing = False          # no new admissions
+        self._drain = True
+        self._bind_metrics()
+        self._worker = threading.Thread(target=self._run,
+                                        name="serving-batcher", daemon=True)
+        self._worker.start()
+
+    def _bind_metrics(self) -> None:
+        m = metrics
+        self._m_gen = m.generation
+        self._m_depth = m.gauge("serving.batcher.queue_depth")
+        self._m_occ = m.gauge("serving.batcher.occupancy")
+        self._m_overload = m.counter("serving.batcher.overloads")
+        self._m_expired = m.counter("serving.batcher.deadline_drops")
+        self._m_batches = m.counter("serving.batcher.batches")
+        self._m_reqs = m.throughput("serving.batcher.requests")
+        self._m_latency = m.histogram("serving.latency_s")
+
+    def _maybe_rebind(self) -> None:
+        if self._m_gen != metrics.generation:
+            self._bind_metrics()
+
+    # -- producer side ---------------------------------------------------
+    def submit(self, ids: np.ndarray, vals: np.ndarray,
+               row_ptr: Optional[np.ndarray] = None,
+               deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one CSR request; returns a Future resolving to the
+        float32 scores (or raising Overloaded/DeadlineExceeded/Shutdown).
+        Oversized and malformed requests fail fast here — they must not
+        poison the shared batch they would have ridden in.
+        """
+        ids = np.asarray(ids, np.int32)
+        vals = np.asarray(vals, np.float32)
+        if row_ptr is None:
+            row_ptr = np.array([0, len(ids)], np.int64)
+        row_ptr = np.asarray(row_ptr, np.int64)
+        self._maybe_rebind()
+        rows, nnz = len(row_ptr) - 1, len(ids)
+        f: Future = Future()
+        if rows < 1 or len(ids) != len(vals) or int(row_ptr[0]) != 0 \
+                or int(row_ptr[-1]) != nnz:
+            f.set_exception(DMLCError("malformed CSR request"))
+            return f
+        if rows > self.max_batch_rows or nnz > self.max_batch_nnz:
+            f.set_exception(RequestTooLarge(
+                f"request ({rows} rows, {nnz} nnz) exceeds the batch "
+                f"budget ({self.max_batch_rows} rows, "
+                f"{self.max_batch_nnz} nnz)"))
+            return f
+        now = time.monotonic()
+        p = _Pending(ids, vals, row_ptr,
+                     now + (self.default_deadline_s if deadline_s is None
+                            else deadline_s), now)
+        with self._cv:
+            if self._closing:
+                p.future.set_exception(Shutdown("batcher is shut down"))
+                return p.future
+            if len(self._q) >= self.max_queue:
+                self._m_overload.add(1)
+                p.future.set_exception(Overloaded(
+                    f"queue full ({self.max_queue} requests) — retry with "
+                    f"backoff"))
+                return p.future
+            self._q.append(p)
+            self._m_depth.set(len(self._q))
+            self._cv.notify()
+        return p.future
+
+    # -- worker side -----------------------------------------------------
+    def _cut_batch(self) -> Optional[List[_Pending]]:
+        """Block until a batch is due (size/delay/shutdown), pop it.
+        Returns None only when closed and (drained or drain=False)."""
+        with self._cv:
+            while True:
+                if self._q:
+                    if self._closing:
+                        break          # flush whatever is queued
+                    rows = nnz = 0
+                    full = False
+                    for p in self._q:
+                        rows += p.rows
+                        nnz += p.nnz
+                        if rows >= self.max_batch_rows \
+                                or nnz >= self.max_batch_nnz:
+                            full = True
+                            break
+                    due = self._q[0].t_enq + self.max_delay_s
+                    now = time.monotonic()
+                    if full or now >= due:
+                        break
+                    self._cv.wait(timeout=due - now)
+                elif self._closing:
+                    return None
+                else:
+                    self._cv.wait(timeout=0.1)
+            batch: List[_Pending] = []
+            rows = nnz = 0
+            while self._q:
+                p = self._q[0]
+                if batch and (rows + p.rows > self.max_batch_rows
+                              or nnz + p.nnz > self.max_batch_nnz):
+                    break
+                batch.append(self._q.pop(0))
+                rows += p.rows
+                nnz += p.nnz
+            self._m_depth.set(len(self._q))
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._cut_batch()
+            if batch is None:
+                return
+            self._maybe_rebind()
+            now = time.monotonic()
+            live: List[_Pending] = []
+            for p in batch:
+                if p.deadline < now:
+                    self._m_expired.add(1)
+                    p.future.set_exception(DeadlineExceeded(
+                        f"request expired after "
+                        f"{now - p.t_enq:.3f}s in queue"))
+                elif not self._drain and self._closing:
+                    p.future.set_exception(Shutdown("batcher shut down"))
+                else:
+                    live.append(p)
+            if not live:
+                continue
+            ids = np.concatenate([p.ids for p in live])
+            vals = np.concatenate([p.vals for p in live])
+            ptrs = [np.int64(0)]
+            off = 0
+            for p in live:
+                ptrs.append(p.row_ptr[1:] + off)
+                off += p.nnz
+            row_ptr = np.concatenate([np.atleast_1d(x) for x in ptrs])
+            try:
+                scores = self.engine.predict(ids, vals, row_ptr)
+            except BaseException as e:  # noqa: BLE001 — fan the failure
+                # out to the waiting clients; the worker must survive to
+                # serve the next batch
+                for p in live:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+                continue
+            self._m_batches.add(1)
+            self._m_occ.set(sum(p.rows for p in live)
+                            / max(1, self.max_batch_rows))
+            done_t = time.monotonic()
+            r0 = 0
+            for p in live:
+                p.future.set_result(scores[r0:r0 + p.rows])
+                r0 += p.rows
+                self._m_latency.observe(done_t - p.t_enq)
+                self._m_reqs.add(1)
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop admissions; ``drain=True`` serves everything already
+        queued before the worker exits, ``drain=False`` fails it."""
+        with self._cv:
+            self._closing = True
+            self._drain = drain
+            if not drain:
+                for p in self._q:
+                    p.future.set_exception(Shutdown("batcher shut down"))
+                self._q.clear()
+                self._m_depth.set(0)
+            self._cv.notify_all()
+        self._worker.join(timeout=timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

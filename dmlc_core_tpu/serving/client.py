@@ -1,0 +1,280 @@
+"""Prediction client + load generator for the serving wire protocol.
+
+:class:`PredictClient` speaks the length-prefixed frame protocol of
+`serving/server.py` over one TCP connection.  A background reader thread
+dispatches responses by ``req_id`` to per-request futures, so the same
+client supports both blocking single-shot :meth:`predict` and pipelined
+:meth:`submit`/``Future`` usage — pipelining is what keeps the server's
+micro-batcher full from a single connection.
+
+Server-side conditions surface as typed exceptions
+(:class:`ServerOverloaded`, :class:`ServerRejected`) so callers can
+implement retry-with-backoff for overload while treating hard rejections
+as bugs.
+
+:func:`run_load` is the benchmarking mode: N concurrent client
+connections stream requests as fast as the server admits them and report
+QPS + latency quantiles — the serving benchmark and capacity tests drive
+the stack exclusively through it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import DMLCError
+from ..utils.metrics import Histogram
+from .server import (REQ_HEADER, RSP_HEADER, STATUS_DEADLINE,
+                     STATUS_NAMES, STATUS_OK, STATUS_OVERLOADED,
+                     _recv_exact)
+
+__all__ = ["PredictClient", "ServerOverloaded", "ServerRejected",
+           "run_load"]
+
+
+class ServerOverloaded(DMLCError):
+    """Server shed this request (admission control or deadline) — retry
+    with backoff."""
+
+
+class ServerRejected(DMLCError):
+    """Server refused this request for a non-retryable reason."""
+
+
+class PredictClient:
+    """One pipelined connection to a :class:`PredictionServer`."""
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout: float = 30.0) -> None:
+        import socket
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._next_id = 0
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="serving-client-reader",
+                                        daemon=True)
+        self._reader.start()
+
+    # -- receive side ----------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                head = _recv_exact(self._sock, RSP_HEADER.size)
+                if head is None:
+                    raise DMLCError("server closed the connection")
+                req_id, status, n = RSP_HEADER.unpack(head)
+                payload = _recv_exact(self._sock, 4 * n if status ==
+                                      STATUS_OK else n)
+                if payload is None:
+                    raise DMLCError("server died mid-response")
+                with self._plock:
+                    fut = self._pending.pop(req_id, None)
+                if fut is None:
+                    continue           # response to a cancelled request
+                if status == STATUS_OK:
+                    fut.set_result(np.frombuffer(payload, np.float32))
+                else:
+                    msg = payload.decode("utf-8", "replace")
+                    name = STATUS_NAMES.get(status, str(status))
+                    exc = (ServerOverloaded if status in
+                           (STATUS_OVERLOADED, STATUS_DEADLINE)
+                           else ServerRejected)
+                    fut.set_exception(exc(f"{name}: {msg}"))
+        except (OSError, DMLCError) as e:
+            with self._plock:
+                pending, self._pending = self._pending, {}
+                closed = self._closed
+            err = DMLCError("connection closed" if closed
+                            else f"serving connection lost: {e}")
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+
+    # -- send side -------------------------------------------------------
+    def submit(self, ids: np.ndarray, vals: np.ndarray,
+               row_ptr: Optional[np.ndarray] = None) -> Future:
+        """Pipeline one request; returns a Future of float32 scores."""
+        ids = np.ascontiguousarray(ids, np.int32)
+        vals = np.ascontiguousarray(vals, np.float32)
+        if row_ptr is None:
+            row_ptr = np.array([0, len(ids)], np.int32)
+        row_ptr = np.ascontiguousarray(row_ptr, np.int32)
+        rows, nnz = len(row_ptr) - 1, len(ids)
+        fut: Future = Future()
+        with self._plock:
+            if self._closed:
+                fut.set_exception(DMLCError("client closed"))
+                return fut
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = fut
+        frame = (REQ_HEADER.pack(req_id, rows, nnz) + row_ptr.tobytes()
+                 + ids.tobytes() + vals.tobytes())
+        try:
+            with self._wlock:
+                self._sock.sendall(frame)
+        except OSError as e:
+            with self._plock:
+                self._pending.pop(req_id, None)
+            fut.set_exception(DMLCError(f"send failed: {e}"))
+        return fut
+
+    def predict(self, ids: np.ndarray, vals: np.ndarray,
+                row_ptr: Optional[np.ndarray] = None,
+                timeout: float = 30.0) -> np.ndarray:
+        """Blocking single request → scores ``[rows]``."""
+        return self.submit(ids, vals, row_ptr).result(timeout=timeout)
+
+    def close(self) -> None:
+        import socket
+        with self._plock:
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._reader.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# load generator
+# ---------------------------------------------------------------------------
+
+def _gen_request(rng: np.random.Generator, rows: int, nnz_per_row: int,
+                 features: int):
+    """One synthetic CSR request: ``rows`` examples, ragged nnz ~U[1, cap]."""
+    counts = rng.integers(1, nnz_per_row + 1, size=rows)
+    total = int(counts.sum())
+    ids = rng.integers(0, features, size=total).astype(np.int32)
+    vals = rng.random(total, dtype=np.float32)
+    row_ptr = np.zeros(rows + 1, np.int32)
+    np.cumsum(counts, out=row_ptr[1:])
+    return ids, vals, row_ptr
+
+
+def run_load(host: str, port: int, *, requests: int = 2000,
+             concurrency: int = 4, pipeline_depth: int = 8,
+             rows_per_req: int = 4, nnz_per_row: int = 32,
+             features: int = 1 << 16, seed: int = 0,
+             timeout: float = 60.0) -> Dict[str, Any]:
+    """Drive a serving endpoint and measure it.
+
+    ``concurrency`` connections each keep ``pipeline_depth`` requests in
+    flight (a closed-loop generator: a response admits the next request),
+    splitting ``requests`` total.  Overload rejections are counted, not
+    retried — the report shows what the server actually shed.  Returns a
+    JSON-ready dict: qps, latency quantiles (ms), error counts.
+    """
+    per_worker = [requests // concurrency] * concurrency
+    per_worker[0] += requests - sum(per_worker)
+    hist = Histogram(max_samples=min(requests, 65536))
+    counts = {"ok": 0, "overload": 0, "rejected": 0}
+    clock = time.monotonic
+    lock = threading.Lock()
+    errors: List[str] = []
+
+    def worker(widx: int, n: int) -> None:
+        rng = np.random.default_rng(seed + widx)
+        try:
+            client = PredictClient(host, port, connect_timeout=timeout)
+        except OSError as e:
+            with lock:
+                errors.append(f"connect: {e}")
+            return
+        inflight: List[tuple] = []      # (future, t_sent)
+
+        def reap() -> None:
+            fut, t0 = inflight.pop(0)
+            try:
+                fut.result(timeout=timeout)
+                with lock:
+                    counts["ok"] += 1
+            except ServerOverloaded:
+                with lock:
+                    counts["overload"] += 1
+            except Exception as e:  # noqa: BLE001 — tally, keep loading
+                with lock:
+                    counts["rejected"] += 1
+                    if len(errors) < 5:
+                        errors.append(repr(e))
+            hist.observe(clock() - t0)
+
+        try:
+            for _ in range(n):
+                if len(inflight) >= pipeline_depth:
+                    reap()
+                ids, vals, row_ptr = _gen_request(
+                    rng, rows_per_req, nnz_per_row, features)
+                inflight.append((client.submit(ids, vals, row_ptr),
+                                 clock()))
+            while inflight:
+                reap()
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, args=(i, n), daemon=True)
+               for i, n in enumerate(per_worker)]
+    t_start = clock()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(clock() - t_start, 1e-9)
+    p50, p95, p99 = hist.quantiles([0.5, 0.95, 0.99])
+    return {
+        "requests": requests, "concurrency": concurrency,
+        "pipeline_depth": pipeline_depth, "rows_per_req": rows_per_req,
+        "nnz_per_row": nnz_per_row,
+        "ok": counts["ok"], "overload": counts["overload"],
+        "rejected": counts["rejected"], "errors": errors,
+        "wall_s": wall,
+        "qps": counts["ok"] / wall,
+        "rows_per_s": counts["ok"] * rows_per_req / wall,
+        "latency_ms": {"p50": p50 * 1e3, "p95": p95 * 1e3,
+                       "p99": p99 * 1e3, "mean": hist.mean * 1e3},
+    }
+
+
+def load_main(argv=None) -> int:
+    """CLI: ``python -m dmlc_core_tpu.serving.client host:port
+    [requests=N] [concurrency=N] ...`` — run the load generator and print
+    the JSON report."""
+    import json
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or ":" not in args[0]:
+        print("usage: serving.client <host:port> [requests=N] "
+              "[concurrency=N] [pipeline_depth=N] [rows_per_req=N] "
+              "[nnz_per_row=N] [features=N] [seed=N]", file=sys.stderr)
+        return 2
+    host, _, port = args[0].rpartition(":")
+    kw = {k: int(v) for k, v in (a.split("=", 1) for a in args[1:])}
+    report = run_load(host, int(port), **kw)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(load_main())

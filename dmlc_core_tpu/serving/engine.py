@@ -1,0 +1,311 @@
+"""Shape-bucketed jit inference engine over the model zoo.
+
+XLA compiles one program per input shape, and online traffic is maximally
+ragged: every request carries its own (rows, nnz).  Feeding raw request
+shapes to ``jax.jit`` would retrace continuously — the serving-time twin
+of the training problem ``pipeline.packing`` solves with fixed-shape
+batches, and the host-level analog of what Ragged Paged Attention solves
+in-kernel (PAPERS.md).  The engine therefore owns a small **ladder of
+shape buckets** (rows × nnz): a request is padded up to the smallest
+bucket that fits, and each bucket is compiled **ahead of time** exactly
+once (``jax.jit(...).lower(...).compile()``).  AOT executables reject any
+other shape instead of silently retracing, so the no-retrace invariant is
+structural, not aspirational — ``compile_count`` can never exceed the
+ladder size.
+
+Model **hot-reload** swaps the param tree atomically (one reference
+assignment under a lock) after validating that shapes/dtypes match the
+compiled avals; requests already holding the old tree finish on the old
+weights, new requests see the new ones, and no executable is invalidated
+because bucket shapes never change.  ``reload_from_checkpoint`` restores
+straight from a `utils.checkpoint` directory via
+:func:`~dmlc_core_tpu.utils.checkpoint.load_for_inference`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import DMLCError, check, log_info
+from ..utils.metrics import metrics
+
+__all__ = ["ShapeBucket", "BucketLadder", "InferenceEngine",
+           "RequestTooLarge"]
+
+
+class RequestTooLarge(DMLCError):
+    """Request exceeds the largest shape bucket — reject, don't retrace."""
+
+
+class ShapeBucket(NamedTuple):
+    rows: int
+    nnz: int
+
+
+class BucketLadder:
+    """Sorted ladder of (rows, nnz) buckets with smallest-fit selection.
+
+    Selection minimizes padded area (rows × nnz), the compiled program's
+    actual cost, not just row count — a 1-row/4096-nnz request should land
+    in a tall-narrow bucket, not the widest one.
+    """
+
+    def __init__(self, buckets: Sequence[Tuple[int, int]]) -> None:
+        check(len(buckets) > 0, "bucket ladder cannot be empty")
+        seen = set()
+        self.buckets: List[ShapeBucket] = []
+        for r, n in buckets:
+            check(r > 0 and n > 0, f"bad bucket ({r}, {n})")
+            b = ShapeBucket(int(r), int(n))
+            if b not in seen:
+                seen.add(b)
+                self.buckets.append(b)
+        self.buckets.sort(key=lambda b: (b.rows * b.nnz, b.rows))
+        self.max_rows = max(b.rows for b in self.buckets)
+        self.max_nnz = max(b.nnz for b in self.buckets)
+
+    @classmethod
+    def default(cls, max_rows: int = 128, max_nnz: int = 8192,
+                min_rows: int = 8, nnz_per_row: int = 64) -> "BucketLadder":
+        """Geometric doubling ladder: rows 8,16,…,max_rows, each with
+        ``rows × nnz_per_row`` value slots, plus one max-nnz catch-all per
+        rung so long rows don't force a row upgrade."""
+        rungs: List[Tuple[int, int]] = []
+        r = min_rows
+        while True:
+            r = min(r, max_rows)
+            rungs.append((r, min(r * nnz_per_row, max_nnz)))
+            rungs.append((r, max_nnz))
+            if r >= max_rows:
+                break
+            r *= 2
+        return cls(rungs)
+
+    def select(self, rows: int, nnz: int) -> ShapeBucket:
+        for b in self.buckets:          # sorted by area: first fit is best
+            if b.rows >= rows and b.nnz >= nnz:
+                return b
+        raise RequestTooLarge(
+            f"request ({rows} rows, {nnz} nnz) exceeds the largest bucket "
+            f"({self.max_rows} rows, {self.max_nnz} nnz) — split the "
+            f"request or widen the ladder")
+
+    def __len__(self) -> int:
+        return len(self.buckets)
+
+    def __iter__(self):
+        return iter(self.buckets)
+
+
+def _aval_tree(params: Any):
+    """Param tree → ShapeDtypeStruct tree without touching array data
+    (``np.asarray`` on a jax.Array would pull the whole table to host)."""
+    import jax
+
+    def aval(x):
+        dt = getattr(x, "dtype", None)
+        if dt is None:
+            dt = np.asarray(x).dtype
+        return jax.ShapeDtypeStruct(np.shape(x), np.dtype(dt))
+    return jax.tree.map(aval, params)
+
+
+def _pad_to_bucket(bucket: ShapeBucket, ids: np.ndarray, vals: np.ndarray,
+                   row_ptr: np.ndarray) -> Dict[str, np.ndarray]:
+    """CSR request → fixed-shape flat batch (the ``pack_flat`` layout, so
+    every zoo model's flat forward path consumes it unchanged).  Padding
+    values carry ``segment == bucket.rows`` (scratch row, see ``ops.csr``)
+    and padding rows carry weight 0."""
+    rows = len(row_ptr) - 1
+    nnz = len(ids)
+    out_ids = np.zeros(bucket.nnz, np.int32)
+    out_vals = np.zeros(bucket.nnz, np.float32)
+    segments = np.full(bucket.nnz, bucket.rows, np.int32)
+    out_ids[:nnz] = ids
+    out_vals[:nnz] = vals
+    counts = np.diff(row_ptr.astype(np.int64))
+    segments[:nnz] = np.repeat(np.arange(rows, dtype=np.int32), counts)
+    out_ptr = np.empty(bucket.rows + 1, np.int32)
+    out_ptr[:rows + 1] = row_ptr
+    out_ptr[rows + 1:] = nnz
+    labels = np.zeros(bucket.rows, np.float32)
+    weights = np.zeros(bucket.rows, np.float32)
+    weights[:rows] = 1.0
+    return {"ids": out_ids, "vals": out_vals, "segments": segments,
+            "row_ptr": out_ptr, "labels": labels, "weights": weights}
+
+
+class InferenceEngine:
+    """Bucketed AOT forward engine with atomic hot-reload.
+
+    ``model`` is any zoo model (``forward(params, batch) -> scores``);
+    ``postprocess="sigmoid"`` folds the binary-task link function into the
+    compiled program (one fused kernel instead of a host round-trip).
+    ``donate="auto"`` donates the batch buffers to the executable on
+    accelerators (the padded batch is dead after the call — donation lets
+    XLA reuse its HBM) and disables donation on CPU where it only warns.
+
+    Thread-safe: ``predict`` may be called from any thread (the batcher
+    worker), ``reload`` from any other (checkpoint watcher); compilation
+    of a cold bucket is serialized per bucket.
+    """
+
+    def __init__(self, model, params: Any, *,
+                 buckets: Optional[BucketLadder] = None,
+                 postprocess: str = "none", donate: str = "auto",
+                 warmup: bool = False) -> None:
+        check(postprocess in ("none", "sigmoid"),
+              f"bad postprocess {postprocess!r}")
+        import jax
+
+        self.model = model
+        self.ladder = buckets or BucketLadder.default()
+        self._postprocess = postprocess
+        self._donate = (donate == "always" or
+                        (donate == "auto"
+                         and jax.default_backend() != "cpu"))
+        self._params = params
+        self._param_avals = _aval_tree(params)
+        self._compiled: Dict[ShapeBucket, Any] = {}
+        self._compile_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self.compile_count = 0
+        self.params_version = 0
+        self._bind_metrics()
+        if warmup:
+            self.warmup_all()
+
+    def _bind_metrics(self) -> None:
+        m = metrics
+        self._m_gen = m.generation
+        self._m_compiles = m.counter("serving.engine.compiles")
+        self._m_batches = m.counter("serving.engine.batches")
+        self._m_rows = m.throughput("serving.engine.rows")
+        self._m_fwd = m.stage("serving.engine.forward")
+        self._m_occupancy = m.gauge("serving.engine.occupancy")
+        self._m_version = m.gauge("serving.engine.params_version")
+
+    def _maybe_rebind(self) -> None:
+        if self._m_gen != metrics.generation:
+            self._bind_metrics()
+
+    # -- compilation ----------------------------------------------------
+    def _forward_fn(self):
+        import jax
+
+        def fwd(params, batch):
+            out = self.model.forward(params, batch)
+            if self._postprocess == "sigmoid":
+                out = jax.nn.sigmoid(out)
+            return out
+        return fwd
+
+    def _batch_avals(self, bucket: ShapeBucket):
+        import jax
+        f32, i32 = np.dtype(np.float32), np.dtype(np.int32)
+        return {
+            "ids": jax.ShapeDtypeStruct((bucket.nnz,), i32),
+            "vals": jax.ShapeDtypeStruct((bucket.nnz,), f32),
+            "segments": jax.ShapeDtypeStruct((bucket.nnz,), i32),
+            "row_ptr": jax.ShapeDtypeStruct((bucket.rows + 1,), i32),
+            "labels": jax.ShapeDtypeStruct((bucket.rows,), f32),
+            "weights": jax.ShapeDtypeStruct((bucket.rows,), f32),
+        }
+
+    def _get_compiled(self, bucket: ShapeBucket):
+        exe = self._compiled.get(bucket)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._compiled.get(bucket)
+            if exe is not None:
+                return exe
+            import jax
+            jitted = jax.jit(self._forward_fn(),
+                             donate_argnums=(1,) if self._donate else ())
+            exe = jitted.lower(self._param_avals,
+                               self._batch_avals(bucket)).compile()
+            self._compiled[bucket] = exe
+            self.compile_count += 1
+            self._maybe_rebind()
+            self._m_compiles.add(1)
+            log_info("serving: compiled bucket rows=%d nnz=%d "
+                     "(%d/%d buckets hot)", bucket.rows, bucket.nnz,
+                     len(self._compiled), len(self.ladder))
+            return exe
+
+    def warmup_all(self) -> None:
+        """Compile every bucket AND push one dummy batch through each —
+        first-request latency pays neither tracing nor any lazy runtime
+        init.  Called before the server starts accepting."""
+        for bucket in self.ladder:
+            exe = self._get_compiled(bucket)
+            dummy = _pad_to_bucket(
+                bucket,
+                np.zeros(1, np.int32), np.zeros(1, np.float32),
+                np.array([0, 1], np.int64))
+            np.asarray(exe(self._params, dummy))
+
+    # -- serving path ---------------------------------------------------
+    def predict(self, ids: np.ndarray, vals: np.ndarray,
+                row_ptr: Optional[np.ndarray] = None) -> np.ndarray:
+        """Score one (micro-batched) CSR request.
+
+        ``ids``/``vals``: the request's concatenated feature ids/values;
+        ``row_ptr``: int offsets ``[rows+1]`` (omitted = one row).
+        Returns float32 scores ``[rows]`` — padding already stripped.
+        """
+        ids = np.asarray(ids, np.int32)
+        vals = np.asarray(vals, np.float32)
+        if row_ptr is None:
+            row_ptr = np.array([0, len(ids)], np.int64)
+        row_ptr = np.asarray(row_ptr)
+        rows = len(row_ptr) - 1
+        check(rows >= 1, "request has no rows")
+        check(len(ids) == len(vals), "ids/vals length mismatch")
+        check(int(row_ptr[0]) == 0 and int(row_ptr[-1]) == len(ids),
+              "row_ptr does not cover ids")
+        bucket = self.ladder.select(rows, max(len(ids), 1))
+        batch = _pad_to_bucket(bucket, ids, vals, row_ptr)
+        params = self._params          # atomic read: hot-reload safe
+        exe = self._get_compiled(bucket)
+        self._maybe_rebind()
+        with self._m_fwd.time():
+            out = np.asarray(exe(params, batch))
+        self._m_batches.add(1)
+        self._m_rows.add(rows)
+        self._m_occupancy.set(rows / bucket.rows)
+        return out[:rows]
+
+    # -- hot reload -----------------------------------------------------
+    def reload(self, params: Any) -> None:
+        """Atomically swap the model weights.  The new tree must match the
+        compiled avals exactly (same architecture) — a mismatched reload
+        is refused BEFORE any request can see it, and the old weights keep
+        serving."""
+        new_avals = _aval_tree(params)
+        if new_avals != self._param_avals:
+            raise DMLCError(
+                "hot-reload refused: new params do not match the serving "
+                f"model's shapes/dtypes\n  serving: {self._param_avals}\n"
+                f"  reload:  {new_avals}")
+        with self._reload_lock:
+            self._params = params
+            self.params_version += 1
+            self._maybe_rebind()
+            self._m_version.set(self.params_version)
+
+    def reload_from_checkpoint(self, directory: str,
+                               step: Optional[int] = None) -> int:
+        """Restore params from a training checkpoint dir and hot-swap
+        them; returns the restored step."""
+        from ..utils.checkpoint import load_for_inference
+        step, params, meta = load_for_inference(
+            directory, step, template=self._params)
+        self.reload(params)
+        log_info("serving: hot-reloaded step %s from %s (model=%s)",
+                 step, directory, meta.get("model", "?"))
+        return step

@@ -1,0 +1,322 @@
+"""Threaded TCP prediction server over the engine + micro-batcher.
+
+Same wire discipline as the disaggregated ingest service
+(`pipeline/ingest_service.py`): length-prefixed little-endian frames over
+plain TCP with ``TCP_NODELAY``, no serialization framework in the hot
+path.  Requests and responses are correlated by a client-chosen ``req_id``
+so one connection can **pipeline** many requests and receive responses in
+completion order — that is what lets a single client thread keep the
+micro-batcher full.
+
+Wire format (all little-endian)::
+
+    request:   [req_id u64][rows u32][nnz u32]
+               [row_ptr i32 × (rows+1)][ids i32 × nnz][vals f32 × nnz]
+    response:  [req_id u64][status u8][n u32]
+               status 0 (OK):  [scores f32 × n]      (n == rows)
+               status ≠ 0:     [utf-8 message × n]
+    statuses:  0 OK, 1 OVERLOADED, 2 DEADLINE_EXCEEDED, 3 TOO_LARGE,
+               4 SHUTDOWN, 5 BAD_REQUEST
+
+Overload shows up as a **response**, not a dropped connection: clients
+need to distinguish "back off and retry" from "server died".
+
+Hot reload: :meth:`PredictionServer.reload_from_checkpoint` swaps weights
+atomically mid-stream, and :meth:`watch_checkpoints` polls a
+`utils.checkpoint` directory and reloads whenever the trainer publishes a
+new step — the serving half of the train→serve loop.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import DMLCError, log_info, log_warning
+from ..utils.metrics import metrics
+from .batcher import DeadlineExceeded, MicroBatcher, Overloaded, Shutdown
+from .engine import InferenceEngine, RequestTooLarge
+
+__all__ = ["PredictionServer", "REQ_HEADER", "RSP_HEADER", "STATUS_OK",
+           "STATUS_OVERLOADED", "STATUS_DEADLINE", "STATUS_TOO_LARGE",
+           "STATUS_SHUTDOWN", "STATUS_BAD_REQUEST", "STATUS_NAMES"]
+
+REQ_HEADER = struct.Struct("<QII")      # req_id, rows, nnz
+RSP_HEADER = struct.Struct("<QBI")      # req_id, status, n
+
+STATUS_OK = 0
+STATUS_OVERLOADED = 1
+STATUS_DEADLINE = 2
+STATUS_TOO_LARGE = 3
+STATUS_SHUTDOWN = 4
+STATUS_BAD_REQUEST = 5
+STATUS_NAMES = {0: "OK", 1: "OVERLOADED", 2: "DEADLINE_EXCEEDED",
+                3: "TOO_LARGE", 4: "SHUTDOWN", 5: "BAD_REQUEST"}
+
+#: hard parse-time sanity caps — a corrupt header must not allocate GBs
+_MAX_ROWS = 1 << 20
+_MAX_NNZ = 1 << 26
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
+            return None
+        got += r
+    return bytes(buf)
+
+
+def _status_of(exc: BaseException) -> int:
+    if isinstance(exc, Overloaded):
+        return STATUS_OVERLOADED
+    if isinstance(exc, DeadlineExceeded):
+        return STATUS_DEADLINE
+    if isinstance(exc, RequestTooLarge):
+        return STATUS_TOO_LARGE
+    if isinstance(exc, Shutdown):
+        return STATUS_SHUTDOWN
+    return STATUS_BAD_REQUEST
+
+
+class PredictionServer:
+    """Accept loop + one reader thread per connection; responses are
+    written from batcher completion callbacks under a per-connection
+    write lock (pipelined requests complete out of order)."""
+
+    def __init__(self, engine: InferenceEngine, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_delay_s: float = 0.002, max_queue: int = 256,
+                 default_deadline_s: float = 1.0,
+                 warmup: bool = True, backlog: int = 64) -> None:
+        self.engine = engine
+        if warmup:
+            engine.warmup_all()
+        self.batcher = MicroBatcher(
+            engine, max_delay_s=max_delay_s, max_queue=max_queue,
+            default_deadline_s=default_deadline_s)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(backlog)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._next_conn = 0
+        self._stopping = False
+        self._accept_thread: Optional[threading.Thread] = None
+        self._watcher: Optional[threading.Thread] = None
+        self._watch_stop = threading.Event()
+        self._m_conns = metrics.gauge("serving.server.connections")
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "PredictionServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serving-accept", daemon=True)
+        self._accept_thread.start()
+        log_info("serving: listening on %s:%d (%d buckets, queue=%d)",
+                 self.host, self.port, len(self.engine.ladder),
+                 self.batcher.max_queue)
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful shutdown: stop accepting, drain the batcher (in-flight
+        requests get their answers), then drop connections."""
+        self._stopping = True
+        self._watch_stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self.batcher.close(drain=drain, timeout=timeout)
+        with self._conn_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._watcher is not None:
+            self._watcher.join(timeout=5.0)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- hot reload ------------------------------------------------------
+    def reload_from_checkpoint(self, directory: str,
+                               step: Optional[int] = None) -> int:
+        return self.engine.reload_from_checkpoint(directory, step)
+
+    def watch_checkpoints(self, directory: str,
+                          interval_s: float = 10.0) -> None:
+        """Poll ``directory``'s manifest; hot-reload whenever the trainer
+        publishes a newer step.  A failed poll/reload logs and keeps
+        serving the current weights — the watcher must never take down a
+        healthy replica over a half-published checkpoint."""
+        from ..utils.checkpoint import CheckpointManager
+        mgr = CheckpointManager(directory)
+        state = {"step": None}
+
+        def poll_once() -> None:
+            latest = mgr.latest_step
+            if latest is not None and latest != state["step"]:
+                self.reload_from_checkpoint(directory, latest)
+                state["step"] = latest
+
+        try:
+            poll_once()                 # load an existing checkpoint NOW —
+        except DMLCError as e:          # serve the current weights if none
+            log_warning("serving: initial checkpoint load failed: %s", e)
+
+        def run() -> None:
+            while not self._watch_stop.wait(interval_s):
+                try:
+                    poll_once()
+                except DMLCError as e:
+                    log_warning("serving: checkpoint watch failed "
+                                "(%s) — keeping current weights", e)
+
+        self._watcher = threading.Thread(target=run, name="serving-watch",
+                                         daemon=True)
+        self._watcher.start()
+
+    # -- connection handling --------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, addr = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                cid = self._next_conn
+                self._next_conn += 1
+                self._conns[cid] = conn
+                self._m_conns.set(len(self._conns))
+            threading.Thread(target=self._serve_conn, args=(cid, conn),
+                             name=f"serving-conn-{cid}",
+                             daemon=True).start()
+
+    def _drop_conn(self, cid: int, conn: socket.socket) -> None:
+        with self._conn_lock:
+            self._conns.pop(cid, None)
+            self._m_conns.set(len(self._conns))
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _serve_conn(self, cid: int, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+
+        def respond(req_id: int, status: int, payload: bytes) -> None:
+            # n counts SCORES for OK (payload is n×f32), BYTES otherwise
+            n = len(payload) // 4 if status == STATUS_OK else len(payload)
+            try:
+                with wlock:
+                    conn.sendall(RSP_HEADER.pack(req_id, status, n)
+                                 + payload)
+            except OSError:
+                pass                   # client gone; reader will notice
+
+        def on_done(req_id: int, fut) -> None:
+            exc = fut.exception()
+            if exc is None:
+                scores = np.ascontiguousarray(fut.result(),
+                                              dtype=np.float32)
+                respond(req_id, STATUS_OK, scores.tobytes())
+            else:
+                respond(req_id, _status_of(exc),
+                        str(exc).encode("utf-8", "replace"))
+
+        try:
+            while True:
+                head = _recv_exact(conn, REQ_HEADER.size)
+                if head is None:
+                    return
+                req_id, rows, nnz = REQ_HEADER.unpack(head)
+                if rows == 0 or rows > _MAX_ROWS or nnz > _MAX_NNZ:
+                    respond(req_id, STATUS_BAD_REQUEST,
+                            f"bad header rows={rows} nnz={nnz}".encode())
+                    return             # framing is broken; drop the conn
+                payload = _recv_exact(conn, 4 * (rows + 1) + 8 * nnz)
+                if payload is None:
+                    return
+                row_ptr = np.frombuffer(payload, np.int32, rows + 1, 0)
+                ids = np.frombuffer(payload, np.int32, nnz,
+                                    4 * (rows + 1))
+                vals = np.frombuffer(payload, np.float32, nnz,
+                                     4 * (rows + 1) + 4 * nnz)
+                fut = self.batcher.submit(ids, vals,
+                                          row_ptr.astype(np.int64))
+                fut.add_done_callback(
+                    lambda f, rid=req_id: on_done(rid, f))
+        except OSError as e:
+            log_info("serving: connection %d ended: %r", cid, e)
+        finally:
+            self._drop_conn(cid, conn)
+
+
+def serve_main(argv=None) -> int:
+    """CLI: ``python -m dmlc_core_tpu.serving.server ckpt_dir=DIR
+    model=fm features=N [dim=N] [port=N] [watch_s=SEC] ...`` — build the
+    zoo model, load the latest checkpoint, serve until interrupted."""
+    import sys
+    args = dict(a.split("=", 1) for a in (sys.argv[1:] if argv is None
+                                          else argv))
+    if not args.get("ckpt_dir") or not args.get("features"):
+        print("usage: serving.server ckpt_dir=DIR features=N [model=fm] "
+              "[dim=16] [task=binary] [port=0] [host=0.0.0.0] "
+              "[watch_s=10] [max_delay_ms=2] [max_queue=256]",
+              file=sys.stderr)
+        return 2
+    import jax
+
+    from ..models.cli import MODEL_REGISTRY, TrainParams
+    p = TrainParams()
+    p.init({"data": "unused", "model": args.get("model", "fm"),
+            "features": args["features"], "dim": args.get("dim", "16"),
+            "task": args.get("task", "binary")})
+    model = MODEL_REGISTRY[p.model](p)
+    params = model.init(jax.random.PRNGKey(0))
+    engine = InferenceEngine(
+        model, params,
+        postprocess="sigmoid" if p.task == "binary" else "none")
+    srv = PredictionServer(
+        engine, host=args.get("host", "0.0.0.0"),
+        port=int(args.get("port", "0")),
+        max_delay_s=float(args.get("max_delay_ms", "2")) / 1e3,
+        max_queue=int(args.get("max_queue", "256")))
+    srv.watch_checkpoints(args["ckpt_dir"],
+                          interval_s=float(args.get("watch_s", "10")))
+    srv.start()
+    print(f"serving on {srv.host}:{srv.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(serve_main())

@@ -19,10 +19,11 @@ from .memory_io import MemoryFixedSizeStream, MemoryStringStream  # noqa: F401
 from .common import split, hash_combine, byteswap  # noqa: F401
 from .checkpoint import (  # noqa: F401
     Serializable, CheckpointManager, save_pytree, load_pytree, fast_forward,
+    load_for_inference,
 )
 from .orbax_compat import save_orbax, restore_orbax  # noqa: F401
 from .metrics import (  # noqa: F401
-    Counter, Gauge, ThroughputMeter, StageTimer, MetricsRegistry,
+    Counter, Gauge, Histogram, ThroughputMeter, StageTimer, MetricsRegistry,
     metrics, trace_span, profile_trace,
 )
 from .json import (  # noqa: F401

@@ -38,7 +38,7 @@ from .logging import DMLCError, check, log_info
 
 __all__ = [
     "Serializable", "save_pytree", "load_pytree", "CheckpointManager",
-    "fast_forward",
+    "fast_forward", "load_for_inference",
 ]
 
 _MAGIC = b"DMLCKPT1"
@@ -126,10 +126,14 @@ def save_pytree(stream, tree: Any) -> None:
     _write_blob(stream, json_dumps(treedef).encode())
     stream.write(struct.pack("<I", len(leaves)))
     for arr in leaves:
+        # record the shape BEFORE ascontiguousarray: its contract is
+        # "at least 1-d", so a 0-d leaf (e.g. an FM's w0 bias) would be
+        # persisted as (1,) and no longer match the model's avals
+        shape = arr.shape
         arr = np.ascontiguousarray(arr)
         _write_blob(stream, str(arr.dtype).encode())
-        stream.write(struct.pack("<I", arr.ndim))
-        for d in arr.shape:
+        stream.write(struct.pack("<I", len(shape)))
+        for d in shape:
             stream.write(struct.pack("<Q", d))
         _write_blob(stream, arr.tobytes())
 
@@ -165,7 +169,16 @@ def load_pytree(stream, template: Any = None) -> Any:
 
     def rebuild_like(tmpl, node):
         if isinstance(node, dict) and "__leaf__" in node:
-            return leaves[node["__leaf__"]]
+            leaf = leaves[node["__leaf__"]]
+            # checkpoints written before the 0-d shape fix hold scalars
+            # as (1,); heal single-element leaves to the template's shape
+            # so old files keep restoring (larger leaves must still match)
+            tshape = getattr(tmpl, "shape", None)
+            if (tshape is not None and leaf.size == 1
+                    and int(np.prod(tshape)) == 1
+                    and tuple(tshape) != leaf.shape):
+                leaf = leaf.reshape(tuple(tshape))
+            return leaf
         if isinstance(node, dict) and "__tuple__" in node:
             children = node["__tuple__"]
             check(isinstance(tmpl, tuple) and len(tmpl) == len(children),
@@ -536,6 +549,31 @@ class CheckpointManager:
 
     def meta(self, step: int) -> Dict[str, Any]:
         return self._read_manifest()["meta"].get(str(step), {})
+
+
+def load_for_inference(directory: str, step: Optional[int] = None,
+                       template: Any = None,
+                       ) -> Tuple[int, Any, Dict[str, Any]]:
+    """Restore just the serving-relevant slice of a training checkpoint:
+    ``(step, params, meta)``.
+
+    Training checkpoints carry ``{"params": ..., "opt_state": ...}``
+    (dmlc-train) so resume restores optimizer moments; a serving replica
+    only needs the params — the opt_state (often the larger half under
+    Adam) is dropped immediately after load instead of sitting in the
+    server's RSS.  Bare-params checkpoints (anything without a ``params``
+    key) pass through whole, so hand-rolled training loops that save the
+    param tree directly serve unchanged.  ``meta`` is the manifest entry
+    for the restored step (model name etc.) so the caller can refuse a
+    checkpoint trained as a different architecture.
+    """
+    mgr = CheckpointManager(directory)
+    if template is not None and "params" not in template:
+        template = {"params": template}
+    step, state = mgr.restore(step, template=template)
+    params = (state["params"]
+              if isinstance(state, dict) and "params" in state else state)
+    return step, params, mgr.meta(step)
 
 
 def fast_forward(loader, num_batches: int) -> int:

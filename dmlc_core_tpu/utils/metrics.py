@@ -8,6 +8,9 @@ plus periodic MB/s prints in ingest loops (`basic_row_iter.h:68-76`,
 first-class and queryable:
 
 * :class:`Counter` / :class:`Gauge` — monotonic / point-in-time values.
+* :class:`Histogram` — value distribution with quantile estimation
+  (p50/p95/p99 request latency is the serving subsystem's SLO surface;
+  exact up to a sample cap, reservoir-sampled beyond it).
 * :class:`ThroughputMeter` — bytes-or-records rate with total + windowed
   rate (what the MB/s prints computed inline).
 * :class:`StageTimer` — accumulated wall time per pipeline stage, usable
@@ -25,15 +28,17 @@ first-class and queryable:
 from __future__ import annotations
 
 import contextlib
+import math
+import random
 import threading
 import time
-from typing import Any, Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 from .logging import log_info
 
 __all__ = [
-    "Counter", "Gauge", "ThroughputMeter", "StageTimer", "MetricsRegistry",
-    "metrics", "trace_span", "profile_trace",
+    "Counter", "Gauge", "Histogram", "ThroughputMeter", "StageTimer",
+    "MetricsRegistry", "metrics", "trace_span", "profile_trace",
 ]
 
 
@@ -71,6 +76,102 @@ class Gauge:
 
     def snapshot(self) -> Dict[str, Any]:
         return {"type": "gauge", "value": self._v}
+
+
+class Histogram:
+    """Value distribution with quantile estimation (thread-safe).
+
+    Exact while the stream fits in ``max_samples``; past that, reservoir
+    sampling keeps a uniform sample of everything seen so far, so
+    quantiles stay unbiased over unbounded streams at O(1) memory while
+    count/sum/min/max remain exact.  The reservoir RNG is seeded, so a
+    replayed stream reports identical quantiles.
+    """
+
+    def __init__(self, max_samples: int = 8192, seed: int = 0) -> None:
+        if max_samples <= 0:
+            raise ValueError("max_samples must be > 0")
+        self._cap = int(max_samples)
+        self._samples: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            if len(self._samples) < self._cap:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < self._cap:
+                    self._samples[j] = v
+
+    @contextlib.contextmanager
+    def time(self, clock: Callable[[], float] = time.monotonic
+             ) -> Iterator[None]:
+        """Observe the wall time of a block (seconds)."""
+        t0 = clock()
+        try:
+            yield
+        finally:
+            self.observe(clock() - t0)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    @property
+    def min(self) -> float:
+        return self._min if self._count else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        return self.quantiles([q])[0]
+
+    def quantiles(self, qs: Sequence[float]) -> List[float]:
+        """Linear interpolation between closest ranks (numpy's default),
+        computed over the (possibly sampled) observation set."""
+        for q in qs:
+            if not 0.0 <= q <= 1.0:
+                raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            s = sorted(self._samples)
+        if not s:
+            return [0.0 for _ in qs]
+        out = []
+        for q in qs:
+            pos = q * (len(s) - 1)
+            lo = int(math.floor(pos))
+            hi = min(lo + 1, len(s) - 1)
+            out.append(s[lo] + (pos - lo) * (s[hi] - s[lo]))
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        p50, p95, p99 = self.quantiles([0.5, 0.95, 0.99])
+        return {"type": "histogram", "count": self._count,
+                "mean": self.mean, "min": self.min, "max": self.max,
+                "p50": p50, "p95": p95, "p99": p99}
 
 
 class ThroughputMeter:
@@ -209,6 +310,9 @@ class MetricsRegistry:
 
     def gauge(self, name: str) -> Gauge:
         return self._get(name, Gauge)
+
+    def histogram(self, name: str, max_samples: int = 8192) -> Histogram:
+        return self._get(name, Histogram, max_samples=max_samples)
 
     def throughput(self, name: str, window_sec: float = 5.0) -> ThroughputMeter:
         return self._get(name, ThroughputMeter, window_sec=window_sec)

@@ -43,6 +43,32 @@ def test_pytree_roundtrip_mixed():
     assert out["shape"] == (3, 4)          # tuples survive as tuples
 
 
+def test_pytree_scalar_leaf_keeps_0d_shape():
+    # np.ascontiguousarray is "at least 1-d": the writer must record the
+    # shape before it, or an FM-style 0-d bias comes back as (1,) and no
+    # longer matches the model's avals (breaks serving hot-reload)
+    tree = {"w0": np.float32(0.25), "z": np.zeros((), np.float64),
+            "w": np.arange(3, dtype=np.float32)}
+    out = _roundtrip(tree)
+    assert out["w0"].shape == () and out["w0"] == np.float32(0.25)
+    assert out["z"].shape == ()
+    assert out["w"].shape == (3,)
+
+
+def test_pytree_template_heals_legacy_1d_scalars():
+    # checkpoints written before the 0-d fix hold scalars as (1,); a
+    # template restore reshapes single-element leaves to the template's
+    # shape, but larger leaves must still match exactly
+    buf = io.BytesIO()
+    save_pytree(buf, {"w0": np.full((1,), 0.5, np.float32),
+                      "w": np.arange(4, dtype=np.float32)})
+    buf.seek(0)
+    out = load_pytree(buf, template={"w0": np.zeros((), np.float32),
+                                     "w": np.zeros(4, np.float32)})
+    assert out["w0"].shape == () and out["w0"] == np.float32(0.5)
+    assert out["w"].shape == (4,)
+
+
 def test_pytree_jax_arrays_roundtrip_as_numpy():
     import jax.numpy as jnp
     tree = {"w": jnp.arange(8, dtype=jnp.float32), "nested": [jnp.ones(3)]}

@@ -8,6 +8,7 @@ import pytest
 from dmlc_core_tpu.utils.metrics import (
     Counter,
     Gauge,
+    Histogram,
     MetricsRegistry,
     StageTimer,
     ThroughputMeter,
@@ -84,6 +85,80 @@ def test_registry_snapshot_and_reuse():
     r.report()                        # must not raise
     r.reset()
     assert r.snapshot() == {}
+
+
+def test_histogram_exact_quantiles_under_cap():
+    """While the sample count fits the reservoir, quantiles are EXACT
+    (linear interpolation between closest ranks)."""
+    h = Histogram(max_samples=1000)
+    for v in range(1, 101):               # 1..100, in order
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.min == 1.0 and h.max == 100.0
+    assert h.mean == pytest.approx(50.5)
+    assert h.quantile(0.0) == 1.0
+    assert h.quantile(1.0) == 100.0
+    assert h.quantile(0.5) == pytest.approx(50.5)
+    p50, p95, p99 = h.quantiles([0.5, 0.95, 0.99])
+    assert p50 == pytest.approx(50.5)
+    assert p95 == pytest.approx(95.05)
+    assert p99 == pytest.approx(99.01)
+
+
+def test_histogram_insertion_order_irrelevant():
+    import random
+    vals = list(range(1, 101))
+    random.Random(7).shuffle(vals)
+    h = Histogram(max_samples=1000)
+    for v in vals:
+        h.observe(float(v))
+    assert h.quantile(0.5) == pytest.approx(50.5)
+
+
+def test_histogram_reservoir_beyond_cap_stays_bounded_and_sane():
+    h = Histogram(max_samples=64, seed=3)
+    for v in range(10_000):
+        h.observe(float(v))
+    assert h.count == 10_000              # exact even when sampling
+    assert h.mean == pytest.approx(4999.5)
+    assert h.min == 0.0 and h.max == 9999.0
+    # sampled median of U[0,10000) lands near the middle
+    assert 2000.0 < h.quantile(0.5) < 8000.0
+
+
+def test_histogram_empty_and_errors():
+    h = Histogram()
+    assert h.count == 0
+    assert h.quantile(0.5) == 0.0
+    assert h.mean == 0.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    with pytest.raises(ValueError):
+        h.quantile(-0.1)
+    with pytest.raises(ValueError):
+        Histogram(max_samples=0)
+
+
+def test_histogram_snapshot_and_registry():
+    r = MetricsRegistry()
+    h = r.histogram("lat")
+    assert r.histogram("lat") is h        # same instance by name
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    snap = r.snapshot()["lat"]
+    assert snap["type"] == "histogram"
+    assert snap["count"] == 4
+    assert snap["p50"] == pytest.approx(2.5)
+    import json
+    json.dumps(snap)
+
+
+def test_histogram_time_context():
+    h = Histogram()
+    with h.time():
+        pass
+    assert h.count == 1
+    assert h.min >= 0.0
 
 
 def test_trace_span_noop_safe():

@@ -1,0 +1,465 @@
+"""Serving subsystem: bucket ladder + no-retrace invariant, micro-batcher
+deadline/overload/drain semantics, server↔client round-trip, checkpoint
+hot-reload mid-stream, and load-generator integrity — all on CPU."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+from dmlc_core_tpu.models import FactorizationMachine, SparseLogReg  # noqa: E402
+from dmlc_core_tpu.serving import (  # noqa: E402
+    BucketLadder, DeadlineExceeded, InferenceEngine, MicroBatcher,
+    Overloaded, PredictClient, PredictionServer, RequestTooLarge,
+    ServerOverloaded, Shutdown, run_load)
+from dmlc_core_tpu.utils import CheckpointManager, load_for_inference  # noqa: E402
+
+F = 5000  # feature space for all serving tests
+
+
+def _logreg_engine(w_scale=1.0, **kw):
+    model = SparseLogReg(num_features=F)
+    params = {"w": jnp.arange(F, dtype=jnp.float32) / F * w_scale,
+              "b": jnp.float32(0.25)}
+    return InferenceEngine(model, params, **kw), model, params
+
+
+def _req(rng, rows, nnz_per_row):
+    counts = rng.integers(1, nnz_per_row + 1, size=rows)
+    ids = rng.integers(0, F, size=int(counts.sum())).astype(np.int32)
+    vals = rng.random(len(ids), dtype=np.float32)
+    row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    return ids, vals, row_ptr
+
+
+def _ref_scores(params, ids, vals, row_ptr):
+    w = np.asarray(params["w"])
+    return np.array([
+        float(vals[row_ptr[r]:row_ptr[r + 1]]
+              @ w[ids[row_ptr[r]:row_ptr[r + 1]]]) + float(params["b"])
+        for r in range(len(row_ptr) - 1)])
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_selects_smallest_fit():
+    ladder = BucketLadder([(8, 64), (8, 512), (32, 512), (128, 4096)])
+    assert ladder.select(3, 20) == (8, 64)
+    assert ladder.select(8, 64) == (8, 64)
+    assert ladder.select(8, 65) == (8, 512)       # nnz forces wider
+    assert ladder.select(9, 10) == (32, 512)      # rows force taller
+    assert ladder.select(128, 4096) == (128, 4096)
+    with pytest.raises(RequestTooLarge):
+        ladder.select(129, 1)
+    with pytest.raises(RequestTooLarge):
+        ladder.select(1, 5000)
+
+
+def test_ladder_min_area_not_row_greedy():
+    """A 1-row/1024-nnz request must land in the tall-narrow bucket, not
+    the widest one (area-ordered selection)."""
+    ladder = BucketLadder([(128, 8192), (8, 1024)])
+    assert ladder.select(1, 1024) == (8, 1024)
+
+
+# ---------------------------------------------------------------------------
+# engine: correctness + no-retrace invariant
+# ---------------------------------------------------------------------------
+
+def test_engine_scores_match_dense_reference():
+    eng, _, params = _logreg_engine(
+        buckets=BucketLadder([(8, 256), (32, 1024)]))
+    rng = np.random.default_rng(0)
+    ids, vals, row_ptr = _req(rng, 5, 30)
+    out = eng.predict(ids, vals, row_ptr)
+    np.testing.assert_allclose(out, _ref_scores(params, ids, vals, row_ptr),
+                               rtol=1e-5)
+
+
+def test_engine_compiles_at_most_once_per_bucket_over_100_requests():
+    """The acceptance invariant: a 100-request ragged stream compiles at
+    most once per shape bucket — no request ever triggers a retrace."""
+    ladder = BucketLadder([(4, 64), (16, 256), (64, 1024)])
+    eng, _, params = _logreg_engine(buckets=ladder)
+    rng = np.random.default_rng(1)
+    used = set()
+    for _ in range(100):
+        rows = int(rng.integers(1, 40))
+        ids, vals, row_ptr = _req(rng, rows, 12)
+        used.add(ladder.select(rows, len(ids)))
+        out = eng.predict(ids, vals, row_ptr)
+        assert out.shape == (rows,)
+    assert eng.compile_count == len(used) <= len(ladder)
+    # the executables are AOT: same stream again adds zero compilations
+    rng = np.random.default_rng(1)
+    for _ in range(100):
+        rows = int(rng.integers(1, 40))
+        ids, vals, row_ptr = _req(rng, rows, 12)
+        eng.predict(ids, vals, row_ptr)
+    assert eng.compile_count == len(used)
+
+
+def test_engine_warmup_compiles_whole_ladder():
+    ladder = BucketLadder([(4, 64), (16, 256)])
+    eng, _, _ = _logreg_engine(buckets=ladder, warmup=True)
+    assert eng.compile_count == len(ladder)
+
+
+def test_engine_sigmoid_postprocess():
+    eng, _, params = _logreg_engine(
+        buckets=BucketLadder([(8, 256)]), postprocess="sigmoid")
+    rng = np.random.default_rng(2)
+    ids, vals, row_ptr = _req(rng, 3, 10)
+    out = eng.predict(ids, vals, row_ptr)
+    ref = 1.0 / (1.0 + np.exp(-_ref_scores(params, ids, vals, row_ptr)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_engine_reload_refuses_mismatched_architecture():
+    eng, _, _ = _logreg_engine(buckets=BucketLadder([(8, 256)]))
+    with pytest.raises(Exception, match="hot-reload refused"):
+        eng.reload({"w": jnp.zeros(F + 1), "b": jnp.float32(0.0)})
+    # and the old weights keep serving
+    out = eng.predict(np.array([1], np.int32), np.ones(1, np.float32))
+    assert out.shape == (1,)
+
+
+def test_engine_reload_swaps_weights_without_recompiling():
+    eng, _, _ = _logreg_engine(buckets=BucketLadder([(8, 256)]))
+    ids = np.array([100], np.int32)
+    vals = np.ones(1, np.float32)
+    before = eng.predict(ids, vals)[0]
+    n_compiles = eng.compile_count
+    eng.reload({"w": jnp.zeros(F, jnp.float32), "b": jnp.float32(7.0)})
+    after = eng.predict(ids, vals)[0]
+    assert before != after
+    assert after == pytest.approx(7.0)
+    assert eng.compile_count == n_compiles
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+class _SlowEngine:
+    """Engine stub: records calls, optional per-call delay/failure."""
+
+    def __init__(self, delay=0.0):
+        self.ladder = BucketLadder([(64, 4096)])
+        self.delay = delay
+        self.calls = []
+        self.fail = False
+
+    def predict(self, ids, vals, row_ptr):
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail:
+            raise RuntimeError("injected engine failure")
+        self.calls.append(len(row_ptr) - 1)
+        return np.arange(len(row_ptr) - 1, dtype=np.float32)
+
+
+def test_batcher_aggregates_and_splits():
+    eng, _, params = _logreg_engine(buckets=BucketLadder([(64, 4096)]))
+    b = MicroBatcher(eng, max_delay_s=0.02)
+    rng = np.random.default_rng(3)
+    reqs = [_req(rng, int(rng.integers(1, 5)), 8) for _ in range(20)]
+    futs = [b.submit(*r) for r in reqs]
+    for (ids, vals, row_ptr), f in zip(reqs, futs):
+        np.testing.assert_allclose(
+            f.result(timeout=10),
+            _ref_scores(params, ids, vals, row_ptr), rtol=1e-4)
+    b.close()
+
+
+def test_batcher_delay_trigger_cuts_partial_batch():
+    """One lone request must not wait for a full batch — the delay
+    trigger serves it after ~max_delay_s."""
+    stub = _SlowEngine()
+    b = MicroBatcher(stub, max_delay_s=0.01)
+    t0 = time.monotonic()
+    f = b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+    f.result(timeout=5)
+    assert time.monotonic() - t0 < 2.0
+    assert stub.calls == [1]
+    b.close()
+
+
+def test_batcher_size_trigger_fills_batch():
+    stub = _SlowEngine(delay=0.05)       # slow call lets the queue pool
+    b = MicroBatcher(stub, max_delay_s=10.0, max_batch_rows=8)
+    futs = [b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+            for _ in range(16)]
+    for f in futs:
+        f.result(timeout=10)
+    b.close()
+    # with a 10s delay trigger, only the size trigger can have cut these
+    assert max(stub.calls) == 8
+    assert sum(stub.calls) == 16
+
+
+def test_batcher_overload_rejects_explicitly():
+    stub = _SlowEngine(delay=0.2)
+    b = MicroBatcher(stub, max_delay_s=0.001, max_queue=4)
+    futs = [b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+            for _ in range(40)]
+    outcomes = []
+    for f in futs:
+        try:
+            f.result(timeout=20)
+            outcomes.append("ok")
+        except Overloaded:
+            outcomes.append("overload")
+    assert "overload" in outcomes          # burst over capacity: shed
+    assert "ok" in outcomes                # but admitted work completes
+    b.close()
+
+
+def test_batcher_deadline_expires_queued_request():
+    stub = _SlowEngine(delay=0.15)
+    b = MicroBatcher(stub, max_delay_s=0.001, max_queue=64)
+    first = b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+    give_up = time.monotonic() + 5
+    while b.queue_depth > 0 and time.monotonic() < give_up:
+        time.sleep(0.001)             # first is now INSIDE the engine call
+    # queued behind a 150ms engine call with a 10ms deadline: must expire
+    doomed = b.submit(np.array([1], np.int32), np.ones(1, np.float32),
+                      deadline_s=0.01)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=10)
+    first.result(timeout=10)
+    b.close()
+
+
+def test_batcher_oversized_request_fails_fast():
+    stub = _SlowEngine()
+    b = MicroBatcher(stub, max_delay_s=0.001)
+    f = b.submit(np.zeros(5000, np.int32), np.zeros(5000, np.float32))
+    with pytest.raises(RequestTooLarge):
+        f.result(timeout=5)
+    b.close()
+
+
+def test_batcher_engine_failure_fans_out_and_worker_survives():
+    stub = _SlowEngine()
+    b = MicroBatcher(stub, max_delay_s=0.001)
+    stub.fail = True
+    f = b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+    with pytest.raises(RuntimeError, match="injected"):
+        f.result(timeout=5)
+    stub.fail = False                     # worker must still be alive
+    f2 = b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+    assert f2.result(timeout=5).shape == (1,)
+    b.close()
+
+
+def test_batcher_graceful_drain_serves_queue():
+    stub = _SlowEngine(delay=0.02)
+    b = MicroBatcher(stub, max_delay_s=5.0, max_batch_rows=4)
+    futs = [b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+            for _ in range(10)]
+    b.close(drain=True)                   # delay trigger never fired
+    for f in futs:
+        assert f.result(timeout=1).shape == (1,)
+    f = b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+    with pytest.raises(Shutdown):
+        f.result(timeout=1)
+
+
+def test_batcher_hard_shutdown_fails_queue():
+    stub = _SlowEngine(delay=0.05)
+    b = MicroBatcher(stub, max_delay_s=5.0)
+    futs = [b.submit(np.array([1], np.int32), np.ones(1, np.float32))
+            for _ in range(4)]
+    b.close(drain=False)
+    failed = 0
+    for f in futs:
+        try:
+            f.result(timeout=1)
+        except Shutdown:
+            failed += 1
+    assert failed >= 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: server <-> client
+# ---------------------------------------------------------------------------
+
+def test_server_client_roundtrip():
+    eng, _, params = _logreg_engine(
+        buckets=BucketLadder([(16, 512), (64, 2048)]))
+    with PredictionServer(eng, warmup=True).start() as srv:
+        with PredictClient(srv.host, srv.port) as c:
+            rng = np.random.default_rng(4)
+            for _ in range(20):
+                ids, vals, row_ptr = _req(rng, int(rng.integers(1, 10)), 16)
+                out = c.predict(ids, vals, row_ptr)
+                np.testing.assert_allclose(
+                    out, _ref_scores(params, ids, vals, row_ptr),
+                    rtol=1e-4, atol=1e-5)
+
+
+def test_server_pipelined_requests_one_connection():
+    eng, _, params = _logreg_engine(buckets=BucketLadder([(64, 2048)]))
+    with PredictionServer(eng, warmup=True).start() as srv:
+        with PredictClient(srv.host, srv.port) as c:
+            rng = np.random.default_rng(5)
+            reqs = [_req(rng, 2, 8) for _ in range(50)]
+            futs = [c.submit(*r) for r in reqs]
+            for (ids, vals, row_ptr), f in zip(reqs, futs):
+                np.testing.assert_allclose(
+                    f.result(timeout=30),
+                    _ref_scores(params, ids, vals, row_ptr),
+                    rtol=1e-4, atol=1e-5)
+
+
+def test_server_overload_surfaces_as_typed_error():
+    eng, _, _ = _logreg_engine(buckets=BucketLadder([(16, 512)]))
+    # slow the engine AFTER warmup so the bounded queue actually fills
+    # (a sleep in forward() would only fire at trace time — AOT never
+    # re-runs the python)
+    orig_predict = eng.predict
+
+    def slow_predict(ids, vals, row_ptr=None):
+        time.sleep(0.1)
+        return orig_predict(ids, vals, row_ptr)
+
+    eng.predict = slow_predict
+    with PredictionServer(eng, warmup=True, max_queue=2,
+                          max_delay_s=0.001).start() as srv:
+        with PredictClient(srv.host, srv.port) as c:
+            futs = [c.submit(np.array([1], np.int32),
+                             np.ones(1, np.float32)) for _ in range(30)]
+            shed = ok = 0
+            for f in futs:
+                try:
+                    f.result(timeout=30)
+                    ok += 1
+                except ServerOverloaded:
+                    shed += 1
+            assert shed > 0, "burst over a queue of 2 must shed load"
+            assert ok > 0, "admitted requests must still complete"
+
+
+def test_server_load_generator_reports():
+    eng, _, _ = _logreg_engine(buckets=BucketLadder([(64, 2048)]),
+                               postprocess="sigmoid")
+    with PredictionServer(eng, warmup=True).start() as srv:
+        rep = run_load(srv.host, srv.port, requests=200, concurrency=2,
+                       pipeline_depth=8, rows_per_req=2, nnz_per_row=8,
+                       features=F)
+    assert rep["ok"] == 200 and rep["rejected"] == 0, rep["errors"]
+    assert rep["qps"] > 0
+    assert 0 < rep["latency_ms"]["p50"] <= rep["latency_ms"]["p99"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hot-reload
+# ---------------------------------------------------------------------------
+
+def _save_ckpt(tmp_path, step, scale):
+    params = {"w": jnp.full((F,), scale, jnp.float32),
+              "b": jnp.float32(0.0)}
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(step, {"params": params, "opt_state": {"count": jnp.int32(0)}},
+             meta={"model": "logreg"})
+    return params
+
+
+def test_load_for_inference_strips_opt_state(tmp_path):
+    _save_ckpt(tmp_path, 7, 2.0)
+    step, params, meta = load_for_inference(str(tmp_path))
+    assert step == 7
+    assert set(params) == {"w", "b"}
+    assert meta["model"] == "logreg"
+    np.testing.assert_allclose(np.asarray(params["w"])[:3], 2.0)
+
+
+def test_hot_reload_mid_stream_no_dropped_requests(tmp_path):
+    """Requests stream while the checkpoint is swapped under the engine:
+    nothing may fail, early answers use the old weights, late answers the
+    new ones."""
+    _save_ckpt(tmp_path, 1, 1.0)
+    model = SparseLogReg(num_features=F)
+    step, params, _ = load_for_inference(str(tmp_path))
+    eng = InferenceEngine(model, params,
+                          buckets=BucketLadder([(16, 512)]))
+    ids = np.array([123], np.int32)
+    vals = np.ones(1, np.float32)
+
+    with PredictionServer(eng, warmup=True).start() as srv:
+        with PredictClient(srv.host, srv.port) as c:
+            stop = threading.Event()
+            results, failures = [], []
+
+            def stream():
+                while not stop.is_set():
+                    try:
+                        results.append(float(c.predict(ids, vals,
+                                                       timeout=30)[0]))
+                    except Exception as e:  # noqa: BLE001 — the assert
+                        failures.append(repr(e))
+                        return
+
+            t = threading.Thread(target=stream, daemon=True)
+            t.start()
+            while len(results) < 20:      # stream established
+                time.sleep(0.001)
+            _save_ckpt(tmp_path, 2, 5.0)  # trainer publishes new weights
+            reloaded_step = srv.reload_from_checkpoint(str(tmp_path))
+            n_at_reload = len(results)
+            while len(results) < n_at_reload + 20:
+                time.sleep(0.001)
+            stop.set()
+            t.join(timeout=10)
+
+    assert failures == [], failures
+    assert reloaded_step == 2
+    assert results[0] == pytest.approx(1.0)     # old: w=1 → 1·1+0
+    assert results[-1] == pytest.approx(5.0)    # new: w=5
+    # exactly one switch point, no corrupt interleaving
+    assert sorted(set(results)) == [1.0, 5.0]
+
+
+def test_watch_checkpoints_picks_up_new_step(tmp_path):
+    _save_ckpt(tmp_path, 1, 1.0)
+    model = SparseLogReg(num_features=F)
+    _, params, _ = load_for_inference(str(tmp_path))
+    eng = InferenceEngine(model, params, buckets=BucketLadder([(16, 512)]))
+    srv = PredictionServer(eng, warmup=True)
+    srv.watch_checkpoints(str(tmp_path), interval_s=0.05)
+    v0 = eng.params_version           # initial poll already loaded step 1
+    srv.start()
+    try:
+        _save_ckpt(tmp_path, 9, 3.0)
+        deadline = time.monotonic() + 20
+        while eng.params_version == v0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.params_version > v0, "watcher never reloaded"
+        with PredictClient(srv.host, srv.port) as c:
+            out = c.predict(np.array([1], np.int32),
+                            np.ones(1, np.float32))
+        assert out[0] == pytest.approx(3.0)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the zoo: an FM engine serves too (bucketed path is model-agnostic)
+# ---------------------------------------------------------------------------
+
+def test_fm_model_serves():
+    model = FactorizationMachine(num_features=F, dim=4)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = InferenceEngine(model, params,
+                          buckets=BucketLadder([(8, 256)]))
+    rng = np.random.default_rng(6)
+    ids, vals, row_ptr = _req(rng, 4, 10)
+    out = eng.predict(ids, vals, row_ptr)
+    assert out.shape == (4,) and np.isfinite(out).all()
