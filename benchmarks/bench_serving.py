@@ -79,6 +79,13 @@ def main() -> int:
             k: snap["serving.latency_s"][k] * 1e3
             for k in ("p50", "p95", "p99", "mean")}
         rep["batch_occupancy"] = snap["serving.batcher.occupancy"]["value"]
+        # resilience counters: how much retry/reconnect/shed machinery the
+        # scenario actually exercised (zero on a healthy run except the
+        # overload scenario's sheds)
+        rep["resilience"] = {
+            k: v["value"] for k, v in sorted(snap.items())
+            if k.startswith(("retry.", "circuit.", "faults."))
+            or k in ("serving.server.shed", "serving.client.reconnects")}
         report["scenarios"][name] = rep
         log(f"{name}: qps={rep['qps']:.0f} "
             f"p50={rep['latency_ms']['p50']:.2f}ms "

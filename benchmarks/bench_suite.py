@@ -1009,7 +1009,7 @@ def bench_allreduce() -> dict:
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from dmlc_core_tpu.utils.jax_compat import shard_map
     devs = jax.devices()
     n = len(devs)
     elems = (TARGET_MB * MB) // 4
@@ -1093,7 +1093,7 @@ def bench_allreduce_mesh8() -> dict:
         "isinstance(reg, dict) and reg.pop('axon', None)\n"
         "import time, numpy as np, jax.numpy as jnp\n"
         "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
-        "from jax import shard_map\n"
+        "from dmlc_core_tpu.utils.jax_compat import shard_map\n"
         "devs = jax.devices(); n = len(devs)\n"
         "mesh = Mesh(np.array(devs), ('dp',))\n"
         "x = jax.device_put(jnp.ones((4 << 20,), jnp.float32),\n"

@@ -33,17 +33,18 @@ without needing cloud credentials or egress.
 from __future__ import annotations
 
 import datetime as _dt
+import email.utils
 import hashlib
 import hmac
 import http.client
 import io
 import os
-import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import BinaryIO, Dict, List, Optional, Tuple
 
-from ..utils import DMLCError, check
+from ..utils import (Deadline, DeadlineExpired, DMLCError, RetriesExhausted,
+                     RetryPolicy, check, fault_point, get_env)
 from .filesys import FS_REGISTRY, FileInfo, FileSystem
 from .uri import URI
 
@@ -57,44 +58,108 @@ _MIN_PART_SIZE = 5 << 20       # S3 minimum multipart part (ref s3_filesys.cc:64
 _MAX_RETRY = 3
 
 
+class _RetryableStatus(OSError):
+    """A 5xx/429 response re-raised through the retry machinery.  Subclasses
+    ``OSError`` so the default retryable predicate sees it; carries the full
+    response so retry exhaustion can still RETURN it (the caller contract:
+    non-transport failures come back as a status, not an exception), and the
+    server's ``Retry-After`` as the ``retry_after_s`` backoff-floor hint that
+    :meth:`RetryPolicy.call` honors (clamped at the remaining deadline)."""
+
+    def __init__(self, status: int, hdrs: Dict[str, str], data: bytes,
+                 retry_after_s: Optional[float] = None) -> None:
+        super().__init__(f"HTTP {status}")
+        self.status = status
+        self.hdrs = hdrs
+        self.data = data
+        self.retry_after_s = retry_after_s
+
+
+def _parse_retry_after(hdrs: Dict[str, str]) -> Optional[float]:
+    """``Retry-After`` → seconds; both RFC forms (delta-seconds, HTTP-date)."""
+    ra = hdrs.get("retry-after")
+    if ra is None:
+        return None
+    try:
+        return max(0.0, float(ra))
+    except ValueError:
+        pass
+    try:
+        t = email.utils.parsedate_to_datetime(ra)
+        now = _dt.datetime.now(_dt.timezone.utc)
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=_dt.timezone.utc)
+        return max(0.0, (t - now).total_seconds())
+    except (TypeError, ValueError):
+        return None
+
+
 def _http_request(scheme: str, netloc: str, method: str, path_qs: str,
                   headers: Dict[str, str], body: bytes = b"",
                   timeout: float = 60.0,
-                  retries: Optional[int] = None
+                  retries: Optional[int] = None,
+                  deadline: Optional[Deadline] = None
                   ) -> Tuple[int, Dict[str, str], bytes]:
-    """One HTTP round trip; by default retries only idempotent methods (a
-    retried POST/PUT could double-apply or fail after server-side success —
-    e.g. re-sending CompleteMultipartUpload for an already-completed id).
-    Callers that KNOW a write is idempotent (UploadPart: same
-    partNumber+uploadId replaces the part; InitiateMultipartUpload: a
-    lost-response orphan id is lifecycle-cleaned) pass ``retries``
-    explicitly — the write-side analog of restart-on-seek
-    (`s3_filesys.cc:747-799`)."""
+    """One HTTP round trip under the shared retry machinery
+    (:class:`~dmlc_core_tpu.utils.retry.RetryPolicy`: exponential backoff,
+    full jitter, ``DMLC_IO_*`` env knobs, ``retry.io.http.*`` counters).
+
+    Transport errors, 5xx and 429 are retried; 429's ``Retry-After`` raises
+    the backoff floor (capped at the remaining ``DMLC_IO_DEADLINE`` budget).
+    By default only idempotent methods retry (a retried POST/PUT could
+    double-apply or fail after server-side success — e.g. re-sending
+    CompleteMultipartUpload for an already-completed id).  Callers that KNOW
+    a write is idempotent (UploadPart: same partNumber+uploadId replaces the
+    part; InitiateMultipartUpload: a lost-response orphan id is
+    lifecycle-cleaned) pass ``retries`` explicitly — the write-side analog
+    of restart-on-seek (`s3_filesys.cc:747-799`).
+
+    Each attempt crosses the ``s3.request`` fault-injection probe, so drops/
+    latency/5xx schedules from ``DMLC_FAULT_SPEC`` exercise this exact path.
+    """
     if retries is None:
-        retries = _MAX_RETRY if method in ("GET", "HEAD") else 1
-    last_exc: Optional[Exception] = None
-    for attempt in range(retries):
+        retries = (get_env("DMLC_IO_RETRIES", _MAX_RETRY)
+                   if method in ("GET", "HEAD") else 1)
+    if deadline is None:
+        budget = get_env("DMLC_IO_DEADLINE", 0.0)
+        deadline = Deadline(budget if budget > 0 else None)
+    policy = RetryPolicy(
+        max_attempts=retries,
+        base_delay_s=get_env("DMLC_IO_BACKOFF_BASE", 0.1),
+        max_delay_s=get_env("DMLC_IO_BACKOFF_MAX", 2.0),
+        retryable=lambda e: isinstance(
+            e, (OSError, http.client.HTTPException)),
+        name="io.http")
+
+    def _once() -> Tuple[int, Dict[str, str], bytes]:
+        fault_point("s3.request")
         conn = None
         try:
             cls = (http.client.HTTPSConnection if scheme == "https"
                    else http.client.HTTPConnection)
-            conn = cls(netloc, timeout=timeout)
+            conn = cls(netloc, timeout=deadline.clamp(timeout))
             conn.request(method, path_qs, body=body or None, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
             hdrs = {k.lower(): v for k, v in resp.getheaders()}
-            if resp.status >= 500 and attempt + 1 < retries:
-                time.sleep(0.1 * (attempt + 1))
-                continue
-            return resp.status, hdrs, data
-        except (OSError, http.client.HTTPException) as e:
-            last_exc = e
-            if attempt + 1 < retries:
-                time.sleep(0.1 * (attempt + 1))
         finally:
             if conn is not None:
                 conn.close()
-    raise DMLCError(f"http {method} {netloc}{path_qs} failed: {last_exc}")
+        if resp.status >= 500 or resp.status == 429:
+            raise _RetryableStatus(resp.status, hdrs, data,
+                                   _parse_retry_after(hdrs))
+        return resp.status, hdrs, data
+
+    try:
+        return policy.call(_once, deadline=deadline)
+    except (RetriesExhausted, DeadlineExpired) as e:
+        cause = e.__cause__
+        if isinstance(cause, _RetryableStatus):
+            # exhausted on a retryable STATUS: hand the caller the final
+            # response, same contract as the old hand-rolled loop
+            return cause.status, cause.hdrs, cause.data
+        raise DMLCError(
+            f"http {method} {netloc}{path_qs} failed: {cause or e}") from e
 
 
 class RangedReadStream(io.RawIOBase):

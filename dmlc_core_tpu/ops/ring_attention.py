@@ -26,7 +26,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..utils.jax_compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["ring_attention", "make_ring_attention", "reference_attention"]
@@ -71,7 +71,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     [B, T_local, H, D].  Shard i initially holds K/V block i; at step s it
     processes block (i - s) mod N received via ppermute.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
     b, t_local, h, d = q.shape

@@ -36,7 +36,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..utils.jax_compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .ring_attention import reference_attention
@@ -89,7 +89,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     permutations, so each one's adjoint IS the other (``all_to_all``'s
     autodiff transpose mislowers under this shard_map configuration, and
     the explicit adjoint pair is also the numerically obvious thing)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
 
     @jax.custom_vjp
     def run(q, k, v):

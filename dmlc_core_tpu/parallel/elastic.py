@@ -51,6 +51,30 @@ from .rabit import RabitContext
 
 __all__ = ["ElasticJaxMesh"]
 
+_BOUNDED_SHUTDOWN: Optional[bool] = None
+
+# deliberately leaked coordination handles from torn-down generations on
+# jaxes without a bounded shutdown barrier — see _teardown's clear_state
+_ZOMBIE_HANDLES: list = []
+
+
+def _bounded_shutdown_supported() -> bool:
+    """Whether this jax accepts heartbeat/shutdown budget kwargs on
+    ``jax.distributed.initialize`` — the same vintages bound the shutdown
+    barrier; older ones block it indefinitely and LOG(FATAL) on a dead
+    peer."""
+    global _BOUNDED_SHUTDOWN
+    if _BOUNDED_SHUTDOWN is None:
+        import inspect
+
+        import jax
+        try:
+            params = inspect.signature(jax.distributed.initialize).parameters
+            _BOUNDED_SHUTDOWN = "shutdown_timeout_seconds" in params
+        except (TypeError, ValueError):    # C-level signature: assume new
+            _BOUNDED_SHUTDOWN = True
+    return _BOUNDED_SHUTDOWN
+
 
 class ElasticJaxMesh:
     """Generation-addressed ``jax.distributed`` membership with rejoin.
@@ -94,24 +118,53 @@ class ElasticJaxMesh:
     def _teardown(self, final: bool = False) -> None:
         import jax
         import jax.extend as jex
-        try:
-            jax.distributed.shutdown()
-        except Exception as e:  # noqa: BLE001 — half-dead service
-            log_warning("elastic: shutdown of generation %d raised (%s) — "
-                        "proceeding", self.generation, e)
-            # clear the half-shut state so exit hooks / the re-init
-            # don't trip over a client the failed shutdown left behind.
-            # jax._src is private and moves across JAX releases: degrade
-            # to a warning rather than masking the real failure above
+
+        def clear_state() -> None:
+            # clear the client/service references so exit hooks / the
+            # re-init don't trip over what a skipped or failed shutdown
+            # left behind.  The old handles are stashed IMMORTAL, never
+            # released: the client's C++ destructor issues a Disconnect,
+            # which blocks on the shutdown barrier (dead peers never
+            # arrive) and then LOG(FATAL)s the whole process — observed
+            # live ~90s after dropping the last reference.  An extra
+            # uncounted incref keeps the destructor from running even at
+            # interpreter teardown.  jax._src is private and moves across
+            # JAX releases: degrade to a warning rather than masking the
+            # real failure above
             try:
+                import ctypes
+
                 from jax._src import distributed as _dist
                 state = getattr(_dist, "global_state", None)
                 for attr in ("preemption_sync_manager", "client", "service"):
-                    if state is not None and hasattr(state, attr):
+                    obj = getattr(state, attr, None) if state else None
+                    if obj is not None:
+                        ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
+                        _ZOMBIE_HANDLES.append(obj)
                         setattr(state, attr, None)
             except Exception as e2:  # noqa: BLE001 — private-API drift
                 log_warning("elastic: could not clear jax distributed "
                             "state (%s) — private API moved?", e2)
+
+        if not _bounded_shutdown_supported():
+            # this jax cannot bound the shutdown barrier: with a dead
+            # peer in the cohort, shutdown() blocks on the barrier for
+            # its full default budget and then LOG(FATAL)s the whole
+            # process from C++ (client.h "Terminating process…").
+            # Dropping the client references is the only survivable
+            # teardown — the old generation's service dies with its
+            # process or is garbage-collected with its last reference.
+            log_warning("elastic: this jax has no bounded shutdown "
+                        "barrier — dropping generation-%d client without "
+                        "the barrier", self.generation)
+            clear_state()
+        else:
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # noqa: BLE001 — half-dead service
+                log_warning("elastic: shutdown of generation %d raised "
+                            "(%s) — proceeding", self.generation, e)
+                clear_state()
         if not final:
             # the old backend holds client handles into the dead
             # coordination service; initialize() refuses to run while any
@@ -184,14 +237,20 @@ class ElasticJaxMesh:
         # enough that the gen-g+1 rendezvous misses ITS window.  The next
         # generation is a fresh service on a fresh port; nothing of the
         # old one is worth waiting minutes for.
+        kw = {}
+        if _bounded_shutdown_supported():
+            kw = dict(
+                heartbeat_timeout_seconds=int(
+                    os.environ.get("DMLC_ELASTIC_HEARTBEAT_S", "10")),
+                shutdown_timeout_seconds=int(
+                    os.environ.get("DMLC_ELASTIC_SHUTDOWN_S", "10")))
+        # a jax that predates the budget kwargs still rebuilds the mesh;
+        # its dead-peer detection is just slower and its teardown goes
+        # through the barrier-less path in _teardown
         jax.distributed.initialize(
             coordinator_address=self._coordinator(gen),
             num_processes=self.num_processes,
-            process_id=self.process_id,
-            heartbeat_timeout_seconds=int(
-                os.environ.get("DMLC_ELASTIC_HEARTBEAT_S", "10")),
-            shutdown_timeout_seconds=int(
-                os.environ.get("DMLC_ELASTIC_SHUTDOWN_S", "10")))
+            process_id=self.process_id, **kw)
         self.generation = gen
         self._dirty = False
 

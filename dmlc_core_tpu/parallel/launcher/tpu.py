@@ -56,6 +56,13 @@ def initialize_jax_from_env() -> None:
     addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if not addr:
         return  # single-process
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # CPU-backend cross-process collectives need the gloo transport;
+        # jaxes that pick it automatically no longer expose the knob
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — option absent: automatic
+            pass
     jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=get_env("JAX_NUM_PROCESSES", 1),

@@ -106,7 +106,8 @@ class RabitContext:
     def __init__(self, tracker_uri: str, tracker_port: int,
                  jobid: Optional[str] = None, recover: bool = False,
                  connect_timeout: float = 60.0, connect_links: bool = True,
-                 recover_timeout: float = 120.0):
+                 recover_timeout: float = 120.0,
+                 heartbeat_interval: Optional[float] = None):
         self.tracker_addr = (tracker_uri, tracker_port)
         self.jobid = jobid or f"job-{os.getpid()}-{socket.gethostname()}"
         self.connect_timeout = connect_timeout
@@ -115,9 +116,19 @@ class RabitContext:
         # detected via the tracker reset's shutdown(SHUT_RDWR), but if the
         # tracker itself is gone a fully-unbounded recv hangs the collective
         # forever.  Sized well past recover_timeout so a slow-but-alive peer
-        # (an elastic-reborn rank redoing its epoch) is never misdiagnosed;
-        # DMLC_PEER_RECV_TIMEOUT tunes it, <= 0 restores unbounded recv
-        t = float(get_env("DMLC_PEER_RECV_TIMEOUT", 2.0 * recover_timeout))
+        # (an elastic-reborn rank redoes its epoch) is never misdiagnosed;
+        # DMLC_PEER_RECV_TIMEOUT tunes it, <= 0 restores unbounded recv.
+        # A malformed value falls back to the default — worker boot must
+        # not crash over an env typo.
+        try:
+            t = float(get_env("DMLC_PEER_RECV_TIMEOUT",
+                              2.0 * recover_timeout))
+        except (TypeError, ValueError):
+            log_warning("rabit: bad DMLC_PEER_RECV_TIMEOUT=%r; using "
+                        "default %.0fs",
+                        os.environ.get("DMLC_PEER_RECV_TIMEOUT"),
+                        2.0 * recover_timeout)
+            t = 2.0 * recover_timeout
         self.peer_recv_timeout: Optional[float] = None if t <= 0 else t
         # listener for peer links
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -127,6 +138,10 @@ class RabitContext:
         self._listen_port = self._listener.getsockname()[1]
         self._peer_socks: Dict[int, socket.socket] = {}
         self._sock_gen: Dict[int, int] = {}
+        # populated by _register's reply; must EXIST before the accept
+        # thread starts — a tracker reset_links push can race ahead of
+        # the registration reply and must not kill the accept loop
+        self._addresses: Dict[int, Tuple[str, int]] = {}
         self._peer_lock = threading.Lock()
         self._reset_event = threading.Event()
         self._target_gen = 0
@@ -136,6 +151,19 @@ class RabitContext:
         self._accepting = True
         self._accept_thread.start()
         self._register(recover)
+        # liveness beats to the tracker (cmd=heartbeat) feed its
+        # dead-worker monitor; a failed beat is the tracker's problem to
+        # notice, never this worker's reason to die.  0 disables.
+        if heartbeat_interval is None:
+            heartbeat_interval = get_env("DMLC_HEARTBEAT_INTERVAL", 5.0)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if self.heartbeat_interval > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, name="rabit-heartbeat",
+                daemon=True)
+            self._hb_thread.start()
         if connect_links:
             self._connect_links()
 
@@ -498,11 +526,23 @@ class RabitContext:
                 f"immediately after restore, before any allreduce")
         self._seq = int(seq)
 
+    def _heartbeat_loop(self) -> None:
+        from ..utils.metrics import metrics
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            try:
+                self._tracker_cmd({"cmd": "heartbeat", "jobid": self.jobid})
+            except OSError:
+                # tracker briefly unreachable — beats are best-effort
+                metrics.counter("rabit.heartbeat.failures").add(1)
+
     # -- misc rabit API --
     def tracker_print(self, msg: str) -> None:
         self._tracker_cmd({"cmd": "print", "msg": msg})
 
     def shutdown(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
         self._tracker_cmd({"cmd": "shutdown", "jobid": self.jobid})
         try:  # clean exit: the recovery checkpoint is no longer needed
             os.unlink(self._ckpt_path())

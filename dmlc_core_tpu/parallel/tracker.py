@@ -37,7 +37,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..utils import DMLCError, check, get_logger, log_info
+from ..utils import DMLCError, check, get_env, get_logger, log_info
+from ..utils.metrics import metrics
 
 __all__ = ["RabitTracker", "PSTracker", "compute_tree", "compute_ring",
            "recv_json", "send_json"]
@@ -112,9 +113,22 @@ class RabitTracker:
     """
 
     def __init__(self, num_workers: int, host_ip: Optional[str] = None,
-                 port: int = 0, max_port: int = 9999):
+                 port: int = 0, max_port: int = 9999,
+                 heartbeat_timeout_s: Optional[float] = None):
         self.num_workers = num_workers
         self.host_ip = host_ip or _default_host_ip()
+        # dead-worker detection: workers beat (cmd=heartbeat) and a monitor
+        # declares silence beyond the timeout a death — survivors get the
+        # same reset_links push a recover registration triggers, so they
+        # stop blocking on the corpse NOW instead of when (if) a launcher
+        # restarts it.  0 (the default) disables the monitor.
+        if heartbeat_timeout_s is None:
+            heartbeat_timeout_s = get_env("DMLC_HEARTBEAT_TIMEOUT", 0.0)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self._last_beat: Dict[str, float] = {}
+        self._dead: set = set()
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         bound = False
@@ -148,6 +162,11 @@ class RabitTracker:
     def start(self) -> None:
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
+        if self.heartbeat_timeout_s > 0:
+            self._monitor = threading.Thread(target=self._monitor_loop,
+                                             name="tracker-heartbeat",
+                                             daemon=True)
+            self._monitor.start()
         log_info("tracker started at %s:%d for %d workers",
                  self.host_ip, self.port, self.num_workers)
 
@@ -178,6 +197,7 @@ class RabitTracker:
 
     def stop(self) -> None:
         self._stop = True
+        self._monitor_stop.set()
         try:
             self._sock.close()
         except OSError:
@@ -205,7 +225,20 @@ class RabitTracker:
             elif cmd == "shutdown":
                 with self._lock:
                     self._shutdown_count += 1
+                    # a cleanly-exited worker stops beating by design —
+                    # it must not be declared dead afterwards
+                    self._last_beat.pop(str(msg.get("jobid", "")), None)
                     self._lock.notify_all()
+            elif cmd == "heartbeat":
+                jobid = str(msg.get("jobid", ""))
+                with self._lock:
+                    self._last_beat[jobid] = time.monotonic()
+                    if jobid in self._dead:
+                        # slow-but-alive: the monitor misdiagnosed it; the
+                        # next reset/recover round re-links it
+                        self._dead.discard(jobid)
+                        logger.warning("tracker: worker %r revived by "
+                                       "heartbeat", jobid)
             elif cmd in ("start", "recover"):
                 self._register_and_reply(conn, msg, recovering=(cmd == "recover"))
             else:
@@ -231,6 +264,8 @@ class RabitTracker:
         with self._lock:
             if self._start_time is None:
                 self._start_time = time.monotonic()
+            self._last_beat[jobid] = time.monotonic()
+            self._dead.discard(jobid)
             rec = self._workers.get(jobid)
             if rec is None:
                 rec = _WorkerRecord(jobid, host, port)
@@ -276,6 +311,45 @@ class RabitTracker:
         for host_port in notify:
             self._notify_reset(host_port, reset)
         send_json(conn, reply)
+
+    def _monitor_loop(self) -> None:
+        """Sweep heartbeats; a worker silent past the timeout is declared
+        dead ONCE (until it beats or re-registers): bump the link
+        generation and push reset_links to the survivors — the same repair
+        a recover registration drives, just initiated by the tracker."""
+        interval = max(0.1, self.heartbeat_timeout_s / 4.0)
+        while not self._monitor_stop.wait(interval):
+            notify: List[Tuple[str, int]] = []
+            reset: Optional[dict] = None
+            now = time.monotonic()
+            with self._lock:
+                if not self._assigned:
+                    continue
+                newly_dead = [
+                    j for j, t in self._last_beat.items()
+                    if j not in self._dead
+                    and now - t > self.heartbeat_timeout_s
+                    and j in self._workers and self._workers[j].rank >= 0
+                    and self._shutdown_count < self.num_workers]
+                if not newly_dead:
+                    continue
+                for j in newly_dead:
+                    self._dead.add(j)
+                    metrics.counter("tracker.dead_workers").add(1)
+                    logger.warning(
+                        "tracker: worker %r (rank %d) missed heartbeats "
+                        "for %.1fs — declaring dead", j,
+                        self._workers[j].rank, now - self._last_beat[j])
+                self._generation += 1
+                notify = [(w.host, w.port) for w in self._workers.values()
+                          if w.jobid not in self._dead and w.rank >= 0]
+                reset = {"cmd": "reset_links",
+                         "generation": self._generation,
+                         "addresses": {str(w.rank): [w.host, w.port]
+                                       for w in self._workers.values()
+                                       if w.rank >= 0}}
+            for host_port in notify:
+                self._notify_reset(host_port, reset)
 
     def _notify_reset(self, addr: Tuple[str, int], reset: dict) -> None:
         """Push a link-reset control message to a survivor's peer listener

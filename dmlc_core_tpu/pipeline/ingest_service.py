@@ -39,7 +39,11 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils import ThreadedIter, check
-from ..utils.logging import DMLCError, log_info
+from ..utils.faults import fault_point
+from ..utils.logging import DMLCError, log_info, log_warning
+from ..utils.metrics import metrics
+from ..utils.parameter import get_env
+from ..utils.retry import RetryPolicy
 from .device_loader import _BufPool, _fused_words_meta, _put_fused_buf
 
 __all__ = ["serve_ingest", "RemoteIngestLoader", "ingest_worker_main"]
@@ -113,6 +117,10 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                 for item in loader:
                     kind, buf, meta, rows = item
                     check(kind == "fused", "host emit must be fused")
+                    # chaos probe: an injected error here kills THIS
+                    # connection mid-epoch (the trainer-side reader sees a
+                    # truncated stream and restarts), the listener lives on
+                    fault_point("ingest.send")
                     # exact fused size, NOT len(buf): recycled pool buffers
                     # are over-sized and their dead tail must not ride the
                     # very link this feature exists to relieve
@@ -182,48 +190,83 @@ class RemoteIngestLoader:
                  "err": None, "stop": False, "socks": []}
         cap = max(self._depth, len(self.addresses))
 
-        def read_one(addr):
-            try:
-                sock = socket.create_connection(
-                    addr, timeout=self.connect_timeout)
-                sock.settimeout(self.connect_timeout)
-                with cv:
-                    if state["stop"]:
-                        sock.close()
-                        return
-                    state["socks"].append(sock)
-                with sock:
-                    while True:
-                        hdr = _recv_exact(sock, _FRAME.size)
-                        if hdr is None:
+        def stream_epoch(addr):
+            """One connection → one epoch pass; raises on a broken stream.
+            Returns normally on the worker's EOS (or a stop request)."""
+            with cv:
+                if state["stop"]:
+                    return
+            sock = socket.create_connection(
+                addr, timeout=self.connect_timeout)
+            sock.settimeout(self.connect_timeout)
+            with cv:
+                if state["stop"]:
+                    sock.close()
+                    return
+                state["socks"].append(sock)
+            with sock:
+                while True:
+                    # chaos probe: injected errors/latency land exactly
+                    # where a flaky network would — per received frame
+                    fault_point("ingest.recv")
+                    hdr = _recv_exact(sock, _FRAME.size)
+                    if hdr is None:
+                        raise DMLCError(
+                            f"ingest worker {addr} closed mid-stream")
+                    meta, words, rows = _FRAME.unpack(hdr)
+                    if words == 0:
+                        return                     # worker's EOS
+                    buf = self._pool.get(words)
+                    view = memoryview(buf)[:words].cast("B")
+                    got = 0
+                    while got < len(view):
+                        r = sock.recv_into(view[got:], len(view) - got)
+                        if not r:
                             raise DMLCError(
-                                f"ingest worker {addr} closed mid-stream")
-                        meta, words, rows = _FRAME.unpack(hdr)
-                        if words == 0:
-                            return                     # worker's EOS
-                        buf = self._pool.get(words)
-                        view = memoryview(buf)[:words].cast("B")
-                        got = 0
-                        while got < len(view):
-                            r = sock.recv_into(view[got:], len(view) - got)
-                            if not r:
-                                raise DMLCError(
-                                    f"ingest worker {addr} died mid-frame")
-                            got += r
-                        with cv:
-                            # backpressure: the pool is bounded, the frame
-                            # list must be too — otherwise a slow consumer
-                            # buffers the whole epoch in trainer RSS
-                            while (len(state["out"]) >= cap
-                                   and not state["stop"]):
-                                cv.wait(timeout=1.0)
-                            if state["stop"]:
-                                return
-                            state["out"].append(
-                                (buf[:words] if len(buf) != words else buf,
-                                 meta,
-                                 None if rows == _NO_ROWS else rows, buf))
-                            cv.notify_all()
+                                f"ingest worker {addr} died mid-frame")
+                        got += r
+                    with cv:
+                        # backpressure: the pool is bounded, the frame
+                        # list must be too — otherwise a slow consumer
+                        # buffers the whole epoch in trainer RSS
+                        while (len(state["out"]) >= cap
+                               and not state["stop"]):
+                            cv.wait(timeout=1.0)
+                        if state["stop"]:
+                            return
+                        state["out"].append(
+                            (buf[:words] if len(buf) != words else buf,
+                             meta,
+                             None if rows == _NO_ROWS else rows, buf))
+                        cv.notify_all()
+
+        def read_one(addr):
+            # a mid-epoch death restarts ONLY this worker's stream: the
+            # reconnected worker re-serves its partition from the top, so
+            # frames it already delivered may arrive again — acceptable
+            # under the module's relaxed-ordering data-parallel contract
+            # (ShuffleInputSplit parity), and the price of not failing the
+            # whole epoch for one flaky link.  DMLC_INGEST_READER_RETRIES=0
+            # restores fail-fast.
+            restarts = max(0, int(get_env("DMLC_INGEST_READER_RETRIES", 2)))
+
+            def on_retry(attempt, exc):
+                metrics.counter("ingest.reader.restarts").add(1)
+                log_warning("ingest reader %s:%d restarting after %r "
+                            "(attempt %d)", addr[0], addr[1], exc, attempt)
+
+            policy = RetryPolicy(
+                max_attempts=1 + restarts,
+                base_delay_s=get_env("DMLC_INGEST_READER_BACKOFF", 0.05),
+                max_delay_s=1.0,
+                # a close()-induced socket error is not a worker death:
+                # reconnecting then would burn one of the worker's
+                # remaining epochs on a stream nobody reads
+                retryable=lambda e: (isinstance(e, (OSError, DMLCError))
+                                     and not state["stop"]),
+                name="ingest.reader")
+            try:
+                policy.call(stream_epoch, addr, on_retry=on_retry)
             except Exception as e:                      # noqa: BLE001
                 with cv:
                     if not state["stop"]:

@@ -20,18 +20,23 @@ the stack exclusively through it.
 
 from __future__ import annotations
 
+import socket
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..utils.logging import DMLCError
-from ..utils.metrics import Histogram
+from ..utils.metrics import Histogram, metrics
+from ..utils.parameter import get_env
+from ..utils.retry import (CircuitBreaker, Deadline, DeadlineExpired,
+                           RetriesExhausted, RetryPolicy)
 from .server import (REQ_HEADER, RSP_HEADER, STATUS_DEADLINE,
                      STATUS_NAMES, STATUS_OK, STATUS_OVERLOADED,
-                     _recv_exact)
+                     STATUS_SHUTDOWN, _recv_exact)
 
 __all__ = ["PredictClient", "ServerOverloaded", "ServerRejected",
            "run_load"]
@@ -47,59 +52,176 @@ class ServerRejected(DMLCError):
 
 
 class PredictClient:
-    """One pipelined connection to a :class:`PredictionServer`."""
+    """One pipelined connection to a :class:`PredictionServer`.
+
+    Resilience contract:
+
+    * :meth:`predict` retries :class:`ServerOverloaded` under the
+      ``DMLC_SERVING_RETRIES``/``_BACKOFF_*`` budget, all attempts inside
+      the single ``timeout`` the caller passed.  A timed-out request is
+      **abandoned** — removed from the pending map and its future failed —
+      so pipelined state can't leak.
+    * A lost connection triggers reconnect-and-resubmit: predictions are
+      pure, so re-sending every in-flight frame on the new connection is
+      idempotent (at worst a score is computed twice; the late duplicate
+      response is discarded).  Reconnects follow the
+      ``DMLC_SERVING_RECONNECT_*`` schedule behind a circuit breaker so a
+      dead server gets probes, not a connect storm; when the budget is
+      exhausted the in-flight futures fail with the transport error.
+      ``DMLC_SERVING_RECONNECT=0`` restores fail-fast.
+    * :meth:`submit` stays raw — one frame, no retries — because pipelined
+      callers (the load generator) want to SEE every shed.
+
+    Counters: ``retry.serving.client.*`` (overload retries),
+    ``serving.client.reconnects``, ``circuit.serving.reconnect.*``.
+    """
 
     def __init__(self, host: str, port: int,
-                 connect_timeout: float = 30.0) -> None:
-        import socket
-        self._sock = socket.create_connection((host, port),
-                                              timeout=connect_timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(None)
+                 connect_timeout: float = 30.0, *,
+                 reconnect: Optional[bool] = None) -> None:
+        self._host = host
+        self._port = int(port)
+        self._connect_timeout = connect_timeout
+        if reconnect is None:
+            reconnect = get_env("DMLC_SERVING_RECONNECT", True)
+        self._reconnect_enabled = bool(reconnect)
+        self._overload_retry = RetryPolicy.from_env(
+            "DMLC_SERVING", name="serving.client",
+            retryable=lambda e: isinstance(e, ServerOverloaded))
+        self._conn_retry = RetryPolicy(
+            max_attempts=get_env("DMLC_SERVING_RECONNECT_RETRIES", 8),
+            base_delay_s=get_env("DMLC_SERVING_RECONNECT_BACKOFF", 0.1),
+            max_delay_s=2.0,
+            retryable=lambda e: isinstance(e, OSError),
+            name="serving.reconnect")
+        self._breaker = CircuitBreaker.from_env("DMLC_SERVING",
+                                                name="serving.reconnect")
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
-        self._pending: Dict[int, Future] = {}
+        # req_id → (future, wire frame); the frame is kept so a reconnect
+        # can replay every in-flight request verbatim
+        self._pending: Dict[int, Tuple[Future, bytes]] = {}
         self._next_id = 0
         self._closed = False
-        self._reader = threading.Thread(target=self._read_loop,
-                                        name="serving-client-reader",
-                                        daemon=True)
+        self._gen = 0              # bumps on every (re)connection
+        self._sock = self._dial()
+        self._start_reader(self._gen)
+
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=self._connect_timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        return sock
+
+    def _start_reader(self, gen: int) -> None:
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(self._sock, gen),
+            name="serving-client-reader", daemon=True)
         self._reader.start()
 
     # -- receive side ----------------------------------------------------
-    def _read_loop(self) -> None:
+    @staticmethod
+    def _resolve(fut: Future, result=None, exc=None) -> None:
+        # a racing abandon() may have settled the future already — the
+        # response for an abandoned request is simply dropped
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        except Exception:  # noqa: BLE001 — InvalidStateError
+            pass
+
+    def _read_loop(self, sock: socket.socket, gen: int) -> None:
         try:
             while True:
-                head = _recv_exact(self._sock, RSP_HEADER.size)
+                head = _recv_exact(sock, RSP_HEADER.size)
                 if head is None:
                     raise DMLCError("server closed the connection")
                 req_id, status, n = RSP_HEADER.unpack(head)
-                payload = _recv_exact(self._sock, 4 * n if status ==
+                payload = _recv_exact(sock, 4 * n if status ==
                                       STATUS_OK else n)
                 if payload is None:
                     raise DMLCError("server died mid-response")
+                if status == STATUS_SHUTDOWN and self._reconnect_enabled:
+                    # a draining/restarting replica answers SHUTDOWN for
+                    # requests it will never serve; leave them in
+                    # _pending and reconnect — the replay lands them on
+                    # the replacement replica
+                    raise DMLCError(
+                        "server shutting down: "
+                        + payload.decode("utf-8", "replace"))
                 with self._plock:
-                    fut = self._pending.pop(req_id, None)
-                if fut is None:
-                    continue           # response to a cancelled request
+                    entry = self._pending.pop(req_id, None)
+                if entry is None:
+                    continue           # response to an abandoned request
+                fut = entry[0]
                 if status == STATUS_OK:
-                    fut.set_result(np.frombuffer(payload, np.float32))
+                    self._resolve(fut,
+                                  result=np.frombuffer(payload, np.float32))
                 else:
                     msg = payload.decode("utf-8", "replace")
                     name = STATUS_NAMES.get(status, str(status))
                     exc = (ServerOverloaded if status in
                            (STATUS_OVERLOADED, STATUS_DEADLINE)
                            else ServerRejected)
-                    fut.set_exception(exc(f"{name}: {msg}"))
+                    self._resolve(fut, exc=exc(f"{name}: {msg}"))
         except (OSError, DMLCError) as e:
-            with self._plock:
-                pending, self._pending = self._pending, {}
-                closed = self._closed
-            err = DMLCError("connection closed" if closed
-                            else f"serving connection lost: {e}")
-            for fut in pending.values():
-                if not fut.done():
-                    fut.set_exception(err)
+            self._on_conn_lost(gen, e)
+
+    # -- reconnect -------------------------------------------------------
+    def _on_conn_lost(self, gen: int, exc: BaseException) -> None:
+        with self._plock:
+            if self._closed or gen != self._gen:
+                return                 # deliberate close() / stale reader
+            self._gen += 1             # this thread owns the reconnect
+            new_gen = self._gen
+        if self._reconnect_enabled:
+            try:
+                self._reestablish(new_gen)
+                return
+            except Exception as e:  # noqa: BLE001 — budget exhausted
+                exc = e
+        self._fail_all_pending(
+            DMLCError(f"serving connection lost: {exc}"))
+
+    def _reestablish(self, gen: int) -> None:
+        """Dial a fresh connection and replay every in-flight frame."""
+
+        def dial_once() -> socket.socket:
+            self._breaker.allow()
+            try:
+                s = self._dial()
+            except BaseException:
+                self._breaker.record_failure()
+                raise
+            self._breaker.record_success()
+            return s
+
+        sock = self._conn_retry.call(dial_once)
+        with self._plock:
+            if self._closed:
+                sock.close()
+                raise DMLCError("client closed during reconnect")
+            self._sock = sock
+            frames = [frame for (_fut, frame) in self._pending.values()]
+        metrics.counter("serving.client.reconnects").add(1)
+        self._start_reader(gen)
+        try:
+            with self._wlock:
+                for frame in frames:
+                    sock.sendall(frame)
+        except OSError:
+            # the connection died again mid-replay; the reader we just
+            # started owns the next round — don't double-handle it here
+            pass
+
+    def _fail_all_pending(self, err: DMLCError) -> None:
+        with self._plock:
+            pending, self._pending = self._pending, {}
+        for fut, _frame in pending.values():
+            self._resolve(fut, exc=err)
 
     # -- send side -------------------------------------------------------
     def submit(self, ids: np.ndarray, vals: np.ndarray,
@@ -112,32 +234,66 @@ class PredictClient:
         row_ptr = np.ascontiguousarray(row_ptr, np.int32)
         rows, nnz = len(row_ptr) - 1, len(ids)
         fut: Future = Future()
+        frame_tail = row_ptr.tobytes() + ids.tobytes() + vals.tobytes()
         with self._plock:
             if self._closed:
                 fut.set_exception(DMLCError("client closed"))
                 return fut
             req_id = self._next_id
             self._next_id += 1
-            self._pending[req_id] = fut
-        frame = (REQ_HEADER.pack(req_id, rows, nnz) + row_ptr.tobytes()
-                 + ids.tobytes() + vals.tobytes())
+            frame = REQ_HEADER.pack(req_id, rows, nnz) + frame_tail
+            fut._dmlc_req_id = req_id          # predict()'s abandon handle
+            self._pending[req_id] = (fut, frame)
+            sock = self._sock
         try:
             with self._wlock:
-                self._sock.sendall(frame)
+                sock.sendall(frame)
         except OSError as e:
-            with self._plock:
-                self._pending.pop(req_id, None)
-            fut.set_exception(DMLCError(f"send failed: {e}"))
+            # registration happened BEFORE this send, so whichever
+            # reconnect the reader drives will replay the frame; only a
+            # fail-fast client settles the future here
+            if not self._reconnect_enabled:
+                with self._plock:
+                    self._pending.pop(req_id, None)
+                self._resolve(fut, exc=DMLCError(f"send failed: {e}"))
         return fut
+
+    def _abandon(self, fut: Future) -> None:
+        """Give up on an in-flight request: unhook it so a late response
+        is discarded, and settle the future so nothing leaks."""
+        req_id = getattr(fut, "_dmlc_req_id", None)
+        if req_id is None:
+            return
+        with self._plock:
+            self._pending.pop(req_id, None)
+        self._resolve(fut, exc=DMLCError("request abandoned on timeout"))
 
     def predict(self, ids: np.ndarray, vals: np.ndarray,
                 row_ptr: Optional[np.ndarray] = None,
                 timeout: float = 30.0) -> np.ndarray:
-        """Blocking single request → scores ``[rows]``."""
-        return self.submit(ids, vals, row_ptr).result(timeout=timeout)
+        """Blocking single request → scores ``[rows]``.
+
+        ``timeout`` is the TOTAL budget: overload retries, reconnect waits
+        and the final wait all draw from it."""
+        dl = Deadline(timeout)
+
+        def once() -> np.ndarray:
+            fut = self.submit(ids, vals, row_ptr)
+            try:
+                wait = None if timeout is None else dl.clamp(timeout)
+                return fut.result(timeout=wait)
+            except FutureTimeout:
+                self._abandon(fut)
+                raise
+        try:
+            return self._overload_retry.call(once, deadline=dl)
+        except (RetriesExhausted, DeadlineExpired) as e:
+            cause = e.__cause__
+            if isinstance(cause, ServerOverloaded):
+                raise cause            # contract: overload stays typed
+            raise
 
     def close(self) -> None:
-        import socket
         with self._plock:
             self._closed = True
         try:
@@ -149,6 +305,7 @@ class PredictClient:
         except OSError:
             pass
         self._reader.join(timeout=5.0)
+        self._fail_all_pending(DMLCError("connection closed"))
 
     def __enter__(self):
         return self

@@ -37,8 +37,10 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..utils.faults import FaultInjected, fault_point
 from ..utils.logging import DMLCError, log_info, log_warning
 from ..utils.metrics import metrics
+from ..utils.parameter import get_env
 from .batcher import DeadlineExceeded, MicroBatcher, Overloaded, Shutdown
 from .engine import InferenceEngine, RequestTooLarge
 
@@ -116,6 +118,11 @@ class PredictionServer:
         self._watcher: Optional[threading.Thread] = None
         self._watch_stop = threading.Event()
         self._m_conns = metrics.gauge("serving.server.connections")
+        # queue-depth fraction above which health degrades before the hard
+        # admission limit kicks in — load balancers drain "degraded"
+        # replicas early instead of discovering "overloaded" via sheds
+        self._degraded_ratio = float(
+            get_env("DMLC_SERVING_DEGRADED_RATIO", 0.75))
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "PredictionServer":
@@ -132,6 +139,16 @@ class PredictionServer:
         requests get their answers), then drop connections."""
         self._stopping = True
         self._watch_stop.set()
+        # shutdown() before close(): the accept thread blocked inside
+        # accept() holds a kernel reference to the listening socket, so a
+        # bare close() leaves the port ACCEPTING — a reconnecting client
+        # would land on this half-dead server and get SHUTDOWN answers
+        # instead of a refused dial it can retry against the restarted
+        # replica
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
@@ -159,6 +176,26 @@ class PredictionServer:
 
     def __exit__(self, *exc):
         self.stop()
+
+    # -- health ----------------------------------------------------------
+    @property
+    def health(self) -> str:
+        """``ok`` | ``degraded`` | ``overloaded`` from batcher queue depth.
+
+        ``degraded`` starts at ``DMLC_SERVING_DEGRADED_RATIO`` (default
+        0.75) of ``max_queue``; ``overloaded`` means the admission limit is
+        reached and new submits are being shed.  Also exported as the gauge
+        ``serving.server.health`` (0 ok / 1 degraded / 2 overloaded)."""
+        depth = self.batcher.queue_depth
+        cap = max(1, self.batcher.max_queue)
+        if depth >= cap:
+            state, level = "overloaded", 2
+        elif depth >= self._degraded_ratio * cap:
+            state, level = "degraded", 1
+        else:
+            state, level = "ok", 0
+        metrics.gauge("serving.server.health").set(level)
+        return state
 
     # -- hot reload ------------------------------------------------------
     def reload_from_checkpoint(self, directory: str,
@@ -205,6 +242,12 @@ class PredictionServer:
                 conn, addr = self._srv.accept()
             except OSError:
                 return
+            if self._stopping:         # raced the listener teardown
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conn_lock:
                 cid = self._next_conn
@@ -244,7 +287,10 @@ class PredictionServer:
                                               dtype=np.float32)
                 respond(req_id, STATUS_OK, scores.tobytes())
             else:
-                respond(req_id, _status_of(exc),
+                status = _status_of(exc)
+                if status == STATUS_OVERLOADED:
+                    metrics.counter("serving.server.shed").add(1)
+                respond(req_id, status,
                         str(exc).encode("utf-8", "replace"))
 
         try:
@@ -265,6 +311,15 @@ class PredictionServer:
                                     4 * (rows + 1))
                 vals = np.frombuffer(payload, np.float32, nnz,
                                      4 * (rows + 1) + 4 * nnz)
+                try:
+                    # chaos harness hook: an injected error here sheds the
+                    # request exactly as real admission control would —
+                    # a deterministic OVERLOADED burst for client tests
+                    fault_point("serving.server.admit")
+                except FaultInjected as e:
+                    metrics.counter("serving.server.shed").add(1)
+                    respond(req_id, STATUS_OVERLOADED, str(e).encode())
+                    continue
                 fut = self.batcher.submit(ids, vals,
                                           row_ptr.astype(np.int64))
                 fut.add_done_callback(

@@ -26,6 +26,14 @@ from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, ThroughputMeter, StageTimer, MetricsRegistry,
     metrics, trace_span, profile_trace,
 )
+from .retry import (  # noqa: F401
+    Deadline, DeadlineExpired, RetryPolicy, RetriesExhausted,
+    CircuitBreaker, CircuitOpen,
+)
+from .faults import (  # noqa: F401
+    FaultInjected, FaultSpecError, fault_point, install_faults,
+    clear_faults, inject_faults,
+)
 from .json import (  # noqa: F401
     JSONReader, JSONWriter, JSONObjectReadHelper, AnyValue,
     register_any_type, read_any, json_dumps, json_loads,

@@ -1,0 +1,40 @@
+"""JAX version compatibility shims.
+
+The package targets jax >= 0.8 (top-level ``jax.shard_map``, ``check_vma``,
+``jax.lax.pcast``); clusters routinely pin older runtimes.  Rather than
+refusing to import — which takes the whole control plane (tracker, rabit,
+launchers) down with the data-plane modules that actually need the new
+APIs — the shims translate where a faithful translation exists and let
+call sites degrade per-feature.
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map", "axis_size"]
+
+try:                                    # jax >= 0.6 exports it top-level
+    from jax import shard_map as _shard_map
+    _KWARG = "check_vma"
+except ImportError:                     # older jax: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _KWARG = "check_rep"
+
+
+def shard_map(f=None, **kw):
+    """``jax.shard_map`` with the replication-check kwarg renamed to
+    whatever this jax spells it (``check_vma`` grew out of ``check_rep``;
+    same semantics for our always-False usage)."""
+    if "check_vma" in kw and _KWARG != "check_vma":
+        kw[_KWARG] = kw.pop("check_vma")
+    if f is None:
+        return lambda g: _shard_map(g, **kw)
+    return _shard_map(f, **kw)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` where it exists; on older jax fall back to
+    ``psum(1, axis)`` — same value, computed collectively."""
+    import jax
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

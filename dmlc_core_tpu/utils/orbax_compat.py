@@ -30,6 +30,13 @@ def save_orbax(path: str, tree: Any, *, force: bool = True) -> None:
     local directory ``path``.  For URI-addressed / versioned checkpoints
     use :class:`CheckpointManager`; this is the ecosystem-interop escape
     hatch."""
+    import jax
+    import numpy as np
+    # older orbax StandardCheckpointers reject numpy scalar leaves
+    # (np.int64 et al.); the equivalent 0-d ndarray is accepted by every
+    # version and restores to the same value
+    tree = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x, tree)
     ckpt = _checkpointer()
     ckpt.save(os.path.abspath(path), tree, force=force)
     # StandardCheckpointer saves asynchronously; the contract here is

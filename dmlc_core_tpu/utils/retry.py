@@ -1,0 +1,287 @@
+"""Unified resilience primitives: retry/backoff, circuit breaker, deadlines.
+
+The reference survived real clusters because every layer had its own
+failure story — S3 streams restart on seek (`s3_filesys.cc:234-239`), the
+tracker rebuilds topologies when workers die (`tracker.py:279-291`) — but
+each story was hand-rolled in place.  This module is the one shared
+implementation the whole repo retries through, so policy (how many
+attempts, how long, when to give up) is tunable in one vocabulary and
+every retry/open/shed shows up in ``utils.metrics``:
+
+* :class:`Deadline` — a wall-clock budget threaded through nested calls;
+  ``remaining()`` caps every sleep and socket timeout below it, so a
+  retry loop can never overshoot its caller's patience.
+* :class:`RetryPolicy` — bounded attempts with exponential backoff and
+  **full jitter** (delay ~ U[0, min(cap, base·2^attempt)]), an optional
+  retryable-exception predicate, and a per-call deadline budget.  The
+  jitter RNG is seedable so replayed failure schedules are deterministic
+  under test (the same property ``utils.faults`` relies on).
+* :class:`CircuitBreaker` — closed → open after N consecutive failures,
+  half-open probe after a cooldown, re-close on success.  Guards
+  reconnect storms: when a dependency is down, failing fast beats
+  hammering it with the full retry schedule per caller.
+
+Env knobs (read by :meth:`RetryPolicy.from_env` /
+:meth:`CircuitBreaker.from_env`; each subsystem passes its own prefix):
+
+==============================  =============================================
+``<PREFIX>_RETRIES``            attempt cap (total tries, not re-tries)
+``<PREFIX>_BACKOFF_BASE``       first-retry backoff ceiling, seconds
+``<PREFIX>_BACKOFF_MAX``        per-sleep backoff cap, seconds
+``<PREFIX>_DEADLINE``           per-call budget, seconds (0 = unbounded)
+``<PREFIX>_BREAKER_THRESHOLD``  consecutive failures before the circuit opens
+``<PREFIX>_BREAKER_COOLDOWN``   seconds open before a half-open probe
+==============================  =============================================
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from .logging import DMLCError, log_warning
+from .metrics import metrics
+
+__all__ = [
+    "Deadline", "DeadlineExpired", "RetryPolicy", "RetriesExhausted",
+    "CircuitBreaker", "CircuitOpen",
+]
+
+
+class DeadlineExpired(DMLCError):
+    """The per-call time budget ran out before the operation succeeded."""
+
+
+class RetriesExhausted(DMLCError):
+    """The attempt cap was reached; the last cause is chained as
+    ``__cause__``."""
+
+
+class CircuitOpen(DMLCError):
+    """The breaker is open — the dependency is presumed down; fail fast
+    instead of burning a retry schedule against it."""
+
+
+class Deadline:
+    """Wall-clock budget, created once and threaded through nested calls.
+
+    ``Deadline(None)`` (or budget ≤ 0 via :meth:`from_env`) is unbounded:
+    ``remaining()`` is ``inf`` and ``expired()`` never fires — callers can
+    clamp against it unconditionally.
+    """
+
+    __slots__ = ("_t_end", "_clock")
+
+    def __init__(self, budget_s: Optional[float],
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._t_end = None if budget_s is None else clock() + float(budget_s)
+
+    @classmethod
+    def unbounded(cls) -> "Deadline":
+        return cls(None)
+
+    def remaining(self) -> float:
+        if self._t_end is None:
+            return math.inf
+        return self._t_end - self._clock()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def clamp(self, timeout_s: float) -> float:
+        """Bound a sleep/socket timeout by what's left of the budget."""
+        return max(0.0, min(float(timeout_s), self.remaining()))
+
+    def check(self, what: str = "operation") -> None:
+        if self.expired():
+            raise DeadlineExpired(f"{what}: deadline budget exhausted")
+
+
+def _default_retryable(exc: BaseException) -> bool:
+    return isinstance(exc, (OSError, ConnectionError))
+
+
+class RetryPolicy:
+    """Bounded retries with exponential backoff and full jitter.
+
+    ``max_attempts`` counts total tries (1 = no retries).  ``retryable``
+    decides which exceptions earn another attempt (default: ``OSError``
+    family — the transient-network shape).  ``deadline_s`` bounds the
+    whole :meth:`call`, sleeps included; a deadline passed explicitly to
+    :meth:`call` takes precedence (it is the caller's budget, shared with
+    whatever else the caller does).
+
+    Every retry bumps ``retry.<name>.retries``; giving up bumps
+    ``retry.<name>.exhausted`` — visible in any metrics snapshot next to
+    the subsystem's own counters.
+    """
+
+    def __init__(self, max_attempts: int = 4, base_delay_s: float = 0.05,
+                 max_delay_s: float = 2.0,
+                 deadline_s: Optional[float] = None,
+                 retryable: Optional[Callable[[BaseException], bool]] = None,
+                 seed: Optional[int] = None, name: str = "default",
+                 sleep: Callable[[float], None] = time.sleep) -> None:
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.deadline_s = deadline_s
+        self.name = name
+        self._retryable = retryable or _default_retryable
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+
+    @classmethod
+    def from_env(cls, prefix: str, *, name: str = "", **kw) -> "RetryPolicy":
+        from .parameter import get_env
+        kw.setdefault("max_attempts", get_env(f"{prefix}_RETRIES", 4))
+        kw.setdefault("base_delay_s", get_env(f"{prefix}_BACKOFF_BASE", 0.05))
+        kw.setdefault("max_delay_s", get_env(f"{prefix}_BACKOFF_MAX", 2.0))
+        dl = get_env(f"{prefix}_DEADLINE", 0.0)
+        kw.setdefault("deadline_s", dl if dl > 0 else None)
+        return cls(name=name or prefix.lower(), **kw)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Full-jitter delay before try ``attempt + 1`` (attempt is
+        1-based: the try that just failed)."""
+        cap = min(self.max_delay_s,
+                  self.base_delay_s * (2.0 ** max(0, attempt - 1)))
+        return self._rng.uniform(0.0, cap)
+
+    def call(self, fn: Callable[..., Any], *args: Any,
+             deadline: Optional[Deadline] = None,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             **kw: Any) -> Any:
+        """Run ``fn`` under this policy; returns its result or raises the
+        last error (:class:`DeadlineExpired` / :class:`RetriesExhausted`
+        wrap it so callers can distinguish budget kinds)."""
+        dl = deadline or Deadline(self.deadline_s)
+        m_retry = metrics.counter(f"retry.{self.name}.retries")
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return fn(*args, **kw)
+            except BaseException as e:  # noqa: BLE001 — predicate decides
+                if not self._retryable(e):
+                    raise
+                if attempt >= self.max_attempts:
+                    metrics.counter(f"retry.{self.name}.exhausted").add(1)
+                    raise RetriesExhausted(
+                        f"{self.name}: gave up after {attempt} attempts: "
+                        f"{e}") from e
+                if dl.expired():
+                    metrics.counter(f"retry.{self.name}.exhausted").add(1)
+                    raise DeadlineExpired(
+                        f"{self.name}: deadline exhausted after {attempt} "
+                        f"attempts: {e}") from e
+                m_retry.add(1)
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                delay = self.backoff_s(attempt)
+                # server-directed backoff (e.g. HTTP Retry-After): an
+                # exception carrying retry_after_s raises the floor; the
+                # deadline clamp below caps even a hostile hint at the
+                # remaining budget
+                hint = getattr(e, "retry_after_s", None)
+                if hint is not None:
+                    delay = max(delay, float(hint))
+                self._sleep(dl.clamp(delay))
+                if dl.expired():
+                    # the (clamped) sleep consumed the rest of the budget;
+                    # an attempt now would run with a zero timeout and
+                    # mask the real failure behind a bogus transport error
+                    metrics.counter(f"retry.{self.name}.exhausted").add(1)
+                    raise DeadlineExpired(
+                        f"{self.name}: deadline exhausted after {attempt} "
+                        f"attempts: {e}") from e
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker (thread-safe).
+
+    closed → ``record_failure()`` × ``failure_threshold`` → open (every
+    ``allow()`` raises :class:`CircuitOpen` for ``cooldown_s``) →
+    half-open (ONE caller gets through as the probe) → closed on success,
+    re-open on failure.  Opens bump ``circuit.<name>.opens``; fast-fails
+    bump ``circuit.<name>.fast_fails``.
+    """
+
+    def __init__(self, name: str = "default", failure_threshold: int = 5,
+                 cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.name = name
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    @classmethod
+    def from_env(cls, prefix: str, *, name: str = "", **kw) -> "CircuitBreaker":
+        from .parameter import get_env
+        kw.setdefault("failure_threshold",
+                      get_env(f"{prefix}_BREAKER_THRESHOLD", 5))
+        kw.setdefault("cooldown_s", get_env(f"{prefix}_BREAKER_COOLDOWN", 5.0))
+        return cls(name=name or prefix.lower(), **kw)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if self._clock() - self._opened_at >= self.cooldown_s:
+                return "half_open"
+            return "open"
+
+    def allow(self) -> None:
+        """Gate one attempt: raises :class:`CircuitOpen` while open; in
+        half-open admits exactly one probe (others keep fast-failing
+        until the probe reports back)."""
+        with self._lock:
+            if self._opened_at is None:
+                return
+            if (self._clock() - self._opened_at >= self.cooldown_s
+                    and not self._probing):
+                self._probing = True        # this caller is the probe
+                return
+            metrics.counter(f"circuit.{self.name}.fast_fails").add(1)
+            raise CircuitOpen(
+                f"circuit {self.name!r} open "
+                f"({self._failures} consecutive failures)")
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._opened_at is not None:
+                # failed probe: restart the cooldown
+                self._opened_at = self._clock()
+            elif self._failures >= self.failure_threshold:
+                self._opened_at = self._clock()
+                metrics.counter(f"circuit.{self.name}.opens").add(1)
+                log_warning("circuit %s opened after %d consecutive "
+                            "failures", self.name, self._failures)
+
+    def call(self, fn: Callable[..., Any], *args: Any, **kw: Any) -> Any:
+        """``allow()`` + run + record; exceptions count as failures."""
+        self.allow()
+        try:
+            out = fn(*args, **kw)
+        except BaseException:
+            self.record_failure()
+            raise
+        self.record_success()
+        return out

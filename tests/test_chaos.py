@@ -1,0 +1,340 @@
+"""Deterministic chaos tests: the fault-injection harness drives real
+failure schedules through the I/O, ingest, and serving stacks and the
+resilience layer must absorb them — bounded wall time, fixed seeds, retry
+counters visible in ``metrics.snapshot()``.
+
+The fast tests stay tier-1 (each well under 10s); the soak rides the
+``slow`` marker."""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+from dmlc_core_tpu.io import open_seek_stream_for_read  # noqa: E402
+from dmlc_core_tpu.models import SparseLogReg  # noqa: E402
+from dmlc_core_tpu.pipeline import RemoteIngestLoader  # noqa: E402
+from dmlc_core_tpu.serving import (  # noqa: E402
+    BucketLadder, InferenceEngine, PredictClient, PredictionServer)
+from dmlc_core_tpu.utils import clear_faults, fault_point, inject_faults  # noqa: E402
+from dmlc_core_tpu.utils.metrics import metrics  # noqa: E402
+
+from conftest import free_port, start_ingest_worker  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _counter(name):
+    return metrics.counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# (a) ranged S3-style reads ride over drops / latency / 5xx
+# ---------------------------------------------------------------------------
+
+class _FlakyRangeHandler(BaseHTTPRequestHandler):
+    """Range GET server that answers 500 for the first ``fail_500`` GETs —
+    the real-wire half of the chaos schedule (the injected half lives at
+    the ``s3.request`` probe inside ``_http_request``)."""
+    files = {}
+    fail_500 = [0]
+
+    def log_message(self, *a):
+        pass
+
+    def do_HEAD(self):
+        data = self.files.get(self.path.split("?")[0])
+        if data is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_GET(self):
+        if self.fail_500[0] > 0:
+            self.fail_500[0] -= 1
+            body = b"injected server error"
+            self.send_response(500)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        data = self.files.get(self.path.split("?")[0])
+        if data is None:
+            self.send_error(404)
+            return
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            lo, hi = rng[6:].split("-")
+            lo = int(lo)
+            hi = min(int(hi), len(data) - 1) if hi else len(data) - 1
+            part = data[lo:hi + 1]
+            self.send_response(206)
+            self.send_header("Content-Range", f"bytes {lo}-{hi}/{len(data)}")
+            self.send_header("Content-Length", str(len(part)))
+            self.end_headers()
+            self.wfile.write(part)
+        else:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+
+@pytest.fixture
+def flaky_server():
+    _FlakyRangeHandler.files = {}
+    _FlakyRangeHandler.fail_500 = [0]
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FlakyRangeHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv, _FlakyRangeHandler
+    srv.shutdown()
+
+
+def test_ranged_read_completes_under_injected_drops_and_latency(
+        flaky_server, monkeypatch):
+    srv, h = flaky_server
+    data = bytes(range(256)) * 256           # 64 KiB
+    h.files["/blob"] = data
+    monkeypatch.setenv("DMLC_IO_RETRIES", "6")
+    retries_before = _counter("retry.io.http.retries")
+    t0 = time.monotonic()
+    with inject_faults("s3.request:error=0.3:seed=7:latency=2ms:lp=0.5"):
+        url = f"http://127.0.0.1:{srv.server_address[1]}/blob"
+        with open_seek_stream_for_read(url) as s:
+            # ragged read pattern: sequential reads + out-of-buffer seeks,
+            # each refill crossing the fault probe
+            assert s.read(1000) == data[:1000]
+            s.seek(50000)
+            assert s.read(500) == data[50000:50500]
+            s.seek(10)
+            assert s.read() == data[10:]
+    assert time.monotonic() - t0 < 10.0
+    assert _counter("faults.s3.request.errors") > 0   # faults actually fired
+    assert _counter("retry.io.http.retries") > retries_before
+
+
+def test_ranged_read_rides_over_real_5xx(flaky_server):
+    srv, h = flaky_server
+    data = b"durable payload " * 512
+    h.files["/five"] = data
+    h.fail_500[0] = 2                        # first two GETs answer 500
+    retries_before = _counter("retry.io.http.retries")
+    url = f"http://127.0.0.1:{srv.server_address[1]}/five"
+    with open_seek_stream_for_read(url) as s:
+        assert s.read() == data
+    assert h.fail_500[0] == 0
+    assert _counter("retry.io.http.retries") >= retries_before + 2
+
+
+# ---------------------------------------------------------------------------
+# (b) ingest epoch completes after a mid-epoch reader kill
+# ---------------------------------------------------------------------------
+
+def _libsvm(tmp_path, rows=400):
+    rng = np.random.default_rng(0)
+    path = tmp_path / "chaos.libsvm"
+    with open(path, "w") as f:
+        for r in range(rows):
+            k = int(rng.integers(1, 5))
+            idx = np.sort(rng.choice(3000, size=k, replace=False))
+            f.write(f"{r} " + " ".join(
+                f"{j}:{rng.random():.4f}" for j in idx) + "\n")
+    return str(path), rows
+
+
+def test_ingest_epoch_survives_mid_epoch_reader_kill(tmp_path):
+    uri, nrows = _libsvm(tmp_path)
+    port = free_port()
+    # two epoch budget: the killed first connection burns one, the
+    # reader's restart connection replays the partition on the second
+    start_ingest_worker(f"file://{uri}", 0, 1, port=port, max_epochs=2)
+    restarts_before = _counter("ingest.reader.restarts")
+    t0 = time.monotonic()
+    # deterministic kill: frame 3 of the stream dies exactly once
+    with inject_faults("ingest.send:error=1:times=1:after=2"):
+        loader = RemoteIngestLoader([("127.0.0.1", port)], batch_rows=64)
+        try:
+            seen = []
+            for b in loader:
+                w = np.asarray(b["weights"]) > 0
+                seen.extend(np.asarray(b["labels"])[w].astype(int).tolist())
+        finally:
+            loader.close()
+    assert time.monotonic() - t0 < 10.0
+    # the restarted reader re-serves its partition from the start, so
+    # relaxed-ordering duplicates are expected — the UNION must be exact
+    assert sorted(set(seen)) == list(range(nrows))
+    assert _counter("ingest.reader.restarts") >= restarts_before + 1
+    assert _counter("faults.ingest.send.errors") > 0
+
+
+def test_ingest_reader_retries_zero_restores_fail_fast(tmp_path, monkeypatch):
+    uri, _ = _libsvm(tmp_path, rows=200)
+    port = free_port()
+    start_ingest_worker(f"file://{uri}", 0, 1, port=port, max_epochs=2)
+    monkeypatch.setenv("DMLC_INGEST_READER_RETRIES", "0")
+    with inject_faults("ingest.send:error=1:times=1:after=2"):
+        loader = RemoteIngestLoader([("127.0.0.1", port)], batch_rows=64)
+        try:
+            with pytest.raises(Exception, match="mid-frame|mid-stream|reader"):
+                for _ in loader:
+                    pass
+        finally:
+            loader.close()
+
+
+# ---------------------------------------------------------------------------
+# (c) serving round trip through an Overloaded burst and a server restart
+# ---------------------------------------------------------------------------
+
+F = 3000
+
+
+def _engine():
+    model = SparseLogReg(num_features=F)
+    params = {"w": jnp.arange(F, dtype=jnp.float32) / F,
+              "b": jnp.float32(0.5)}
+    return InferenceEngine(model, params, buckets=BucketLadder([(8, 256)]))
+
+
+def test_predict_retries_through_overloaded_burst():
+    eng = _engine()
+    ids = np.array([100], np.int32)
+    vals = np.ones(1, np.float32)
+    expect = 100.0 / F + 0.5
+    retries_before = _counter("retry.serving.client.retries")
+    shed_before = _counter("serving.server.shed")
+    with PredictionServer(eng, warmup=True).start() as srv:
+        with PredictClient(srv.host, srv.port) as c:
+            t0 = time.monotonic()
+            # exactly 3 sheds; the default 4-attempt budget absorbs them
+            with inject_faults("serving.server.admit:error=1:times=3"):
+                out = c.predict(ids, vals, timeout=20.0)
+            assert time.monotonic() - t0 < 10.0
+    assert out[0] == pytest.approx(expect, rel=1e-5)
+    assert _counter("retry.serving.client.retries") == retries_before + 3
+    assert _counter("serving.server.shed") == shed_before + 3
+
+
+def test_predict_survives_server_restart(monkeypatch):
+    # generous reconnect budget: the dial schedule must span the rebind
+    # window however the jitter draws
+    monkeypatch.setenv("DMLC_SERVING_RECONNECT_RETRIES", "60")
+    monkeypatch.setenv("DMLC_SERVING_RECONNECT_BACKOFF", "0.05")
+    monkeypatch.setenv("DMLC_SERVING_BREAKER_THRESHOLD", "1000")
+    eng = _engine()
+    ids = np.array([200], np.int32)
+    vals = np.ones(1, np.float32)
+    expect = 200.0 / F + 0.5
+    reconnects_before = _counter("serving.client.reconnects")
+    port = free_port()
+    srv = PredictionServer(eng, port=port, warmup=True).start()
+    client = PredictClient(srv.host, port)
+    try:
+        assert client.predict(ids, vals)[0] == pytest.approx(expect,
+                                                             rel=1e-5)
+        srv.stop()                           # take the replica down...
+        srv = PredictionServer(eng, port=port, warmup=False).start()
+        t0 = time.monotonic()                # ...and bring a new one up
+        out = client.predict(ids, vals, timeout=20.0)
+        assert time.monotonic() - t0 < 15.0
+        assert out[0] == pytest.approx(expect, rel=1e-5)
+    finally:
+        client.close()
+        srv.stop()
+    assert _counter("serving.client.reconnects") >= reconnects_before + 1
+
+
+def test_pipelined_inflight_requests_resubmitted_across_restart(monkeypatch):
+    """Kill the server while pipelined requests are in flight: the client
+    replays every registered frame on the new connection and all futures
+    complete (predictions are pure, so replay is idempotent)."""
+    monkeypatch.setenv("DMLC_SERVING_RECONNECT_RETRIES", "60")
+    monkeypatch.setenv("DMLC_SERVING_RECONNECT_BACKOFF", "0.05")
+    monkeypatch.setenv("DMLC_SERVING_BREAKER_THRESHOLD", "1000")
+    eng = _engine()
+    port = free_port()
+    srv = PredictionServer(eng, port=port, warmup=True).start()
+    client = PredictClient(srv.host, port)
+    try:
+        # a first round trip proves the link, then the server dies with
+        # requests submitted against the dead socket
+        client.predict(np.array([1], np.int32), np.ones(1, np.float32))
+        srv.stop(drain=False)
+        futs = [client.submit(np.array([i], np.int32),
+                              np.ones(1, np.float32)) for i in range(8)]
+        srv = PredictionServer(eng, port=port, warmup=False).start()
+        for i, f in enumerate(futs):
+            out = f.result(timeout=20)
+            assert out[0] == pytest.approx(i / F + 0.5, rel=1e-4, abs=1e-5)
+        assert client._pending == {}         # nothing leaked
+    finally:
+        client.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# (d) probes are exact no-ops when no spec is armed
+# ---------------------------------------------------------------------------
+
+def test_probes_are_noops_without_spec(flaky_server):
+    clear_faults()
+    srv, h = flaky_server
+    data = b"quiet wire " * 100
+    h.files["/quiet"] = data
+    faults_before = {k: v for k, v in metrics.snapshot().items()
+                     if k.startswith("faults.")}
+    url = f"http://127.0.0.1:{srv.server_address[1]}/quiet"
+    with open_seek_stream_for_read(url) as s:
+        assert s.read() == data              # real path crosses the probe
+    for _ in range(50):
+        fault_point("s3.request")
+        fault_point("ingest.send")
+        fault_point("serving.server.admit")
+    faults_after = {k: v for k, v in metrics.snapshot().items()
+                    if k.startswith("faults.")}
+    assert faults_before == faults_after
+
+
+# ---------------------------------------------------------------------------
+# soak (slow): sustained probabilistic chaos across serving + io
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_serving_and_io(flaky_server, monkeypatch):
+    srv_http, h = flaky_server
+    data = bytes(range(256)) * 64
+    h.files["/soak"] = data
+    monkeypatch.setenv("DMLC_IO_RETRIES", "8")
+    monkeypatch.setenv("DMLC_SERVING_RETRIES", "8")
+    eng = _engine()
+    url = f"http://127.0.0.1:{srv_http.server_address[1]}/soak"
+    spec = ("s3.request:error=0.25:seed=11:latency=1ms:lp=0.3,"
+            "serving.server.admit:error=0.2:seed=13")
+    with PredictionServer(eng, warmup=True).start() as srv:
+        with PredictClient(srv.host, srv.port) as c:
+            with inject_faults(spec):
+                rng = np.random.default_rng(17)
+                for i in range(200):
+                    ids = rng.integers(0, F, size=4).astype(np.int32)
+                    vals = np.ones(4, np.float32)
+                    out = c.predict(ids, vals, timeout=30.0)
+                    assert out.shape == (1,) and np.isfinite(out).all()
+                    if i % 10 == 0:
+                        with open_seek_stream_for_read(url) as s:
+                            s.seek(int(rng.integers(0, len(data) - 64)))
+                            assert len(s.read(64)) == 64
+    assert _counter("faults.serving.server.admit.errors") > 0
+    assert _counter("faults.s3.request.errors") > 0

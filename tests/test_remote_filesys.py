@@ -852,3 +852,96 @@ def test_fscli_pack_unpack_roundtrip(tmp_path, capsys):
     assert main(["pack", f"file://{src}", f"file://{rec}"]) == 0
     assert main(["unpack", f"file://{rec}", f"file://{txt}"]) == 0
     assert txt.read_bytes() == src.read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# 429 rate limiting + Retry-After (the shared retry machinery end-to-end)
+# ---------------------------------------------------------------------------
+
+class _RateLimitHandler(_RangeHTTPHandler):
+    """Range server that answers each queued GET with 429; the header value
+    queued in ``limit_next`` (or None for no header) rides as Retry-After."""
+    limit_next = []
+
+    def do_GET(self):
+        if type(self).limit_next:
+            ra = type(self).limit_next.pop(0)
+            self.send_response(429)
+            if ra is not None:
+                self.send_header("Retry-After", ra)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        super().do_GET()
+
+
+@pytest.fixture
+def ratelimit_server():
+    _RateLimitHandler.files = {}
+    _RateLimitHandler.requests = []
+    _RateLimitHandler.limit_next = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _RateLimitHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv, _RateLimitHandler
+    srv.shutdown()
+
+
+def test_http_429_retried_and_retry_after_honored(ratelimit_server):
+    """One 429 with ``Retry-After: 1`` then 200: the request succeeds and
+    the server-directed pause is respected as a backoff floor."""
+    import time as _t
+    from dmlc_core_tpu.io.remote_filesys import _http_request
+    srv, h = ratelimit_server
+    h.files["/obj"] = b"rate limited payload"
+    h.limit_next = ["1"]
+    t0 = _t.monotonic()
+    status, _, data = _http_request(
+        "http", f"127.0.0.1:{srv.server_address[1]}", "GET", "/obj", {})
+    assert status == 200 and data == b"rate limited payload"
+    assert _t.monotonic() - t0 >= 0.9, \
+        "Retry-After must raise the backoff floor"
+    assert h.limit_next == []
+
+
+def test_http_429_retry_after_capped_by_deadline(ratelimit_server):
+    """A huge ``Retry-After: 30`` must not out-wait the I/O deadline: the
+    sleep is clamped to the remaining budget and the final 429 comes back
+    as a STATUS (caller contract), promptly."""
+    import time as _t
+    from dmlc_core_tpu.io.remote_filesys import _http_request
+    from dmlc_core_tpu.utils.retry import Deadline
+    srv, h = ratelimit_server
+    h.files["/obj"] = b"x"
+    h.limit_next = ["30"] * 10
+    t0 = _t.monotonic()
+    status, _, _ = _http_request(
+        "http", f"127.0.0.1:{srv.server_address[1]}", "GET", "/obj", {},
+        deadline=Deadline(0.5))
+    assert status == 429
+    assert _t.monotonic() - t0 < 5.0
+
+
+def test_ranged_read_recovers_from_429(ratelimit_server):
+    """End-to-end: a ranged stream read rides over a transient 429."""
+    srv, h = ratelimit_server
+    data = os.urandom(5000)
+    h.files["/blob"] = data
+    h.limit_next = ["0.05"]
+    url = f"http://127.0.0.1:{srv.server_address[1]}/blob"
+    with open_seek_stream_for_read(url) as s:
+        assert s.read() == data
+
+
+def test_parse_retry_after_both_rfc_forms():
+    import datetime
+    import email.utils
+    from dmlc_core_tpu.io.remote_filesys import _parse_retry_after
+    assert _parse_retry_after({}) is None
+    assert _parse_retry_after({"retry-after": "7"}) == 7.0
+    assert _parse_retry_after({"retry-after": "-3"}) == 0.0
+    future = datetime.datetime.now(datetime.timezone.utc) \
+        + datetime.timedelta(seconds=60)
+    got = _parse_retry_after({"retry-after": email.utils.format_datetime(future)})
+    assert got is not None and 50.0 <= got <= 61.0
+    assert _parse_retry_after({"retry-after": "not a date"}) is None

@@ -1,0 +1,442 @@
+"""Unit tests for the unified resilience layer: Deadline budgets,
+RetryPolicy (full-jitter backoff, retryable predicate, server-directed
+Retry-After floor), CircuitBreaker state machine, and the deterministic
+fault-injection plan language (``DMLC_FAULT_SPEC``)."""
+
+import time
+
+import pytest
+
+from dmlc_core_tpu.utils import (
+    CircuitBreaker, CircuitOpen, Deadline, DeadlineExpired, FaultInjected,
+    FaultSpecError, RetriesExhausted, RetryPolicy, clear_faults, fault_point,
+    inject_faults, install_faults)
+from dmlc_core_tpu.utils.faults import _parse_duration, active_spec
+from dmlc_core_tpu.utils.metrics import metrics
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+def test_deadline_remaining_and_clamp():
+    clk = FakeClock()
+    dl = Deadline(10.0, clock=clk)
+    assert dl.remaining() == pytest.approx(10.0)
+    assert dl.clamp(3.0) == pytest.approx(3.0)
+    assert dl.clamp(30.0) == pytest.approx(10.0)
+    clk.advance(9.5)
+    assert dl.clamp(3.0) == pytest.approx(0.5)
+    assert not dl.expired()
+    clk.advance(1.0)
+    assert dl.expired()
+    assert dl.clamp(3.0) == 0.0
+    with pytest.raises(DeadlineExpired):
+        dl.check("unit test")
+
+
+def test_deadline_unbounded_never_expires():
+    dl = Deadline(None)
+    assert dl.remaining() == float("inf")
+    assert not dl.expired()
+    assert dl.clamp(7.0) == 7.0
+    dl.check()                              # no raise
+    assert not Deadline.unbounded().expired()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps = []
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.01, seed=0,
+                         name="ut.transient", sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2                 # one sleep per retry
+
+
+def test_retry_counts_total_attempts_and_chains_cause():
+    policy = RetryPolicy(max_attempts=3, seed=0, name="ut.exhaust",
+                         sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError("still down")
+
+    before = metrics.counter("retry.ut.exhaust.exhausted").value
+    with pytest.raises(RetriesExhausted) as ei:
+        policy.call(always_fails)
+    assert calls["n"] == 3                  # max_attempts is TOTAL tries
+    assert isinstance(ei.value.__cause__, OSError)
+    assert metrics.counter("retry.ut.exhaust.exhausted").value == before + 1
+
+
+def test_retry_non_retryable_raises_immediately():
+    policy = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def typo():
+        calls["n"] += 1
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        policy.call(typo)
+    assert calls["n"] == 1
+
+
+def test_retry_custom_retryable_predicate():
+    class Shed(Exception):
+        pass
+
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.0,
+                         retryable=lambda e: isinstance(e, Shed),
+                         sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def shed_twice():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise Shed()
+        return calls["n"]
+
+    assert policy.call(shed_twice) == 3
+
+
+def test_retry_backoff_full_jitter_bounds_and_determinism():
+    p1 = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, seed=42)
+    p2 = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, seed=42)
+    for attempt in range(1, 10):
+        cap = min(1.0, 0.1 * 2.0 ** (attempt - 1))
+        d1 = p1.backoff_s(attempt)
+        assert 0.0 <= d1 <= cap
+        assert d1 == p2.backoff_s(attempt)   # same seed → same schedule
+
+
+def test_retry_deadline_stops_the_schedule():
+    clk = FakeClock()
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clk.advance(max(s, 0.3))            # attempts burn wall clock too
+
+    policy = RetryPolicy(max_attempts=100, base_delay_s=0.05,
+                         name="ut.deadline", sleep=fake_sleep)
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(DeadlineExpired) as ei:
+        policy.call(always_fails, deadline=Deadline(1.0, clock=clk))
+    assert isinstance(ei.value.__cause__, OSError)
+    assert calls["n"] < 100                 # budget, not attempt cap, ended it
+    # every sleep was clamped to the remaining budget
+    assert all(s <= 1.0 for s in sleeps)
+
+
+def test_retry_honors_retry_after_hint_clamped_by_deadline():
+    class Overloaded(OSError):
+        def __init__(self, retry_after_s):
+            super().__init__("429")
+            self.retry_after_s = retry_after_s
+
+    sleeps = []
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                         sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def server_says_wait():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Overloaded(5.0)
+        return "ok"
+
+    assert policy.call(server_says_wait) == "ok"
+    assert sleeps == [5.0]                  # hint raised the backoff floor
+
+    sleeps.clear()
+    calls["n"] = 0
+    clk = FakeClock()
+    assert policy.call(server_says_wait,
+                       deadline=Deadline(0.5, clock=clk)) == "ok"
+    assert sleeps == [0.5]                  # hostile hint capped at budget
+
+
+def test_retry_on_retry_callback_sees_each_failure():
+    seen = []
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.0,
+                         sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def fails_twice():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(f"boom {calls['n']}")
+        return "ok"
+
+    policy.call(fails_twice,
+                on_retry=lambda a, e: seen.append((a, str(e))))
+    assert seen == [(1, "boom 1"), (2, "boom 2")]
+
+
+def test_retry_from_env_and_explicit_kwargs_win(monkeypatch):
+    monkeypatch.setenv("UT_RETRIES", "7")
+    monkeypatch.setenv("UT_BACKOFF_BASE", "0.25")
+    monkeypatch.setenv("UT_BACKOFF_MAX", "3.5")
+    monkeypatch.setenv("UT_DEADLINE", "9")
+    p = RetryPolicy.from_env("UT")
+    assert p.max_attempts == 7
+    assert p.base_delay_s == 0.25
+    assert p.max_delay_s == 3.5
+    assert p.deadline_s == 9
+    assert p.name == "ut"
+    # explicit kwargs beat the env
+    p2 = RetryPolicy.from_env("UT", max_attempts=2, name="mine")
+    assert p2.max_attempts == 2 and p2.name == "mine"
+    # DEADLINE=0 means unbounded
+    monkeypatch.setenv("UT_DEADLINE", "0")
+    assert RetryPolicy.from_env("UT").deadline_s is None
+
+
+def test_retry_counter_increments():
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                         name="ut.counted", sleep=lambda s: None)
+    before = metrics.counter("retry.ut.counted.retries").value
+    calls = {"n": 0}
+
+    def fails_once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("x")
+        return "ok"
+
+    policy.call(fails_once)
+    assert metrics.counter("retry.ut.counted.retries").value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_fast_fails():
+    clk = FakeClock()
+    br = CircuitBreaker("ut.open", failure_threshold=3, cooldown_s=10.0,
+                        clock=clk)
+    opens_before = metrics.counter("circuit.ut.open.opens").value
+    for _ in range(2):
+        br.allow()
+        br.record_failure()
+    assert br.state == "closed"             # under threshold
+    br.allow()
+    br.record_failure()                     # third consecutive: opens
+    assert br.state == "open"
+    assert metrics.counter("circuit.ut.open.opens").value == opens_before + 1
+    ff_before = metrics.counter("circuit.ut.open.fast_fails").value
+    with pytest.raises(CircuitOpen):
+        br.allow()
+    assert metrics.counter(
+        "circuit.ut.open.fast_fails").value == ff_before + 1
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker("ut.streak", failure_threshold=3,
+                        cooldown_s=10.0, clock=FakeClock())
+    for _ in range(2):
+        br.record_failure()
+    br.record_success()
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == "closed"             # streak broken, never opened
+
+
+def test_breaker_half_open_admits_single_probe():
+    clk = FakeClock()
+    br = CircuitBreaker("ut.probe", failure_threshold=1, cooldown_s=5.0,
+                        clock=clk)
+    br.record_failure()
+    assert br.state == "open"
+    clk.advance(5.0)
+    assert br.state == "half_open"
+    br.allow()                              # this caller is THE probe
+    with pytest.raises(CircuitOpen):
+        br.allow()                          # everyone else keeps failing fast
+    br.record_success()                     # probe succeeded
+    assert br.state == "closed"
+    br.allow()
+
+
+def test_breaker_failed_probe_restarts_cooldown():
+    clk = FakeClock()
+    br = CircuitBreaker("ut.reprobe", failure_threshold=1, cooldown_s=5.0,
+                        clock=clk)
+    br.record_failure()
+    clk.advance(5.0)
+    br.allow()                              # probe
+    br.record_failure()                     # probe failed
+    assert br.state == "open"               # cooldown restarted
+    clk.advance(4.9)
+    with pytest.raises(CircuitOpen):
+        br.allow()
+    clk.advance(0.2)
+    br.allow()                              # next probe window
+
+
+def test_breaker_call_wrapper_records_outcomes():
+    clk = FakeClock()
+    br = CircuitBreaker("ut.wrap", failure_threshold=2, cooldown_s=5.0,
+                        clock=clk)
+    with pytest.raises(OSError):
+        br.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    with pytest.raises(OSError):
+        br.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    with pytest.raises(CircuitOpen):
+        br.call(lambda: "never runs")
+    clk.advance(5.0)
+    assert br.call(lambda: "ok") == "ok"    # probe succeeds, re-closes
+    assert br.state == "closed"
+
+
+def test_breaker_from_env(monkeypatch):
+    monkeypatch.setenv("UT_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("UT_BREAKER_COOLDOWN", "1.5")
+    br = CircuitBreaker.from_env("UT")
+    assert br.failure_threshold == 2
+    assert br.cooldown_s == 1.5
+
+
+# ---------------------------------------------------------------------------
+# fault-injection plan language
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parse_errors_are_loud():
+    for bad in ["", ":error=1", "site:error", "site:error=x",
+                "site:latency=4q", "site:bogus=1", "site:times=maybe"]:
+        with pytest.raises(FaultSpecError):
+            install_faults(bad)
+
+
+def test_parse_duration_forms():
+    assert _parse_duration("50ms") == pytest.approx(0.05)
+    assert _parse_duration("0.2s") == pytest.approx(0.2)
+    assert _parse_duration("3") == pytest.approx(3.0)
+    with pytest.raises(FaultSpecError):
+        _parse_duration("fast")
+
+
+def test_fault_point_noop_when_nothing_installed():
+    clear_faults()
+    assert active_spec() is None
+    snap_before = {k: v for k, v in metrics.snapshot().items()
+                   if k.startswith("faults.")}
+    for _ in range(100):
+        fault_point("ut.some.site")         # must not raise, sleep, or count
+    snap_after = {k: v for k, v in metrics.snapshot().items()
+                  if k.startswith("faults.")}
+    assert snap_before == snap_after
+
+
+def test_fault_error_with_times_and_after():
+    fired = 0
+    with inject_faults("ut.kill:error=1:times=2:after=3"):
+        for i in range(10):
+            try:
+                fault_point("ut.kill")
+            except FaultInjected as e:
+                assert isinstance(e, OSError)   # composes with retry layers
+                fired += 1
+                # calls are 1-based: after=3 skips 1..3, times=2 arms 4..5
+                assert i in (3, 4)
+    assert fired == 2
+
+
+def test_fault_seeded_probability_is_deterministic():
+    def schedule():
+        hits = []
+        with inject_faults("ut.p:error=0.5:seed=123"):
+            for i in range(40):
+                try:
+                    fault_point("ut.p")
+                except FaultInjected:
+                    hits.append(i)
+        return hits
+
+    a, b = schedule(), schedule()
+    assert a == b                           # identical replayed schedule
+    assert 0 < len(a) < 40                  # actually probabilistic
+
+
+def test_fault_latency_sleeps_and_counts():
+    before = metrics.counter("faults.ut.slow.delays").value
+    with inject_faults("ut.slow:latency=30ms"):
+        t0 = time.monotonic()
+        fault_point("ut.slow")
+        assert time.monotonic() - t0 >= 0.025
+    assert metrics.counter("faults.ut.slow.delays").value == before + 1
+
+
+def test_fault_prefix_glob_matches():
+    with inject_faults("ingest.*:error=1:times=1"):
+        with pytest.raises(FaultInjected):
+            fault_point("ingest.recv")
+        fault_point("serving.server.admit")   # different prefix: untouched
+
+
+def test_fault_env_var_drives_probes(monkeypatch):
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "ut.env:error=1:times=1")
+    with pytest.raises(FaultInjected):
+        fault_point("ut.env")
+    fault_point("ut.env")                   # times=1: healed
+    monkeypatch.delenv("DMLC_FAULT_SPEC")
+    fault_point("ut.env")                   # env cleared: exact no-op again
+    assert active_spec() is None
+
+
+def test_fault_install_wins_over_env(monkeypatch):
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "ut.a:error=1")
+    install_faults("ut.b:error=1:times=1")
+    fault_point("ut.a")                     # env plan is shadowed
+    with pytest.raises(FaultInjected):
+        fault_point("ut.b")
+    clear_faults()
+
+
+def test_fault_error_counter_increments():
+    before = metrics.counter("faults.ut.ctr.errors").value
+    with inject_faults("ut.ctr:error=1:times=3"):
+        for _ in range(5):
+            try:
+                fault_point("ut.ctr")
+            except FaultInjected:
+                pass
+    assert metrics.counter("faults.ut.ctr.errors").value == before + 3

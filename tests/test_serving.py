@@ -4,6 +4,7 @@ hot-reload mid-stream, and load-generator integrity — all on CPU."""
 
 import threading
 import time
+from concurrent.futures import TimeoutError as FutureTimeout
 
 import numpy as np
 import pytest
@@ -345,6 +346,26 @@ def test_server_overload_surfaces_as_typed_error():
                     shed += 1
             assert shed > 0, "burst over a queue of 2 must shed load"
             assert ok > 0, "admitted requests must still complete"
+
+
+def test_predict_timeout_abandons_pending_entry():
+    """Regression: a timed-out predict() must remove its req_id from the
+    pending map — a leaked entry pins the future and its frame forever
+    and would be replayed on every subsequent reconnect."""
+    stub = _SlowEngine(delay=0.6)
+    with PredictionServer(stub, warmup=False,
+                          default_deadline_s=10.0).start() as srv:
+        with PredictClient(srv.host, srv.port) as c:
+            with pytest.raises(FutureTimeout):
+                c.predict(np.array([1], np.int32),
+                          np.ones(1, np.float32), timeout=0.2)
+            assert c._pending == {}
+            # the connection stays usable: the late response for the
+            # abandoned request is discarded, not misdelivered
+            out = c.predict(np.array([1], np.int32),
+                            np.ones(1, np.float32), timeout=10.0)
+            assert out.shape == (1,)
+            assert c._pending == {}
 
 
 def test_server_load_generator_reports():
