@@ -15,8 +15,15 @@ Sweeps the load axes that matter for a serving replica:
                 goodput under 4x admission pressure
 
 Usage: python benchmarks/bench_serving.py [out.json]
+                                          [--telemetry-out PREFIX]
 Env:   DMLC_SERVE_REQUESTS (default 2000), DMLC_SERVE_FEATURES (2^16),
-       DMLC_SERVE_MODEL (fm), DMLC_SERVE_DIM (16)
+       DMLC_SERVE_MODEL (fm), DMLC_SERVE_DIM (16),
+       DMLC_TELEMETRY_OUT (same as --telemetry-out)
+
+``--telemetry-out p`` writes ``p.metrics.json`` (full registry snapshot)
+and ``p.trace.json`` (Chrome trace — open in Perfetto) after the sweep;
+a short traced predict sequence runs last so the trace carries
+correlated client → server → engine spans.
 """
 
 from __future__ import annotations
@@ -39,9 +46,16 @@ def main() -> int:
     import jax
 
     from dmlc_core_tpu.models.cli import MODEL_REGISTRY, TrainParams
-    from dmlc_core_tpu.serving import (InferenceEngine, PredictionServer,
-                                       run_load)
+    from dmlc_core_tpu.serving import (InferenceEngine, PredictClient,
+                                       PredictionServer, run_load)
     from dmlc_core_tpu.utils.metrics import metrics
+
+    argv = sys.argv[1:]
+    telemetry_prefix = os.environ.get("DMLC_TELEMETRY_OUT")
+    if "--telemetry-out" in argv:
+        i = argv.index("--telemetry-out")
+        telemetry_prefix = argv[i + 1]
+        del argv[i:i + 2]
 
     requests = int(os.environ.get("DMLC_SERVE_REQUESTS", "2000"))
     features = int(os.environ.get("DMLC_SERVE_FEATURES", str(1 << 16)))
@@ -79,6 +93,10 @@ def main() -> int:
             k: snap["serving.latency_s"][k] * 1e3
             for k in ("p50", "p95", "p99", "mean")}
         rep["batch_occupancy"] = snap["serving.batcher.occupancy"]["value"]
+        # the whole registry rides in the artifact so observability data
+        # (queue depths, retry counters, latency quantiles) is diffable
+        # across rounds without re-running the bench
+        rep["registry"] = snap
         # resilience counters: how much retry/reconnect/shed machinery the
         # scenario actually exercised (zero on a healthy run except the
         # overload scenario's sheds)
@@ -105,12 +123,33 @@ def main() -> int:
     report["qps"] = cc["qps"]
     report["latency_ms"] = cc["latency_ms"]
 
+    if telemetry_prefix:
+        # one short SYNCHRONOUS predict sequence: run_load drives async
+        # submits (untraced by design), but predict() opens the client
+        # span, so these requests give the trace artifact correlated
+        # client → server → engine spans
+        from dmlc_core_tpu import telemetry
+        engine = InferenceEngine(model, params, postprocess="sigmoid")
+        srv = PredictionServer(engine, warmup=True).start()
+        try:
+            with PredictClient(srv.host, srv.port) as client:
+                import numpy as np
+                rng = np.random.default_rng(0)
+                for _ in range(8):
+                    n = int(rng.integers(4, 32))
+                    client.predict(rng.integers(0, features, n, np.int32),
+                                   rng.random(n, np.float32))
+        finally:
+            srv.stop()
+        paths = telemetry.dump_artifacts(telemetry_prefix)
+        log(f"telemetry artifacts: {paths['metrics']} {paths['trace']}")
+
     blob = json.dumps(report, indent=2)
     print(blob)
-    if len(sys.argv) > 1:
-        with open(sys.argv[1], "w") as f:
+    if argv:
+        with open(argv[0], "w") as f:
             f.write(blob + "\n")
-        log(f"wrote {sys.argv[1]}")
+        log(f"wrote {argv[0]}")
     return 0
 
 

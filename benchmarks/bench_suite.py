@@ -1279,6 +1279,16 @@ def run_one(name: str) -> None:
         r = ALL[name][0]()
     except Exception as e:  # noqa: BLE001 - report and continue
         r = {"metric": METRIC_OF.get(name, name), "error": str(e)}
+    prefix = os.environ.get("DMLC_TELEMETRY_OUT")
+    if prefix:
+        # per-config observability artifact: the full registry snapshot +
+        # Chrome trace of whatever spans the config produced (each config
+        # is its own process, so the dump is per-config by construction)
+        try:
+            from dmlc_core_tpu import telemetry
+            telemetry.dump_artifacts(f"{prefix}_{name}")
+        except Exception as e:  # noqa: BLE001 — telemetry never fails a run
+            log(f"telemetry dump failed: {e}")
     r["platform"] = platform
     print(json.dumps(r), flush=True)
 
@@ -1305,6 +1315,12 @@ def resolve_picks(argv) -> list:
 
 def main() -> None:
     argv = sys.argv[1:]
+    if "--telemetry-out" in argv:
+        # ride to the per-config children via env — each child dumps
+        # <prefix>_<config>.metrics.json / .trace.json from run_one
+        i = argv.index("--telemetry-out")
+        os.environ["DMLC_TELEMETRY_OUT"] = argv[i + 1]
+        del argv[i:i + 2]
     if argv[:1] == ["--one"]:
         run_one(argv[1])
         return

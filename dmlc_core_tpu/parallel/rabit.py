@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils import DMLCError, check, get_env, log_info, log_warning
+from ..utils.logging import set_log_context
 from .tracker import recv_json, send_json
 
 __all__ = ["RabitContext"]
@@ -107,7 +108,8 @@ class RabitContext:
                  jobid: Optional[str] = None, recover: bool = False,
                  connect_timeout: float = 60.0, connect_links: bool = True,
                  recover_timeout: float = 120.0,
-                 heartbeat_interval: Optional[float] = None):
+                 heartbeat_interval: Optional[float] = None,
+                 telemetry_interval: Optional[float] = None):
         self.tracker_addr = (tracker_uri, tracker_port)
         self.jobid = jobid or f"job-{os.getpid()}-{socket.gethostname()}"
         self.connect_timeout = connect_timeout
@@ -164,6 +166,19 @@ class RabitContext:
                 target=self._heartbeat_loop, name="rabit-heartbeat",
                 daemon=True)
             self._hb_thread.start()
+        # fleet telemetry: push this process's registry state to the
+        # tracker (cmd=telemetry) on a cadence; the tracker merges the
+        # per-rank states into its /metrics.  0 (the default) disables.
+        if telemetry_interval is None:
+            telemetry_interval = get_env("DMLC_TELEMETRY_INTERVAL", 0.0)
+        self.telemetry_interval = float(telemetry_interval)
+        self._tel_stop = threading.Event()
+        self._tel_thread: Optional[threading.Thread] = None
+        if self.telemetry_interval > 0:
+            self._tel_thread = threading.Thread(
+                target=self._telemetry_loop, name="rabit-telemetry",
+                daemon=True)
+            self._tel_thread.start()
         if connect_links:
             self._connect_links()
 
@@ -207,6 +222,8 @@ class RabitContext:
         self._target_gen = self.generation
         self._addresses = {int(k): tuple(v)
                            for k, v in reply["addresses"].items()}
+        # every log record from this process now carries its rank
+        set_log_context(rank=self.rank)
 
     # -- link management --
     def _accept_loop(self) -> None:
@@ -535,6 +552,22 @@ class RabitContext:
                 # tracker briefly unreachable — beats are best-effort
                 metrics.counter("rabit.heartbeat.failures").add(1)
 
+    # -- fleet telemetry --
+    def push_telemetry(self) -> None:
+        """Push this process's full registry state (mergeable form — see
+        ``MetricsRegistry.state``) to the tracker, tagged with our rank."""
+        from ..utils.metrics import metrics
+        self._tracker_cmd({"cmd": "telemetry", "jobid": self.jobid,
+                           "rank": self.rank, "state": metrics.state()})
+
+    def _telemetry_loop(self) -> None:
+        from ..utils.metrics import metrics
+        while not self._tel_stop.wait(self.telemetry_interval):
+            try:
+                self.push_telemetry()
+            except OSError:
+                metrics.counter("rabit.telemetry.failures").add(1)
+
     # -- misc rabit API --
     def tracker_print(self, msg: str) -> None:
         self._tracker_cmd({"cmd": "print", "msg": msg})
@@ -543,6 +576,13 @@ class RabitContext:
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2.0)
+        self._tel_stop.set()
+        if self._tel_thread is not None:
+            self._tel_thread.join(timeout=2.0)
+            try:  # final push so the fleet view reflects the full run
+                self.push_telemetry()
+            except OSError:
+                pass
         self._tracker_cmd({"cmd": "shutdown", "jobid": self.jobid})
         try:  # clean exit: the recovery checkpoint is no longer needed
             os.unlink(self._ckpt_path())

@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry.aggregate import render_fleet
+from ..telemetry.exposition import TelemetryServer
 from ..utils import DMLCError, check, get_env, get_logger, log_info
 from ..utils.metrics import metrics
 
@@ -114,7 +116,8 @@ class RabitTracker:
 
     def __init__(self, num_workers: int, host_ip: Optional[str] = None,
                  port: int = 0, max_port: int = 9999,
-                 heartbeat_timeout_s: Optional[float] = None):
+                 heartbeat_timeout_s: Optional[float] = None,
+                 telemetry_port: Optional[int] = None):
         self.num_workers = num_workers
         self.host_ip = host_ip or _default_host_ip()
         # dead-worker detection: workers beat (cmd=heartbeat) and a monitor
@@ -157,6 +160,17 @@ class RabitTracker:
         self._start_time: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = False
+        # fleet telemetry: workers push rank-tagged registry snapshots
+        # (cmd=telemetry) and the tracker exposes the merged view on its
+        # own /metrics endpoint.  Unset/negative port = disabled.
+        if telemetry_port is None:
+            p = get_env("DMLC_TRACKER_METRICS_PORT", -1)
+            telemetry_port = p if p >= 0 else None
+        self._telemetry_states: Dict[str, dict] = {}
+        self.telemetry: Optional[TelemetryServer] = None
+        if telemetry_port is not None:
+            self.telemetry = TelemetryServer(
+                port=int(telemetry_port), metrics_fn=self._render_fleet)
 
     # -- public control --
     def start(self) -> None:
@@ -167,6 +181,10 @@ class RabitTracker:
                                              name="tracker-heartbeat",
                                              daemon=True)
             self._monitor.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
+            log_info("tracker fleet metrics at http://%s:%d/metrics",
+                     self.host_ip, self.telemetry.port)
         log_info("tracker started at %s:%d for %d workers",
                  self.host_ip, self.port, self.num_workers)
 
@@ -198,10 +216,22 @@ class RabitTracker:
     def stop(self) -> None:
         self._stop = True
         self._monitor_stop.set()
+        if self.telemetry is not None:
+            self.telemetry.stop()
         try:
             self._sock.close()
         except OSError:
             pass
+
+    def _render_fleet(self) -> str:
+        with self._lock:
+            per_rank = dict(self._telemetry_states)
+        return render_fleet(per_rank, own_snapshot=metrics.snapshot())
+
+    def telemetry_states(self) -> Dict[str, dict]:
+        """Latest per-rank registry states pushed via ``cmd=telemetry``."""
+        with self._lock:
+            return dict(self._telemetry_states)
 
     # -- accept/assign logic --
     def _accept_loop(self) -> None:
@@ -229,6 +259,13 @@ class RabitTracker:
                     # it must not be declared dead afterwards
                     self._last_beat.pop(str(msg.get("jobid", "")), None)
                     self._lock.notify_all()
+            elif cmd == "telemetry":
+                # rank-tagged registry state push; last write per rank wins
+                # (each push is a full snapshot, not a delta)
+                state = msg.get("state")
+                if isinstance(state, dict):
+                    with self._lock:
+                        self._telemetry_states[str(msg.get("rank"))] = state
             elif cmd == "heartbeat":
                 jobid = str(msg.get("jobid", ""))
                 with self._lock:

@@ -40,6 +40,7 @@ import jax
 import numpy as np
 
 from ..data.parser import ParserBase
+from ..telemetry import trace as teltrace
 from ..utils import ThreadedIter, check
 from .packing import PackStats, batch_slices, pack_flat, pack_rowmajor
 
@@ -533,7 +534,9 @@ class DeviceLoader:
             yield self._pack_host(carry.flush(), fused)
 
     def _pack_host(self, block, fused: bool):
-        with self._m_pack.time():
+        with teltrace.span("device_loader.pack",
+                           rows=getattr(block, "size", self.batch_rows)), \
+                self._m_pack.time():
             if self.layout == "flat":
                 host = pack_flat(block, self.batch_rows, self.nnz_cap,
                                  self.stats, id_mod=self.id_mod,
@@ -674,7 +677,8 @@ class DeviceLoader:
         self._maybe_bind()
         # pool mode times under its own stage: K workers accumulate
         # overlapping seconds, which must not be read as serial h2d time
-        with (self._m_h2d_pool if sync else self._m_h2d).time():
+        with teltrace.span("device_loader.h2d", sync=sync), \
+                (self._m_h2d_pool if sync else self._m_h2d).time():
             if item[0] == "fused":
                 _, buf, nnz, rows_real = item
                 out = _put_fused_buf(buf, self.batch_rows, nnz)

@@ -38,6 +38,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry import trace as teltrace
 from ..utils import ThreadedIter, check
 from ..utils.faults import fault_point
 from ..utils.logging import DMLCError, log_info, log_warning
@@ -109,28 +110,39 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                 nthreads, threaded = ((1, False)
                                       if cores == 1 and not pinned
                                       else (0, True))
-                loader = DeviceLoader(
-                    create_parser(uri, part, nparts, fmt,
-                                  nthreads=nthreads, threaded=threaded),
-                    batch_rows=batch_rows, nnz_cap=nnz_cap,
-                    id_mod=id_mod, wire_compact=wire_compact, emit="host")
-                for item in loader:
-                    kind, buf, meta, rows = item
-                    check(kind == "fused", "host emit must be fused")
-                    # chaos probe: an injected error here kills THIS
-                    # connection mid-epoch (the trainer-side reader sees a
-                    # truncated stream and restarts), the listener lives on
-                    fault_point("ingest.send")
-                    # exact fused size, NOT len(buf): recycled pool buffers
-                    # are over-sized and their dead tail must not ride the
-                    # very link this feature exists to relieve
-                    words = _fused_words_meta(batch_rows, int(meta))
-                    _send_all(conn, _FRAME.pack(
-                        int(meta), words,
-                        _NO_ROWS if rows is None else int(rows)))
-                    _send_all(conn, memoryview(buf[:words]).cast("B"))
-                    loader.recycle(buf)
-                _send_all(conn, _FRAME.pack(0, 0, 0))      # end of stream
+                # one span per served epoch: stage attribution for the
+                # whole partition stream (frame-level work is too hot —
+                # the pack/h2d spans inside DeviceLoader cover it)
+                with teltrace.span("ingest.serve_epoch", part=part,
+                                   nparts=nparts, peer=str(addr)) as sp:
+                    loader = DeviceLoader(
+                        create_parser(uri, part, nparts, fmt,
+                                      nthreads=nthreads, threaded=threaded),
+                        batch_rows=batch_rows, nnz_cap=nnz_cap,
+                        id_mod=id_mod, wire_compact=wire_compact,
+                        emit="host")
+                    frames = 0
+                    for item in loader:
+                        kind, buf, meta, rows = item
+                        check(kind == "fused", "host emit must be fused")
+                        # chaos probe: an injected error here kills THIS
+                        # connection mid-epoch (the trainer-side reader
+                        # sees a truncated stream and restarts), the
+                        # listener lives on
+                        fault_point("ingest.send")
+                        # exact fused size, NOT len(buf): recycled pool
+                        # buffers are over-sized and their dead tail must
+                        # not ride the very link this feature exists to
+                        # relieve
+                        words = _fused_words_meta(batch_rows, int(meta))
+                        _send_all(conn, _FRAME.pack(
+                            int(meta), words,
+                            _NO_ROWS if rows is None else int(rows)))
+                        _send_all(conn, memoryview(buf[:words]).cast("B"))
+                        loader.recycle(buf)
+                        frames += 1
+                    _send_all(conn, _FRAME.pack(0, 0, 0))  # end of stream
+                    sp.attrs["frames"] = frames
             except Exception as e:  # noqa: BLE001 — a server: one bad
                 # connection (trainer vanished, parse/IO error — including
                 # while CONSTRUCTING the loader) must never take down the
@@ -359,7 +371,9 @@ class RemoteIngestLoader:
         view, meta, rows, buf = item
         self._check_frame(view, meta)
         self._maybe_bind()
-        with self._m_h2d.time():
+        with teltrace.span("remote_ingest.h2d",
+                           rows=(None if rows is None else int(rows))), \
+                self._m_h2d.time():
             out = _put_fused_buf(view, self.batch_rows, meta)
             import jax
             jax.block_until_ready(out)

@@ -32,6 +32,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..telemetry import trace as teltrace
 from ..utils.logging import DMLCError, check
 from ..utils.metrics import metrics
 from .engine import InferenceEngine, RequestTooLarge
@@ -53,9 +54,9 @@ class Shutdown(DMLCError):
 
 class _Pending:
     __slots__ = ("ids", "vals", "row_ptr", "rows", "nnz", "deadline",
-                 "t_enq", "future")
+                 "t_enq", "future", "ctx")
 
-    def __init__(self, ids, vals, row_ptr, deadline, t_enq):
+    def __init__(self, ids, vals, row_ptr, deadline, t_enq, ctx=None):
         self.ids = ids
         self.vals = vals
         self.row_ptr = row_ptr
@@ -64,6 +65,7 @@ class _Pending:
         self.deadline = deadline
         self.t_enq = t_enq
         self.future: Future = Future()
+        self.ctx = ctx                 # trace context riding the request
 
 
 class MicroBatcher:
@@ -117,11 +119,15 @@ class MicroBatcher:
     # -- producer side ---------------------------------------------------
     def submit(self, ids: np.ndarray, vals: np.ndarray,
                row_ptr: Optional[np.ndarray] = None,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               trace_ctx: Optional[teltrace.TraceContext] = None) -> Future:
         """Enqueue one CSR request; returns a Future resolving to the
         float32 scores (or raising Overloaded/DeadlineExceeded/Shutdown).
         Oversized and malformed requests fail fast here — they must not
         poison the shared batch they would have ridden in.
+        ``trace_ctx`` (defaults to the ambient context) crosses to the
+        worker thread with the request, so the engine's forward span can
+        join the submitter's trace.
         """
         ids = np.asarray(ids, np.int32)
         vals = np.asarray(vals, np.float32)
@@ -142,9 +148,11 @@ class MicroBatcher:
                 f"{self.max_batch_nnz} nnz)"))
             return f
         now = time.monotonic()
+        if trace_ctx is None:
+            trace_ctx = teltrace.current()
         p = _Pending(ids, vals, row_ptr,
                      now + (self.default_deadline_s if deadline_s is None
-                            else deadline_s), now)
+                            else deadline_s), now, trace_ctx)
         with self._cv:
             if self._closing:
                 p.future.set_exception(Shutdown("batcher is shut down"))
@@ -228,8 +236,14 @@ class MicroBatcher:
                 ptrs.append(p.row_ptr[1:] + off)
                 off += p.nnz
             row_ptr = np.concatenate([np.atleast_1d(x) for x in ptrs])
+            # a batch serves many requests but one engine call: run it
+            # under the first traced request's context so the forward
+            # span joins that trace (the others ride the same batch and
+            # are annotated with its size)
+            ctx = next((p.ctx for p in live if p.ctx is not None), None)
             try:
-                scores = self.engine.predict(ids, vals, row_ptr)
+                with teltrace.activate(ctx):
+                    scores = self.engine.predict(ids, vals, row_ptr)
             except BaseException as e:  # noqa: BLE001 — fan the failure
                 # out to the waiting clients; the worker must survive to
                 # serve the next batch

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry import trace as teltrace
 from ..utils.logging import DMLCError
 from ..utils.metrics import Histogram, metrics
 from ..utils.parameter import get_env
@@ -241,7 +242,15 @@ class PredictClient:
                 return fut
             req_id = self._next_id
             self._next_id += 1
-            frame = REQ_HEADER.pack(req_id, rows, nnz) + frame_tail
+            # the ambient trace context rides the wire header (0/0 when
+            # untraced) so the server's span lands in the caller's trace;
+            # replayed frames keep the original ids — a reconnect is the
+            # same logical request
+            ctx = teltrace.current()
+            trace_id, parent = (ctx.trace_id, ctx.span_id) if ctx \
+                else (0, 0)
+            frame = REQ_HEADER.pack(req_id, trace_id, parent,
+                                    rows, nnz) + frame_tail
             fut._dmlc_req_id = req_id          # predict()'s abandon handle
             self._pending[req_id] = (fut, frame)
             sock = self._sock
@@ -285,13 +294,20 @@ class PredictClient:
             except FutureTimeout:
                 self._abandon(fut)
                 raise
-        try:
-            return self._overload_retry.call(once, deadline=dl)
-        except (RetriesExhausted, DeadlineExpired) as e:
-            cause = e.__cause__
-            if isinstance(cause, ServerOverloaded):
-                raise cause            # contract: overload stays typed
-            raise
+        # root (or child) span for the whole call: submit() reads the
+        # activated context into the wire header, so the server and
+        # engine spans join this trace; overload retries inside the
+        # policy surface as events on this span
+        with teltrace.span(
+                "serving.client.predict",
+                rows=(len(row_ptr) - 1 if row_ptr is not None else 1)):
+            try:
+                return self._overload_retry.call(once, deadline=dl)
+            except (RetriesExhausted, DeadlineExpired) as e:
+                cause = e.__cause__
+                if isinstance(cause, ServerOverloaded):
+                    raise cause        # contract: overload stays typed
+                raise
 
     def close(self) -> None:
         with self._plock:

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry import trace as teltrace
 from ..utils.logging import DMLCError, check, log_info
 from ..utils.metrics import metrics
 
@@ -273,8 +274,12 @@ class InferenceEngine:
         params = self._params          # atomic read: hot-reload safe
         exe = self._get_compiled(bucket)
         self._maybe_rebind()
-        with self._m_fwd.time():
-            out = np.asarray(exe(params, batch))
+        # nested under the batcher-activated request context when the
+        # call came off a traced wire request; a new root otherwise
+        with teltrace.span("serving.engine.forward", rows=rows,
+                           bucket_rows=bucket.rows, bucket_nnz=bucket.nnz):
+            with self._m_fwd.time():
+                out = np.asarray(exe(params, batch))
         self._m_batches.add(1)
         self._m_rows.add(rows)
         self._m_occupancy.set(rows / bucket.rows)

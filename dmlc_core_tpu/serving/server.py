@@ -10,13 +10,18 @@ micro-batcher full.
 
 Wire format (all little-endian)::
 
-    request:   [req_id u64][rows u32][nnz u32]
+    request:   [req_id u64][trace_id u64][parent_span u64][rows u32][nnz u32]
                [row_ptr i32 × (rows+1)][ids i32 × nnz][vals f32 × nnz]
     response:  [req_id u64][status u8][n u32]
                status 0 (OK):  [scores f32 × n]      (n == rows)
                status ≠ 0:     [utf-8 message × n]
     statuses:  0 OK, 1 OVERLOADED, 2 DEADLINE_EXCEEDED, 3 TOO_LARGE,
                4 SHUTDOWN, 5 BAD_REQUEST
+
+``trace_id``/``parent_span`` carry the client's ``telemetry.trace``
+context (0 = untraced): a traced request grows a server-side span that
+parents the engine's forward span, so client→server→engine share one
+trace_id in the Perfetto export (see `docs/observability.md`).
 
 Overload shows up as a **response**, not a dropped connection: clients
 need to distinguish "back off and retry" from "server died".
@@ -37,6 +42,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..telemetry import trace as teltrace
+from ..telemetry.exposition import TelemetryServer
 from ..utils.faults import FaultInjected, fault_point
 from ..utils.logging import DMLCError, log_info, log_warning
 from ..utils.metrics import metrics
@@ -48,7 +55,8 @@ __all__ = ["PredictionServer", "REQ_HEADER", "RSP_HEADER", "STATUS_OK",
            "STATUS_OVERLOADED", "STATUS_DEADLINE", "STATUS_TOO_LARGE",
            "STATUS_SHUTDOWN", "STATUS_BAD_REQUEST", "STATUS_NAMES"]
 
-REQ_HEADER = struct.Struct("<QII")      # req_id, rows, nnz
+REQ_HEADER = struct.Struct("<QQQII")    # req_id, trace_id, parent_span,
+                                        # rows, nnz (trace ids 0 = untraced)
 RSP_HEADER = struct.Struct("<QBI")      # req_id, status, n
 
 STATUS_OK = 0
@@ -98,7 +106,8 @@ class PredictionServer:
                  host: str = "127.0.0.1", port: int = 0,
                  max_delay_s: float = 0.002, max_queue: int = 256,
                  default_deadline_s: float = 1.0,
-                 warmup: bool = True, backlog: int = 64) -> None:
+                 warmup: bool = True, backlog: int = 64,
+                 metrics_port: Optional[int] = None) -> None:
         self.engine = engine
         if warmup:
             engine.warmup_all()
@@ -123,12 +132,24 @@ class PredictionServer:
         # replicas early instead of discovering "overloaded" via sheds
         self._degraded_ratio = float(
             get_env("DMLC_SERVING_DEGRADED_RATIO", 0.75))
+        # telemetry exporter (/metrics /healthz /spans): explicit
+        # metrics_port kwarg, else DMLC_METRICS_PORT (0 = ephemeral,
+        # unset = disabled); /healthz reflects the live health property
+        if metrics_port is None:
+            p = get_env("DMLC_METRICS_PORT", -1)
+            metrics_port = p if p >= 0 else None
+        self.telemetry: Optional[TelemetryServer] = None
+        if metrics_port is not None:
+            self.telemetry = TelemetryServer(
+                port=int(metrics_port), health_fn=lambda: self.health)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "PredictionServer":
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="serving-accept", daemon=True)
         self._accept_thread.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
         log_info("serving: listening on %s:%d (%d buckets, queue=%d)",
                  self.host, self.port, len(self.engine.ladder),
                  self.batcher.max_queue)
@@ -139,6 +160,8 @@ class PredictionServer:
         requests get their answers), then drop connections."""
         self._stopping = True
         self._watch_stop.set()
+        if self.telemetry is not None:
+            self.telemetry.stop()
         # shutdown() before close(): the accept thread blocked inside
         # accept() holds a kernel reference to the listening socket, so a
         # bare close() leaves the port ACCEPTING — a reconnecting client
@@ -280,16 +303,21 @@ class PredictionServer:
             except OSError:
                 pass                   # client gone; reader will notice
 
-        def on_done(req_id: int, fut) -> None:
+        def on_done(req_id: int, fut,
+                    span: Optional[teltrace.Span]) -> None:
             exc = fut.exception()
             if exc is None:
                 scores = np.ascontiguousarray(fut.result(),
                                               dtype=np.float32)
+                if span is not None:
+                    span.end(status="OK")
                 respond(req_id, STATUS_OK, scores.tobytes())
             else:
                 status = _status_of(exc)
                 if status == STATUS_OVERLOADED:
                     metrics.counter("serving.server.shed").add(1)
+                if span is not None:
+                    span.end(status=STATUS_NAMES.get(status, str(status)))
                 respond(req_id, status,
                         str(exc).encode("utf-8", "replace"))
 
@@ -298,13 +326,28 @@ class PredictionServer:
                 head = _recv_exact(conn, REQ_HEADER.size)
                 if head is None:
                     return
-                req_id, rows, nnz = REQ_HEADER.unpack(head)
+                req_id, trace_id, parent_span, rows, nnz = \
+                    REQ_HEADER.unpack(head)
+                # traced requests (non-zero trace_id in the header) get a
+                # server span parented on the client's wire context; the
+                # span object travels with the request and is ended from
+                # the completion callback — requests finish out of order
+                span = None
+                if trace_id:
+                    span = teltrace.start_span(
+                        "serving.server.request",
+                        parent=teltrace.TraceContext(trace_id, parent_span),
+                        req_id=req_id, rows=rows, nnz=nnz, conn=cid)
                 if rows == 0 or rows > _MAX_ROWS or nnz > _MAX_NNZ:
+                    if span is not None:
+                        span.end(status="BAD_REQUEST")
                     respond(req_id, STATUS_BAD_REQUEST,
                             f"bad header rows={rows} nnz={nnz}".encode())
                     return             # framing is broken; drop the conn
                 payload = _recv_exact(conn, 4 * (rows + 1) + 8 * nnz)
                 if payload is None:
+                    if span is not None:
+                        span.end(status="DISCONNECT")
                     return
                 row_ptr = np.frombuffer(payload, np.int32, rows + 1, 0)
                 ids = np.frombuffer(payload, np.int32, nnz,
@@ -318,12 +361,16 @@ class PredictionServer:
                     fault_point("serving.server.admit")
                 except FaultInjected as e:
                     metrics.counter("serving.server.shed").add(1)
+                    if span is not None:
+                        span.end(status="OVERLOADED", injected=True)
                     respond(req_id, STATUS_OVERLOADED, str(e).encode())
                     continue
                 fut = self.batcher.submit(ids, vals,
-                                          row_ptr.astype(np.int64))
+                                          row_ptr.astype(np.int64),
+                                          trace_ctx=(span.context
+                                                     if span else None))
                 fut.add_done_callback(
-                    lambda f, rid=req_id: on_done(rid, f))
+                    lambda f, rid=req_id, sp=span: on_done(rid, f, sp))
         except OSError as e:
             log_info("serving: connection %d ended: %r", cid, e)
         finally:

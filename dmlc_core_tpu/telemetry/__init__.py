@@ -1,0 +1,56 @@
+"""Cluster-wide telemetry plane: trace propagation, Chrome/Perfetto
+export, Prometheus exposition, and tracker-side aggregation.
+
+Layers (see ``docs/observability.md``):
+
+* :mod:`telemetry.trace` — ``TraceContext`` / ``span()`` propagation and
+  the process-global span ring buffer.
+* :mod:`telemetry.chrome_trace` — export recorded spans as Chrome
+  trace-event JSON (open in Perfetto).
+* :mod:`telemetry.exposition` — Prometheus text rendering and the
+  ``/metrics`` / ``/healthz`` / ``/spans`` HTTP exporter.
+* :mod:`telemetry.aggregate` — merge rank-tagged registry states into
+  the tracker's fleet view.
+
+Everything here is stdlib-only on top of ``utils.metrics`` — safe to
+import in any process, including JAX-less tooling.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from .aggregate import merge_states, render_fleet, state_to_snapshot
+from .chrome_trace import to_chrome_trace, write_chrome_trace
+from .exposition import (TelemetryServer, maybe_start_from_env,
+                         render_prometheus, render_series)
+from .trace import (Span, SpanRecorder, TraceContext, activate, add_event,
+                    current, current_trace_id, format_id, new_trace_id,
+                    recorder, span, start_span)
+
+__all__ = [
+    "TraceContext", "Span", "SpanRecorder", "recorder", "span",
+    "start_span", "activate", "add_event", "current", "current_trace_id",
+    "new_trace_id", "format_id",
+    "to_chrome_trace", "write_chrome_trace",
+    "render_prometheus", "render_series", "TelemetryServer",
+    "maybe_start_from_env",
+    "merge_states", "state_to_snapshot", "render_fleet",
+    "dump_artifacts",
+]
+
+
+def dump_artifacts(prefix: str, registry=None) -> dict:
+    """Benchmark-exit hook (``--telemetry-out``): write
+    ``<prefix>.metrics.json`` (registry snapshot) and
+    ``<prefix>.trace.json`` (Chrome trace of recorded spans).
+    Returns ``{"metrics": path, "trace": path}``."""
+    if registry is None:
+        from ..utils.metrics import metrics as registry   # type: ignore
+    metrics_path = f"{prefix}.metrics.json"
+    with open(metrics_path, "w", encoding="utf-8") as f:
+        json.dump({"snapshot": registry.snapshot()}, f, indent=2,
+                  sort_keys=True, default=str)
+    trace_path = write_chrome_trace(f"{prefix}.trace.json")
+    return {"metrics": metrics_path, "trace": trace_path}

@@ -1,0 +1,87 @@
+"""Tracker-side merging of rank-tagged registry states.
+
+Workers push ``MetricsRegistry.state()`` dicts (histograms carry their
+reservoir samples) to the tracker over the tracker protocol; this module
+folds a ``{rank: state}`` map into one fleet snapshot and renders the
+combined ``/metrics`` page: merged series first (unlabeled — the scrape
+target for dashboards), then every contributing rank re-rendered with a
+``rank="N"`` label for drill-down.
+
+Merge semantics live with the metric classes (``Counter.merge``,
+``Histogram.merge`` over serialized reservoirs, ...); this module only
+groups by name/type and skips conflicting types rather than guessing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.metrics import (Counter, Gauge, Histogram, StageTimer,
+                             ThroughputMeter)
+from .exposition import render_series
+
+__all__ = ["merge_states", "state_to_snapshot", "render_fleet"]
+
+_MERGERS = {
+    "counter": Counter.merge,
+    "gauge": Gauge.merge,
+    "histogram": Histogram.merge,
+    "throughput": ThroughputMeter.merge,
+    "stage": StageTimer.merge,
+}
+
+
+def merge_states(per_rank: Dict[str, Dict[str, Dict[str, Any]]]
+                 ) -> Dict[str, Dict[str, Any]]:
+    """``{rank: {metric_name: state}}`` → merged snapshot-form dict.
+
+    A metric name reported with different types by different ranks (a
+    version skew symptom) is dropped from the merged view — the per-rank
+    sections still show both sides of the skew.
+    """
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for state in per_rank.values():
+        for name, s in (state or {}).items():
+            if isinstance(s, dict):
+                by_name.setdefault(name, []).append(s)
+    merged: Dict[str, Dict[str, Any]] = {}
+    for name, states in sorted(by_name.items()):
+        types = {s.get("type") for s in states}
+        if len(types) != 1:
+            continue
+        merger = _MERGERS.get(next(iter(types)))
+        if merger is not None:
+            merged[name] = merger(states)
+    return merged
+
+
+def state_to_snapshot(state: Dict[str, Dict[str, Any]]
+                      ) -> Dict[str, Dict[str, Any]]:
+    """Make one rank's serialized state renderable: histogram reservoir
+    states become quantile snapshots (a merge of one); everything else is
+    already in snapshot form."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, s in (state or {}).items():
+        if isinstance(s, dict) and s.get("type") == "histogram" \
+                and "samples" in s:
+            out[name] = Histogram.merge([s])
+        elif isinstance(s, dict):
+            out[name] = s
+    return out
+
+
+def render_fleet(per_rank: Dict[str, Dict[str, Dict[str, Any]]],
+                 own_snapshot: Optional[Dict[str, Dict[str, Any]]] = None,
+                 prefix: str = "dmlc") -> str:
+    """The tracker's ``/metrics`` page: merged fleet series, then
+    per-rank ``rank="N"`` drill-down series, then (optionally) the
+    tracker's own registry labeled ``rank="tracker"``."""
+    series: List[Tuple[Optional[Dict[str, str]],
+                       Dict[str, Dict[str, Any]]]] = []
+    series.append((None, merge_states(per_rank)))
+    for rank in sorted(per_rank, key=str):
+        series.append(({"rank": str(rank)},
+                       state_to_snapshot(per_rank[rank])))
+    if own_snapshot:
+        series.append(({"rank": "tracker"}, own_snapshot))
+    return render_series(series, prefix=prefix)

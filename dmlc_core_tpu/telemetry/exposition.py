@@ -1,0 +1,275 @@
+"""Prometheus text exposition + stdlib HTTP exporter.
+
+:func:`render_prometheus` turns any ``MetricsRegistry.snapshot()`` into
+Prometheus text format 0.0.4 — no client library, just the format:
+
+* counter      → ``dmlc_<name>_total``
+* gauge        → ``dmlc_<name>``
+* histogram    → summary-style ``{quantile="0.5|0.95|0.99"}`` series plus
+  ``_sum`` / ``_count`` (reservoir quantiles are pre-computed, which is a
+  summary, not a Prometheus histogram's cumulative buckets)
+* throughput   → ``_total`` counter + ``_rate`` / ``_windowed_rate`` gauges
+* stage        → ``_seconds_total`` counter + ``_count`` + ``_mean_seconds``
+
+:func:`render_series` renders several labeled snapshots (e.g. one per
+rank plus a merged fleet view) into one page with each ``# TYPE`` header
+emitted once per family, which is what the tracker's ``/metrics`` serves.
+
+:class:`TelemetryServer` is a daemon-thread ``ThreadingHTTPServer``
+mounting ``/metrics``, ``/healthz``, and ``/spans``.  The serving server
+mounts one when ``metrics_port`` / ``DMLC_METRICS_PORT`` is set, the
+tracker mounts one for the fleet view, and
+:func:`maybe_start_from_env` lets any process self-serve its registry.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import log_info, log_warning
+from ..utils.parameter import get_env
+from . import trace as _trace
+
+__all__ = ["render_prometheus", "render_series", "TelemetryServer",
+           "maybe_start_from_env"]
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: health states a health_fn may return, with their HTTP mapping
+_HEALTH_HTTP = {"ok": 200, "degraded": 200, "overloaded": 503}
+
+
+def _sanitize(name: str) -> str:
+    """``serving.client.retries`` → ``serving_client_retries``."""
+    name = _NAME_BAD.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _fmt_labels(labels: Optional[Dict[str, str]],
+                extra: Optional[Dict[str, str]] = None) -> str:
+    merged: Dict[str, str] = dict(labels or {})
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_val(v: Any) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def _family_samples(name: str, snap: Dict[str, Any],
+                    labels: Optional[Dict[str, str]], prefix: str
+                    ) -> List[Tuple[str, str, List[str]]]:
+    """One snapshot entry → list of (family_name, prom_type, sample_lines)."""
+    base = f"{prefix}_{_sanitize(name)}" if prefix else _sanitize(name)
+    t = snap.get("type")
+    lab = lambda extra=None: _fmt_labels(labels, extra)  # noqa: E731
+    if t == "counter":
+        return [(f"{base}_total", "counter",
+                 [f"{base}_total{lab()} {_fmt_val(snap.get('value', 0))}"])]
+    if t == "gauge":
+        return [(base, "gauge",
+                 [f"{base}{lab()} {_fmt_val(snap.get('value', 0.0))}"])]
+    if t == "histogram":
+        count = int(snap.get("count", 0))
+        mean = float(snap.get("mean", 0.0))
+        lines = [
+            f"{base}{lab({'quantile': q})} {_fmt_val(snap.get(p, 0.0))}"
+            for q, p in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+        ]
+        lines.append(f"{base}_sum{lab()} {_fmt_val(mean * count)}")
+        lines.append(f"{base}_count{lab()} {count}")
+        return [(base, "summary", lines)]
+    if t == "throughput":
+        return [
+            (f"{base}_total", "counter",
+             [f"{base}_total{lab()} {_fmt_val(snap.get('total', 0))}"]),
+            (f"{base}_rate", "gauge",
+             [f"{base}_rate{lab()} {_fmt_val(snap.get('rate', 0.0))}"]),
+            (f"{base}_windowed_rate", "gauge",
+             [f"{base}_windowed_rate{lab()} "
+              f"{_fmt_val(snap.get('windowed_rate', 0.0))}"]),
+        ]
+    if t == "stage":
+        return [
+            (f"{base}_seconds_total", "counter",
+             [f"{base}_seconds_total{lab()} "
+              f"{_fmt_val(snap.get('total_sec', 0.0))}"]),
+            (f"{base}_count", "counter",
+             [f"{base}_count{lab()} {_fmt_val(snap.get('count', 0))}"]),
+            (f"{base}_mean_seconds", "gauge",
+             [f"{base}_mean_seconds{lab()} "
+              f"{_fmt_val(snap.get('mean_sec', 0.0))}"]),
+        ]
+    return []   # unknown type: skip rather than emit malformed text
+
+
+def render_series(series: Sequence[Tuple[Optional[Dict[str, str]],
+                                         Dict[str, Dict[str, Any]]]],
+                  prefix: str = "dmlc") -> str:
+    """Render labeled snapshots into one exposition page.
+
+    ``series`` is ``[(labels_or_None, snapshot), ...]``; samples of the
+    same family from different label sets share a single ``# TYPE``
+    header (duplicated headers are invalid exposition format).
+    """
+    families: Dict[str, Tuple[str, List[str]]] = {}
+    order: List[str] = []
+    for labels, snapshot in series:
+        for name in sorted(snapshot):
+            for fam, ptype, lines in _family_samples(
+                    name, snapshot[name], labels, prefix):
+                if fam not in families:
+                    families[fam] = (ptype, [])
+                    order.append(fam)
+                families[fam][1].extend(lines)
+    out: List[str] = []
+    for fam in order:
+        ptype, lines = families[fam]
+        out.append(f"# TYPE {fam} {ptype}")
+        out.extend(lines)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def render_prometheus(snapshot: Dict[str, Dict[str, Any]],
+                      labels: Optional[Dict[str, str]] = None,
+                      prefix: str = "dmlc") -> str:
+    """Prometheus text format 0.0.4 for one registry snapshot."""
+    return render_series([(labels, snapshot)], prefix=prefix)
+
+
+class TelemetryServer:
+    """Daemon-thread HTTP exporter: ``/metrics`` (Prometheus text),
+    ``/healthz`` (JSON status, 503 when overloaded), ``/spans`` (recent
+    span records as JSON).
+
+    All three content callbacks are injectable so the same class serves a
+    process-local registry (serving server, standalone exporter) or the
+    tracker's merged fleet view.  ``port=0`` binds an ephemeral port —
+    read it back from :attr:`port` (tests and same-host discovery).
+    """
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0", *,
+                 metrics_fn: Optional[Callable[[], str]] = None,
+                 health_fn: Optional[Callable[[], str]] = None,
+                 spans_fn: Optional[Callable[[], List[Dict[str, Any]]]] = None,
+                 ) -> None:
+        if metrics_fn is None:
+            from ..utils.metrics import metrics as _registry
+            metrics_fn = lambda: render_prometheus(_registry.snapshot())  # noqa: E731
+        if health_fn is None:
+            health_fn = self._default_health
+        if spans_fn is None:
+            spans_fn = _trace.recorder.snapshot
+        self._metrics_fn = metrics_fn
+        self._health_fn = health_fn
+        self._spans_fn = spans_fn
+        self._requested = (host, int(port))
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_health() -> str:
+        """Standalone exporters report the serving health gauge when the
+        process runs a server (0 ok / 1 degraded / 2 overloaded), else ok."""
+        from ..utils.metrics import metrics as _registry
+        v = _registry.gauge("serving.server.health").value
+        return {0: "ok", 1: "degraded", 2: "overloaded"}.get(int(v), "ok")
+
+    @property
+    def port(self) -> int:
+        """The bound port (resolves ``port=0`` ephemeral binds)."""
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        return self._requested[1]
+
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # route into our logger
+                pass
+
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def do_GET(self):   # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        body = outer._metrics_fn().encode("utf-8")
+                        self._send(200, "text/plain; version=0.0.4; "
+                                        "charset=utf-8", body)
+                    elif path == "/healthz":
+                        status = outer._health_fn()
+                        code = _HEALTH_HTTP.get(status, 200)
+                        self._send(code, "application/json",
+                                   json.dumps({"status": status})
+                                   .encode("utf-8"))
+                    elif path == "/spans":
+                        self._send(200, "application/json",
+                                   json.dumps({"spans": outer._spans_fn()})
+                                   .encode("utf-8"))
+                    else:
+                        self._send(404, "text/plain", b"not found\n")
+                except Exception as e:   # scrape must never kill the server
+                    self._send(500, "text/plain",
+                               f"exporter error: {e}\n".encode("utf-8"))
+
+        self._httpd = ThreadingHTTPServer(self._requested, Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dmlc-telemetry",
+            daemon=True)
+        self._thread.start()
+        log_info("telemetry exporter listening on %s:%d "
+                 "(/metrics /healthz /spans)", self._requested[0], self.port)
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def maybe_start_from_env() -> Optional[TelemetryServer]:
+    """Start a process-local exporter when ``DMLC_METRICS_PORT`` is set
+    (0 = ephemeral).  Returns the running server or None.  Startup
+    failures (port in use) are logged, not raised — telemetry must not
+    take the workload down."""
+    port = get_env("DMLC_METRICS_PORT", -1)
+    if port < 0:
+        return None
+    try:
+        return TelemetryServer(port=port).start()
+    except OSError as e:
+        log_warning("telemetry exporter failed to bind port %d: %s", port, e)
+        return None

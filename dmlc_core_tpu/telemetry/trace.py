@@ -1,0 +1,278 @@
+"""Trace context propagation + in-process span recording.
+
+``utils.metrics`` answers *how much / how fast*; this module answers
+*where did this request go*.  A :class:`TraceContext` is a pair of ids
+(``trace_id`` for the whole request tree, ``span_id`` for the current
+operation) carried in a ``contextvars.ContextVar`` so it follows the
+logical call chain — including across ``with``-scoped helper layers —
+without threading an argument through every signature.  Crossing a
+thread or a wire is explicit: pack ``current()`` ids into the message
+(the serving protocol carries them in the request header) and
+:func:`activate` the reconstructed context on the other side.
+
+Finished spans land in a process-global lock-protected ring buffer
+(:class:`SpanRecorder`): bounded memory, newest-wins, cheap enough for
+per-request recording.  Consumers are ``telemetry.chrome_trace``
+(Perfetto export) and the ``/spans`` endpoint of
+``telemetry.exposition``.
+
+Usage::
+
+    with span("serving.client.predict", rows=4):        # scoped span
+        ...                                             # children nest
+
+    s = start_span("serving.server.request", parent=ctx)  # manual span
+    ...                                                   # (async paths)
+    s.end(status="OK")
+
+    add_event("retry", attempt=2)   # annotate the active span, if any
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Union
+
+from ..utils.parameter import get_env
+
+__all__ = [
+    "TraceContext", "Span", "SpanRecorder", "recorder", "current",
+    "current_trace_id", "new_trace_id", "start_span", "span", "activate",
+    "add_event", "format_id",
+]
+
+
+class TraceContext(NamedTuple):
+    """Wire-portable identity of an in-progress span: 64-bit non-zero
+    ``trace_id`` shared by every span of one request tree, plus the
+    ``span_id`` new children must name as their parent."""
+
+    trace_id: int
+    span_id: int
+
+
+def format_id(v: int) -> str:
+    """Canonical hex rendering (what logs/exports show)."""
+    return f"{v & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
+# one RNG for id generation; os.urandom-seeded so forked workers diverge
+_id_rng = random.Random(int.from_bytes(os.urandom(8), "little"))
+_id_lock = threading.Lock()
+
+
+def new_trace_id() -> int:
+    """Random non-zero 64-bit id (zero is the wire's 'untraced' marker)."""
+    with _id_lock:
+        return _id_rng.randrange(1, 1 << 64)
+
+
+class SpanRecorder:
+    """Lock-protected ring buffer of finished span/event records.
+
+    Records are plain JSON-ready dicts (see :meth:`Span.end` for the
+    schema) so exports never touch live objects.  Bounded by
+    ``capacity`` (env ``DMLC_SPAN_BUFFER``): under sustained load old
+    spans fall off the back — observability must never become the
+    memory leak it exists to find.
+    """
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=max(1, int(capacity)))
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._buf.append(rec)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+
+#: process-global recorder (the /spans endpoint and Chrome export read it)
+recorder = SpanRecorder(capacity=get_env("DMLC_SPAN_BUFFER", 4096))
+
+# The active node of the logical call chain: a live Span in-process, or a
+# bare TraceContext re-activated after crossing a thread/wire boundary.
+_current: contextvars.ContextVar[Optional[Union["Span", TraceContext]]] = \
+    contextvars.ContextVar("dmlc_trace", default=None)
+
+
+def _ids_of(node: Union["Span", TraceContext, None]) -> Optional[TraceContext]:
+    if node is None:
+        return None
+    if isinstance(node, TraceContext):
+        return node
+    return node.context
+
+
+def current() -> Optional[TraceContext]:
+    """The active trace context (ids only), or None when untraced."""
+    return _ids_of(_current.get())
+
+
+def current_trace_id() -> Optional[str]:
+    """Hex trace id of the active context (log-correlation helper)."""
+    ctx = current()
+    return format_id(ctx.trace_id) if ctx is not None else None
+
+
+class Span:
+    """One timed operation.  Created via :func:`start_span` / :func:`span`;
+    finished exactly once with :meth:`end` (idempotent — async completion
+    paths may race a cleanup path)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "events", "_t0_wall", "_t0_mono", "_tid", "_thread",
+                 "_ended")
+
+    def __init__(self, name: str, trace_id: int, span_id: int,
+                 parent_id: Optional[int], attrs: Dict[str, Any]) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.events: List[Dict[str, Any]] = []
+        self._t0_wall = time.time()
+        self._t0_mono = time.monotonic()
+        t = threading.current_thread()
+        self._tid = t.ident or 0
+        self._thread = t.name
+        self._ended = False
+
+    @property
+    def context(self) -> TraceContext:
+        """What children (local or remote) name as their parent."""
+        return TraceContext(self.trace_id, self.span_id)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Attach a point-in-time annotation (retry, breaker trip, ...)."""
+        self.events.append({
+            "name": name,
+            "ts_us": int(time.time() * 1e6),
+            "attrs": _jsonable(attrs),
+        })
+
+    def end(self, **attrs: Any) -> None:
+        """Finish the span and push its record into the ring buffer."""
+        if self._ended:
+            return
+        self._ended = True
+        if attrs:
+            self.attrs.update(attrs)
+        recorder.record({
+            "kind": "span",
+            "name": self.name,
+            "trace_id": format_id(self.trace_id),
+            "span_id": format_id(self.span_id),
+            "parent_id": (format_id(self.parent_id)
+                          if self.parent_id else None),
+            "ts_us": int(self._t0_wall * 1e6),
+            "dur_us": max(0, int((time.monotonic() - self._t0_mono) * 1e6)),
+            "pid": os.getpid(),
+            "tid": self._tid,
+            "thread": self._thread,
+            "attrs": _jsonable(self.attrs),
+            "events": self.events,
+        })
+
+
+def _jsonable(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    """Attrs must survive json.dumps — coerce exotic values to str."""
+    out: Dict[str, Any] = {}
+    for k, v in attrs.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        else:
+            try:
+                json.dumps(v)
+                out[k] = v
+            except (TypeError, ValueError):
+                out[k] = str(v)
+    return out
+
+
+def start_span(name: str, parent: Optional[TraceContext] = None,
+               **attrs: Any) -> Span:
+    """Create a span WITHOUT activating it (async server paths hold the
+    object and ``end()`` it from a completion callback).  ``parent``
+    defaults to the ambient context; with neither, the span roots a new
+    trace."""
+    if parent is None:
+        parent = current()
+    if parent is None:
+        trace_id, parent_id = new_trace_id(), None
+    else:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    return Span(name, trace_id, new_trace_id(), parent_id, _jsonable(attrs))
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[Span]:
+    """Scoped span: child of the ambient context, active for the block,
+    ended on exit (exceptions recorded as ``error`` before re-raising)."""
+    s = start_span(name, **attrs)
+    token = _current.set(s)
+    try:
+        yield s
+    except BaseException as e:
+        s.end(error=f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        _current.reset(token)
+        s.end()
+
+
+@contextlib.contextmanager
+def activate(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """Re-enter a context that crossed a thread or wire boundary (ids
+    only — the originating span keeps ownership of its record).  ``None``
+    is a no-op so call sites need no branching."""
+    if ctx is None:
+        yield
+        return
+    token = _current.set(ctx)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+def add_event(name: str, **attrs: Any) -> None:
+    """Annotate the active span; with only a re-activated context (or no
+    trace at all) record a standalone instant event instead, so signals
+    like retries are never dropped on untraced paths."""
+    node = _current.get()
+    if isinstance(node, Span):
+        node.event(name, **attrs)
+        return
+    ctx = _ids_of(node)
+    t = threading.current_thread()
+    recorder.record({
+        "kind": "event",
+        "name": name,
+        "trace_id": format_id(ctx.trace_id) if ctx else None,
+        "span_id": format_id(ctx.span_id) if ctx else None,
+        "ts_us": int(time.time() * 1e6),
+        "pid": os.getpid(),
+        "tid": t.ident or 0,
+        "thread": t.name,
+        "attrs": _jsonable(attrs),
+    })

@@ -4,7 +4,7 @@ from .logging import (  # noqa: F401
     DMLCError, ParamError, IdOverflowError,
     check, check_eq, check_ne, check_lt, check_le, check_gt, check_ge,
     check_notnull, log_info, log_warning, log_error, log_fatal,
-    set_log_sink, get_logger, PeriodicLogger,
+    set_log_sink, set_log_context, get_logger, PeriodicLogger,
 )
 from .registry import Registry, RegistryEntry  # noqa: F401
 from .parameter import Parameter, field, FieldEntry, get_env  # noqa: F401

@@ -14,11 +14,12 @@ a customizable sink (``DMLC_LOG_CUSTOMIZE`` `logging.h:142`), and a date logger
 
 from __future__ import annotations
 
+import json
 import logging as _pylogging
 import os
 import sys
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 __all__ = [
     "DMLCError",
@@ -36,6 +37,7 @@ __all__ = [
     "log_error",
     "log_fatal",
     "set_log_sink",
+    "set_log_context",
     "get_logger",
     "IdOverflowError",
 ]
@@ -79,7 +81,65 @@ def set_log_sink(sink: Optional[Callable[[str, str], None]]) -> None:
     _custom_sink = sink
 
 
+# Process-wide log correlation fields.  ``rank`` is set by the collective
+# layer once the tracker assigns it (env DMLC_RANK seeds launcher-spawned
+# processes); the live trace id is looked up per record.
+_log_ctx: Dict[str, Any] = {}
+_r = os.environ.get("DMLC_RANK")
+if _r is not None and _r.lstrip("-").isdigit():
+    _log_ctx["rank"] = int(_r)
+del _r
+
+
+def set_log_context(**fields: Any) -> None:
+    """Attach correlation fields (``rank=...``) to every subsequent log
+    record; ``None`` removes a field."""
+    for k, v in fields.items():
+        if v is None:
+            _log_ctx.pop(k, None)
+        else:
+            _log_ctx[k] = v
+
+
+def _live_trace_id() -> Optional[str]:
+    """Active trace id, if the telemetry plane is loaded AND a trace is
+    live on this logical thread.  Looked up via sys.modules so logging —
+    imported by everything — never imports telemetry (which imports
+    utils back): the cost when telemetry is unused is one dict miss."""
+    mod = sys.modules.get("dmlc_core_tpu.telemetry.trace")
+    if mod is None:
+        return None
+    try:
+        return mod.current_trace_id()
+    except Exception:
+        return None
+
+
+def _record_fields(severity: str, msg: str) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {
+        "ts": time.time(), "level": severity, "msg": msg}
+    rec.update(_log_ctx)
+    trace_id = _live_trace_id()
+    if trace_id is not None:
+        rec["trace_id"] = trace_id
+    return rec
+
+
 def _emit(severity: str, msg: str) -> None:
+    rec = _record_fields(severity, msg)
+    if os.environ.get("DMLC_LOG_FORMAT", "").lower() == "json":
+        # JSON-lines for log shippers: write the line directly (the text
+        # formatter's "[time] LEVEL " prefix would corrupt the JSON)
+        line = json.dumps(rec, default=str)
+        if _custom_sink is not None:
+            _custom_sink(severity, line)
+        else:
+            print(line, file=sys.stderr, flush=True)
+        return
+    suffix = " ".join(f"{k}={v}" for k, v in rec.items()
+                      if k not in ("ts", "level", "msg"))
+    if suffix:
+        msg = f"{msg} [{suffix}]"
     if _custom_sink is not None:
         _custom_sink(severity, msg)
         return

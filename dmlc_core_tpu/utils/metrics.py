@@ -58,7 +58,17 @@ class Counter:
         return self._v
 
     def snapshot(self) -> Dict[str, Any]:
-        return {"type": "counter", "value": self._v}
+        with self._lock:
+            return {"type": "counter", "value": self._v}
+
+    def state(self) -> Dict[str, Any]:
+        """Serialized mergeable state (same as snapshot for counters)."""
+        return self.snapshot()
+
+    @classmethod
+    def merge(cls, states: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        return {"type": "counter",
+                "value": sum(int(s.get("value", 0)) for s in states)}
 
 
 class Gauge:
@@ -76,6 +86,16 @@ class Gauge:
 
     def snapshot(self) -> Dict[str, Any]:
         return {"type": "gauge", "value": self._v}
+
+    def state(self) -> Dict[str, Any]:
+        return self.snapshot()
+
+    @classmethod
+    def merge(cls, states: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        """Fleet view of a gauge is the worst (max) rank — health-style
+        gauges encode severity as magnitude (0 ok / 1 degraded / ...)."""
+        vals = [float(s.get("value", 0.0)) for s in states]
+        return {"type": "gauge", "value": max(vals) if vals else 0.0}
 
 
 class Histogram:
@@ -149,14 +169,14 @@ class Histogram:
     def quantile(self, q: float) -> float:
         return self.quantiles([q])[0]
 
-    def quantiles(self, qs: Sequence[float]) -> List[float]:
-        """Linear interpolation between closest ranks (numpy's default),
-        computed over the (possibly sampled) observation set."""
+    @staticmethod
+    def _interp(sorted_samples: List[float], qs: Sequence[float]
+                ) -> List[float]:
+        """Linear interpolation between closest ranks (numpy's default)."""
         for q in qs:
             if not 0.0 <= q <= 1.0:
                 raise ValueError(f"quantile must be in [0, 1], got {q}")
-        with self._lock:
-            s = sorted(self._samples)
+        s = sorted_samples
         if not s:
             return [0.0 for _ in qs]
         out = []
@@ -167,11 +187,108 @@ class Histogram:
             out.append(s[lo] + (pos - lo) * (s[hi] - s[lo]))
         return out
 
+    def quantiles(self, qs: Sequence[float]) -> List[float]:
+        """Quantiles over the (possibly sampled) observation set."""
+        with self._lock:
+            s = sorted(self._samples)
+        return self._interp(s, qs)
+
     def snapshot(self) -> Dict[str, Any]:
-        p50, p95, p99 = self.quantiles([0.5, 0.95, 0.99])
-        return {"type": "histogram", "count": self._count,
-                "mean": self.mean, "min": self.min, "max": self.max,
+        # One lock acquisition for the whole view: quantiles, count, and
+        # moments must describe the same instant or a concurrent observe()
+        # tears the snapshot (count ahead of sum, quantile behind max).
+        with self._lock:
+            count, sum_ = self._count, self._sum
+            mn = self._min if count else 0.0
+            mx = self._max if count else 0.0
+            s = sorted(self._samples)
+        p50, p95, p99 = self._interp(s, [0.5, 0.95, 0.99])
+        return {"type": "histogram", "count": count,
+                "mean": sum_ / count if count else 0.0, "min": mn, "max": mx,
                 "p50": p50, "p95": p95, "p99": p99}
+
+    def state(self) -> Dict[str, Any]:
+        """Serialized reservoir state — exact moments + the sample set —
+        consistent under one lock.  This is what ranks ship to the tracker;
+        :meth:`merge` reconstructs fleet quantiles from a list of these."""
+        with self._lock:
+            count = self._count
+            return {"type": "histogram", "count": count, "sum": self._sum,
+                    "min": self._min if count else 0.0,
+                    "max": self._max if count else 0.0,
+                    "samples": list(self._samples)}
+
+    @classmethod
+    def merge(cls, states: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        """Merge serialized states into one snapshot-form dict.
+
+        Moments (count/sum/min/max) merge exactly.  Quantiles come from
+        the union of reservoirs with each sample weighted by how many
+        observations it stands for (``count_i / len(samples_i)``), so a
+        rank that saw 10x the traffic pulls the fleet quantile 10x harder.
+        Exact when no reservoir ever overflowed (weights all 1).
+        """
+        count = 0
+        sum_ = 0.0
+        mn, mx = math.inf, -math.inf
+        weighted: List[Any] = []   # (value, weight) pairs
+        for s in states:
+            c = int(s.get("count", 0))
+            if c <= 0:
+                continue
+            count += c
+            sum_ += float(s.get("sum", 0.0))
+            mn = min(mn, float(s.get("min", math.inf)))
+            mx = max(mx, float(s.get("max", -math.inf)))
+            samples = s.get("samples") or []
+            if samples:
+                w = c / len(samples)
+                weighted.extend((float(v), w) for v in samples)
+        if not count:
+            return {"type": "histogram", "count": 0, "mean": 0.0,
+                    "min": 0.0, "max": 0.0, "p50": 0.0, "p95": 0.0,
+                    "p99": 0.0}
+        weighted.sort(key=lambda vw: vw[0])
+        p50, p95, p99 = cls._weighted_quantiles(weighted, [0.5, 0.95, 0.99])
+        return {"type": "histogram", "count": count, "mean": sum_ / count,
+                "min": mn, "max": mx, "p50": p50, "p95": p95, "p99": p99}
+
+    @staticmethod
+    def _weighted_quantiles(sorted_vw: List[Any], qs: Sequence[float]
+                            ) -> List[float]:
+        """Weighted quantiles by the midpoint rule: sample i sits at
+        cumulative position ``cum_i - w_i/2``; interpolate between the
+        bracketing samples.  Reduces to :meth:`_interp` for equal weights."""
+        total_w = sum(w for _, w in sorted_vw)
+        if total_w <= 0:
+            return [0.0 for _ in qs]
+        pos = []
+        cum = 0.0
+        for _, w in sorted_vw:
+            pos.append(cum + w / 2.0)
+            cum += w
+        out = []
+        for q in qs:
+            target = q * total_w
+            if target <= pos[0]:
+                out.append(sorted_vw[0][0])
+                continue
+            if target >= pos[-1]:
+                out.append(sorted_vw[-1][0])
+                continue
+            # binary search for the bracketing pair
+            lo, hi = 0, len(pos) - 1
+            while hi - lo > 1:
+                mid = (lo + hi) // 2
+                if pos[mid] <= target:
+                    lo = mid
+                else:
+                    hi = mid
+            v0, v1 = sorted_vw[lo][0], sorted_vw[hi][0]
+            span = pos[hi] - pos[lo]
+            frac = (target - pos[lo]) / span if span > 0 else 0.0
+            out.append(v0 + frac * (v1 - v0))
+        return out
 
 
 class ThroughputMeter:
@@ -208,27 +325,51 @@ class ThroughputMeter:
     def total(self) -> int:
         return self._total
 
+    def _rate_locked(self, now: float) -> float:
+        dt = now - self._start
+        return self._total / dt if dt > 0 else 0.0
+
+    def _windowed_locked(self, now: float) -> float:
+        elapsed = now - self._win_start
+        if elapsed >= self._window:
+            # window overdue: rate over the open (possibly stalled) span
+            return self._win_total / elapsed
+        if self._win_closed:
+            return self._win_rate
+        return self._rate_locked(now)   # before the first window closes
+
     def rate(self) -> float:
         """Overall units/sec since construction."""
-        dt = self._clock() - self._start
-        return self._total / dt if dt > 0 else 0.0
+        with self._lock:
+            return self._rate_locked(self._clock())
 
     def windowed_rate(self) -> float:
         """Units/sec over the current/most recent window. A stalled stream
         (no ``add`` calls) decays toward 0 as the open window ages — it must
         NOT keep reporting the last healthy rate."""
         with self._lock:
-            elapsed = self._clock() - self._win_start
-            if elapsed >= self._window:
-                # window overdue: rate over the open (possibly stalled) span
-                return self._win_total / elapsed
-            if self._win_closed:
-                return self._win_rate
-            return self.rate()      # before the first window closes
+            return self._windowed_locked(self._clock())
 
     def snapshot(self) -> Dict[str, Any]:
-        return {"type": "throughput", "total": self._total,
-                "rate": self.rate(), "windowed_rate": self.windowed_rate()}
+        # total and both rates read at one instant under one lock — a
+        # concurrent add() between them would report rate ahead of total
+        with self._lock:
+            now = self._clock()
+            return {"type": "throughput", "total": self._total,
+                    "rate": self._rate_locked(now),
+                    "windowed_rate": self._windowed_locked(now)}
+
+    def state(self) -> Dict[str, Any]:
+        return self.snapshot()
+
+    @classmethod
+    def merge(cls, states: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        """Totals and rates sum across ranks (parallel streams)."""
+        return {"type": "throughput",
+                "total": sum(int(s.get("total", 0)) for s in states),
+                "rate": sum(float(s.get("rate", 0.0)) for s in states),
+                "windowed_rate": sum(float(s.get("windowed_rate", 0.0))
+                                     for s in states)}
 
 
 class StageTimer:
@@ -280,8 +421,20 @@ class StageTimer:
         return self._total / self._count if self._count else 0.0
 
     def snapshot(self) -> Dict[str, Any]:
-        return {"type": "stage", "count": self._count,
-                "total_sec": self._total, "mean_sec": self.mean_sec}
+        with self._lock:   # count and total from the same instant
+            count, total = self._count, self._total
+        return {"type": "stage", "count": count, "total_sec": total,
+                "mean_sec": total / count if count else 0.0}
+
+    def state(self) -> Dict[str, Any]:
+        return self.snapshot()
+
+    @classmethod
+    def merge(cls, states: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        count = sum(int(s.get("count", 0)) for s in states)
+        total = sum(float(s.get("total_sec", 0.0)) for s in states)
+        return {"type": "stage", "count": count, "total_sec": total,
+                "mean_sec": total / count if count else 0.0}
 
 
 class MetricsRegistry:
@@ -324,6 +477,15 @@ class MetricsRegistry:
         with self._lock:
             return {k: v.snapshot() for k, v in sorted(self._m.items())}
 
+    def state(self) -> Dict[str, Dict[str, Any]]:
+        """Serialized mergeable view of every metric (histograms carry
+        their reservoir).  This is the payload workers push to the
+        tracker; ``telemetry.aggregate`` merges a set of them."""
+        with self._lock:
+            items = sorted(self._m.items())
+        return {k: (v.state() if hasattr(v, "state") else v.snapshot())
+                for k, v in items}
+
     def report(self) -> None:
         for name, snap in self.snapshot().items():
             log_info("metric %s: %s", name,
@@ -341,16 +503,33 @@ class MetricsRegistry:
 metrics = MetricsRegistry()
 
 
+# jax.profiler resolved once at first trace_span() use; False caches the
+# negative case so a JAX-less process pays the failed import exactly once
+_profiler_mod: Any = None
+
+
+def _resolve_profiler() -> Any:
+    global _profiler_mod
+    if _profiler_mod is None:
+        try:
+            import jax.profiler as _prof
+            _profiler_mod = _prof
+        except Exception:
+            _profiler_mod = False
+    return _profiler_mod or None
+
+
 @contextlib.contextmanager
 def trace_span(name: str) -> Iterator[None]:
     """Annotate a host-side span on the jax.profiler timeline; no-op when
     JAX is unavailable. The idiomatic upgrade of printf timing (SURVEY §5)."""
     ann = None
-    try:
-        import jax.profiler as _prof
-        ann = _prof.TraceAnnotation(name)
-    except Exception:
-        pass
+    prof = _resolve_profiler()
+    if prof is not None:
+        try:
+            ann = prof.TraceAnnotation(name)
+        except Exception:
+            pass
     if ann is None:
         yield
         return

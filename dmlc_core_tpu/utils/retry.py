@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import math
 import random
+import sys
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -49,6 +50,21 @@ __all__ = [
     "Deadline", "DeadlineExpired", "RetryPolicy", "RetriesExhausted",
     "CircuitBreaker", "CircuitOpen",
 ]
+
+
+def _trace_event(name: str, **attrs: Any) -> None:
+    """Mirror a resilience signal onto the active telemetry span, so a
+    Perfetto trace of a slow request shows *why* it was slow.  Looked up
+    via sys.modules (never imported here): utils.retry sits below the
+    telemetry package in the import graph, and an untraced process pays
+    one dict miss."""
+    mod = sys.modules.get("dmlc_core_tpu.telemetry.trace")
+    if mod is None:
+        return
+    try:
+        mod.add_event(name, **attrs)
+    except Exception:   # telemetry must never break the retried call
+        pass
 
 
 class DeadlineExpired(DMLCError):
@@ -171,15 +187,22 @@ class RetryPolicy:
                     raise
                 if attempt >= self.max_attempts:
                     metrics.counter(f"retry.{self.name}.exhausted").add(1)
+                    _trace_event("retries_exhausted", policy=self.name,
+                                 attempts=attempt, error=str(e))
                     raise RetriesExhausted(
                         f"{self.name}: gave up after {attempt} attempts: "
                         f"{e}") from e
                 if dl.expired():
                     metrics.counter(f"retry.{self.name}.exhausted").add(1)
+                    _trace_event("retries_exhausted", policy=self.name,
+                                 attempts=attempt, error=str(e),
+                                 reason="deadline")
                     raise DeadlineExpired(
                         f"{self.name}: deadline exhausted after {attempt} "
                         f"attempts: {e}") from e
                 m_retry.add(1)
+                _trace_event("retry", policy=self.name, attempt=attempt,
+                             error=str(e))
                 if on_retry is not None:
                     on_retry(attempt, e)
                 delay = self.backoff_s(attempt)
@@ -252,6 +275,7 @@ class CircuitBreaker:
                 self._probing = True        # this caller is the probe
                 return
             metrics.counter(f"circuit.{self.name}.fast_fails").add(1)
+            _trace_event("circuit_fast_fail", circuit=self.name)
             raise CircuitOpen(
                 f"circuit {self.name!r} open "
                 f"({self._failures} consecutive failures)")
@@ -272,6 +296,8 @@ class CircuitBreaker:
             elif self._failures >= self.failure_threshold:
                 self._opened_at = self._clock()
                 metrics.counter(f"circuit.{self.name}.opens").add(1)
+                _trace_event("circuit_open", circuit=self.name,
+                             failures=self._failures)
                 log_warning("circuit %s opened after %d consecutive "
                             "failures", self.name, self._failures)
 

@@ -1,0 +1,476 @@
+"""Telemetry plane: trace propagation (in-process, cross-thread, over the
+serving wire), Chrome-trace export schema, Prometheus exposition format,
+mergeable metric states, the HTTP exporter, tracker fleet aggregation,
+and log correlation — all on CPU."""
+
+import json
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.telemetry import aggregate, chrome_trace, exposition
+from dmlc_core_tpu.telemetry import trace as teltrace
+from dmlc_core_tpu.utils.logging import set_log_context, set_log_sink
+from dmlc_core_tpu.utils.metrics import Histogram, MetricsRegistry
+from dmlc_core_tpu.utils.retry import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    teltrace.recorder.clear()
+    yield
+    teltrace.recorder.clear()
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# trace context + spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_shares_trace_id():
+    with teltrace.span("outer") as outer:
+        with teltrace.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            assert teltrace.current() == inner.context
+    assert teltrace.current() is None
+    recs = {r["name"]: r for r in teltrace.recorder.snapshot()}
+    assert recs["inner"]["trace_id"] == recs["outer"]["trace_id"]
+    assert recs["inner"]["parent_id"] == recs["outer"]["span_id"]
+    assert recs["outer"]["parent_id"] is None
+
+
+def test_activate_crosses_boundaries():
+    """A bare TraceContext re-activated on 'the other side' parents new
+    spans correctly (the thread/wire-crossing contract)."""
+    ctx = teltrace.TraceContext(teltrace.new_trace_id(),
+                                teltrace.new_trace_id())
+    with teltrace.activate(ctx):
+        with teltrace.span("child") as child:
+            assert child.trace_id == ctx.trace_id
+            assert child.parent_id == ctx.span_id
+    with teltrace.activate(None):        # None is a no-op, not an error
+        assert teltrace.current() is None
+
+
+def test_span_records_error_and_events():
+    with pytest.raises(ValueError):
+        with teltrace.span("boom") as s:
+            s.event("checkpoint", step=3)
+            raise ValueError("nope")
+    (rec,) = teltrace.recorder.snapshot()
+    assert rec["attrs"]["error"].startswith("ValueError")
+    assert rec["events"][0]["name"] == "checkpoint"
+    assert rec["events"][0]["attrs"]["step"] == 3
+
+
+def test_add_event_without_span_records_instant():
+    teltrace.add_event("orphan", detail="x")
+    (rec,) = teltrace.recorder.snapshot()
+    assert rec["kind"] == "event" and rec["name"] == "orphan"
+    assert rec["trace_id"] is None
+
+
+def test_recorder_ring_is_bounded():
+    r = teltrace.SpanRecorder(capacity=4)
+    for i in range(10):
+        r.record({"name": str(i)})
+    assert [x["name"] for x in r.snapshot()] == ["6", "7", "8", "9"]
+
+
+def test_retry_emits_span_events():
+    """utils.retry reports retries into the active span (satellite: the
+    resilience layer feeds the telemetry plane without importing it)."""
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.0,
+                      retryable=lambda e: isinstance(e, OSError))
+    with teltrace.span("op") as s:
+        assert pol.call(flaky) == "ok"
+        names = [e["name"] for e in s.events]
+    assert names.count("retry") == 2
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_schema(tmp_path):
+    with teltrace.span("parent"):
+        with teltrace.span("child"):
+            teltrace.add_event("tick", k=1)
+    doc = chrome_trace.to_chrome_trace()
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"parent", "child"}
+    for e in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+    # async nesting pair per span, keyed by the shared trace id
+    bs = [e for e in events if e["ph"] == "b"]
+    es = [e for e in events if e["ph"] == "e"]
+    assert len(bs) == len(es) == 2
+    assert len({e["id"] for e in bs}) == 1     # one trace → one async id
+    assert any(e["ph"] == "i" and e["name"] == "tick" for e in events)
+    # the file form is valid JSON Perfetto can open
+    p = tmp_path / "trace.json"
+    chrome_trace.write_chrome_trace(str(p))
+    assert json.loads(p.read_text())["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def test_render_prometheus_golden():
+    reg = MetricsRegistry()
+    reg.counter("reqs.total").add(7)
+    reg.gauge("queue.depth").set(3)
+    h = reg.histogram("lat_s")
+    for v in [0.1] * 99 + [1.0]:
+        h.observe(v)
+    text = exposition.render_prometheus(reg.snapshot())
+    lines = text.splitlines()
+    assert "# TYPE dmlc_reqs_total_total counter" in lines
+    assert "dmlc_reqs_total_total 7" in lines
+    assert "# TYPE dmlc_queue_depth gauge" in lines
+    assert "dmlc_queue_depth 3" in lines
+    assert "# TYPE dmlc_lat_s summary" in lines
+    assert 'dmlc_lat_s{quantile="0.5"} 0.1' in lines
+    assert "dmlc_lat_s_count 100" in lines
+    # every non-comment line is `name{labels} value`
+    for ln in lines:
+        if not ln.startswith("#"):
+            name, val = ln.rsplit(" ", 1)
+            float(val)
+            assert name[0].isalpha() or name[0] == "_"
+
+
+def test_render_prometheus_sanitizes_and_labels():
+    reg = MetricsRegistry()
+    reg.counter("weird-name.1").add(1)
+    text = exposition.render_prometheus(reg.snapshot(),
+                                        labels={"rank": "3"})
+    assert 'dmlc_weird_name_1_total{rank="3"} 1' in text
+
+
+def test_render_series_single_type_header():
+    """The same family across label sets must emit ONE # TYPE header."""
+    r0, r1 = MetricsRegistry(), MetricsRegistry()
+    r0.counter("c").add(1)
+    r1.counter("c").add(2)
+    text = exposition.render_series([({"rank": "0"}, r0.snapshot()),
+                                     ({"rank": "1"}, r1.snapshot())])
+    assert text.count("# TYPE dmlc_c_total counter") == 1
+    assert 'dmlc_c_total{rank="0"} 1' in text
+    assert 'dmlc_c_total{rank="1"} 2' in text
+
+
+# ---------------------------------------------------------------------------
+# mergeable metric states
+# ---------------------------------------------------------------------------
+
+def test_histogram_merge_matches_combined_stream():
+    rng = np.random.default_rng(7)
+    a = rng.normal(0.0, 1.0, 1200)
+    b = rng.normal(4.0, 0.5, 800)
+    ha, hb, ref = Histogram(), Histogram(), Histogram(max_samples=4096)
+    for v in a:
+        ha.observe(float(v))
+        ref.observe(float(v))
+    for v in b:
+        hb.observe(float(v))
+        ref.observe(float(v))
+    merged = Histogram.merge([ha.state(), hb.state()])
+    want = ref.snapshot()
+    assert merged["count"] == 2000
+    assert merged["mean"] == pytest.approx(want["mean"], abs=1e-9)
+    assert merged["min"] == want["min"] and merged["max"] == want["max"]
+    for q in ("p50", "p95", "p99"):
+        assert merged[q] == pytest.approx(want[q], abs=0.2)
+
+
+def test_merge_states_counters_gauges_and_skew():
+    per_rank = {
+        "0": {"reqs": {"type": "counter", "value": 5},
+              "health": {"type": "gauge", "value": 0},
+              "skewed": {"type": "counter", "value": 1}},
+        "1": {"reqs": {"type": "counter", "value": 7},
+              "health": {"type": "gauge", "value": 2},
+              "skewed": {"type": "gauge", "value": 1}},
+    }
+    merged = aggregate.merge_states(per_rank)
+    assert merged["reqs"]["value"] == 12
+    assert merged["health"]["value"] == 2     # gauge merge = worst rank
+    assert "skewed" not in merged             # type skew dropped, not guessed
+
+
+def test_registry_state_round_trips_through_renderer():
+    reg = MetricsRegistry()
+    reg.counter("c").add(3)
+    reg.histogram("h").observe(1.5)
+    reg.throughput("tp").add(10)
+    with reg.stage("st").time():
+        pass
+    state = reg.state()
+    text = aggregate.render_fleet({"0": state})
+    assert "dmlc_c_total 3" in text
+    assert 'dmlc_h{quantile="0.5"} 1.5' in text
+    assert "dmlc_tp_total 10" in text
+    assert "dmlc_st_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter
+# ---------------------------------------------------------------------------
+
+def test_exporter_endpoints_smoke():
+    """Tier-1 exporter smoke on an ephemeral port: /metrics renders the
+    global registry, /healthz is JSON, /spans returns recorded spans."""
+    from dmlc_core_tpu.utils.metrics import metrics
+    metrics.counter("telemetry.test.hits").add(2)
+    with teltrace.span("exporter-smoke"):
+        pass
+    srv = exposition.TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(base + "/metrics")
+        assert code == 200
+        assert "dmlc_telemetry_test_hits_total 2" in body
+        code, body = _get(base + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        code, body = _get(base + "/spans")
+        assert code == 200
+        assert any(s["name"] == "exporter-smoke"
+                   for s in json.loads(body)["spans"])
+        code, _ = _get(base + "/nope")
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_exporter_healthz_maps_overloaded_to_503():
+    srv = exposition.TelemetryServer(port=0, host="127.0.0.1",
+                                     health_fn=lambda: "overloaded").start()
+    try:
+        code, body = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert code == 503 and json.loads(body)["status"] == "overloaded"
+    finally:
+        srv.stop()
+
+
+def test_maybe_start_from_env(monkeypatch):
+    monkeypatch.delenv("DMLC_METRICS_PORT", raising=False)
+    assert exposition.maybe_start_from_env() is None
+    monkeypatch.setenv("DMLC_METRICS_PORT", "0")
+    srv = exposition.maybe_start_from_env()
+    assert srv is not None
+    try:
+        code, _ = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# live client → server → engine propagation
+# ---------------------------------------------------------------------------
+
+def test_serving_trace_propagates_end_to_end():
+    jax = pytest.importorskip("jax")
+    jnp = jax.numpy
+    from dmlc_core_tpu.models import SparseLogReg
+    from dmlc_core_tpu.serving import (BucketLadder, InferenceEngine,
+                                       PredictClient, PredictionServer)
+
+    F = 5000
+    model = SparseLogReg(num_features=F)
+    params = {"w": jnp.arange(F, dtype=jnp.float32) / F,
+              "b": jnp.float32(0.25)}
+    engine = InferenceEngine(model, params,
+                             buckets=BucketLadder([(16, 512)]))
+    srv = PredictionServer(engine, warmup=True, metrics_port=0).start()
+    try:
+        rng = np.random.default_rng(0)
+        with PredictClient(srv.host, srv.port) as client:
+            n = 16
+            client.predict(rng.integers(0, F, n, np.int32),
+                           rng.random(n, np.float32))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            recs = {r["name"]: r for r in teltrace.recorder.snapshot()}
+            if {"serving.client.predict", "serving.server.request",
+                    "serving.engine.forward"} <= set(recs):
+                break
+            time.sleep(0.02)
+        c = recs["serving.client.predict"]
+        s = recs["serving.server.request"]
+        e = recs["serving.engine.forward"]
+        # one trace id rides client → wire → server → batcher → engine
+        assert c["trace_id"] == s["trace_id"] == e["trace_id"]
+        assert s["parent_id"] == c["span_id"]
+        assert e["parent_id"] == s["span_id"]
+        assert s["attrs"]["status"] == "OK"
+        # the mounted exporter serves this process's registry + spans
+        assert srv.telemetry is not None
+        base = f"http://127.0.0.1:{srv.telemetry.port}"
+        code, body = _get(base + "/metrics")
+        assert code == 200 and "dmlc_serving_latency_s" in body
+        code, body = _get(base + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# tracker fleet aggregation
+# ---------------------------------------------------------------------------
+
+def test_tracker_merges_rank_tagged_states():
+    from dmlc_core_tpu.parallel.tracker import RabitTracker, send_json
+
+    t = RabitTracker(num_workers=2, host_ip="127.0.0.1", telemetry_port=0)
+    t.start()
+    try:
+        assert t.telemetry is not None
+
+        def push(rank, lat_base):
+            reg = MetricsRegistry()
+            reg.counter("reqs").add(5 + rank * 2)
+            h = reg.histogram("lat_s")
+            for i in range(100):
+                h.observe(lat_base + i * 0.001)
+            s = socket.create_connection((t.host_ip, t.port), timeout=5)
+            try:
+                send_json(s, {"cmd": "telemetry", "jobid": f"j{rank}",
+                              "rank": rank, "state": reg.state()})
+            finally:
+                s.close()
+
+        push(0, 0.1)
+        push(1, 0.5)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(t.telemetry_states()) < 2:
+            time.sleep(0.02)
+        assert set(t.telemetry_states()) == {"0", "1"}
+        code, body = _get(f"http://127.0.0.1:{t.telemetry.port}/metrics")
+        assert code == 200
+        lines = body.splitlines()
+        assert "dmlc_reqs_total 12" in lines           # merged fleet total
+        assert 'dmlc_reqs_total{rank="0"} 5' in lines  # drill-down series
+        assert 'dmlc_reqs_total{rank="1"} 7' in lines
+        # merged histogram quantiles span both ranks' reservoirs
+        p99 = next(float(ln.rsplit(" ", 1)[1]) for ln in lines
+                   if ln.startswith('dmlc_lat_s{quantile="0.99"}'))
+        assert 0.5 < p99 < 0.7
+        assert any(ln.startswith('dmlc_lat_s{quantile="0.5",rank="1"}')
+                   for ln in lines)
+    finally:
+        t.stop()
+
+
+def test_rabit_push_telemetry_cadence():
+    """A worker with DMLC_TELEMETRY_INTERVAL pushes its registry to the
+    tracker without any explicit call (plus one final push at shutdown)."""
+    from dmlc_core_tpu.parallel.rabit import RabitContext
+    from dmlc_core_tpu.parallel.tracker import RabitTracker
+    from dmlc_core_tpu.utils.metrics import metrics
+
+    t = RabitTracker(num_workers=1, host_ip="127.0.0.1", telemetry_port=0)
+    t.start()
+    try:
+        rc = RabitContext(t.host_ip, t.port, jobid="w0",
+                          heartbeat_interval=0, telemetry_interval=0.05)
+        try:
+            metrics.counter("worker.work_done").add(3)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                states = t.telemetry_states()
+                if "0" in states and "worker.work_done" in states["0"]:
+                    break
+                time.sleep(0.02)
+            assert states["0"]["worker.work_done"]["value"] == 3
+        finally:
+            rc.shutdown()
+        code, body = _get(f"http://127.0.0.1:{t.telemetry.port}/metrics")
+        assert code == 200 and "dmlc_worker_work_done_total" in body
+    finally:
+        t.stop()
+
+
+# ---------------------------------------------------------------------------
+# log correlation
+# ---------------------------------------------------------------------------
+
+def test_log_text_mode_carries_rank_and_trace_id():
+    from dmlc_core_tpu.utils.logging import log_info
+
+    captured = []
+    set_log_sink(lambda sev, msg: captured.append((sev, msg)))
+    try:
+        set_log_context(rank=3)
+        with teltrace.span("logged-op") as s:
+            log_info("inside")
+        log_info("outside")
+    finally:
+        set_log_sink(None)
+        set_log_context(rank=None)
+    assert "rank=3" in captured[0][1]
+    assert teltrace.format_id(s.trace_id) in captured[0][1]
+    assert "trace_id" not in captured[1][1]
+
+
+def test_log_json_mode_emits_json_lines(monkeypatch):
+    from dmlc_core_tpu.utils.logging import log_warning
+
+    monkeypatch.setenv("DMLC_LOG_FORMAT", "json")
+    captured = []
+    set_log_sink(lambda sev, line: captured.append((sev, line)))
+    try:
+        set_log_context(rank=1)
+        with teltrace.span("json-op") as s:
+            log_warning("careful: %d", 42)
+    finally:
+        set_log_sink(None)
+        set_log_context(rank=None)
+    sev, line = captured[0]
+    rec = json.loads(line)
+    assert sev == "WARNING" and rec["level"] == "WARNING"
+    assert rec["msg"] == "careful: 42"
+    assert rec["rank"] == 1
+    assert rec["trace_id"] == teltrace.format_id(s.trace_id)
+    assert isinstance(rec["ts"], float)
+
+
+# ---------------------------------------------------------------------------
+# artifacts
+# ---------------------------------------------------------------------------
+
+def test_dump_artifacts(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("done").add(1)
+    with teltrace.span("artifact-op"):
+        pass
+    prefix = str(tmp_path / "run1")
+    paths = telemetry.dump_artifacts(prefix, registry=reg)
+    snap = json.loads(open(paths["metrics"]).read())["snapshot"]
+    assert snap["done"]["value"] == 1
+    doc = json.loads(open(paths["trace"]).read())
+    assert any(e.get("name") == "artifact-op" for e in doc["traceEvents"])
