@@ -334,7 +334,10 @@ def measure_ours(platform_override: str = "", interleave=None):
             parts = []
             # h2d_pool: concurrent workers' overlapping seconds (pt>1)
             for name in ("parser.chunk", "parser.parse",
-                         "device_loader.pack", "device_loader.h2d",
+                         "device_loader.pack",
+                         "device_loader.cache_read",
+                         "device_loader.cache_write",
+                         "device_loader.h2d",
                          "device_loader.h2d_pool"):
                 st = metrics.stage(name)
                 parts.append(f"{name}={st.total_sec:.2f}s")

@@ -135,6 +135,90 @@ def bench_libsvm() -> dict:
             "unit": "MB/s"}
 
 
+def bench_ingest_cached() -> dict:
+    """Packed-page epoch cache (`pipeline/page_cache.py`): one loader
+    config measured three ways — cache-off baseline, epoch 1 with
+    write-through, epoch ≥2 replaying mmap'd pages.  The headline value is
+    the cached-epoch rate; the artifact carries the acceptance ratios
+    (cached ≥ 2× uncached, write-through within 10% of baseline, pack ≤ 5%
+    of cached-epoch wall)."""
+    import shutil
+    import tempfile
+
+    import bench
+    from dmlc_core_tpu.data import create_parser
+    from dmlc_core_tpu.pipeline import DeviceLoader
+    from dmlc_core_tpu.utils.metrics import metrics
+
+    path = "/tmp/bench_suite.libsvm"
+    _gen_libsvm(path)
+    size_mb = os.path.getsize(path) / MB
+    cores = bench.host_cores()
+    nthreads, threaded = (1, False) if cores == 1 else (cores, True)
+    batch_rows = int(os.environ.get("DMLC_BENCH_ROWS", "16384"))
+    nnz_cap = int(os.environ.get("DMLC_BENCH_NNZ", str(512 * 1024)))
+
+    def make_loader(cache=None):
+        return DeviceLoader(
+            create_parser(path, 0, 1, "libsvm", nthreads=nthreads,
+                          threaded=threaded),
+            batch_rows=batch_rows, nnz_cap=nnz_cap, prefetch=4,
+            cache=cache)
+
+    def epoch(loader) -> float:
+        t0 = time.perf_counter()
+        acc = None
+        for b in loader:
+            acc = bench.consume_batch(acc, b)
+        bench.prove_consumed(acc)
+        return time.perf_counter() - t0
+
+    def stage_sec(name: str) -> float:
+        return metrics.stage(name).total_sec
+
+    # cache-off baseline, best of 2 epochs on one loader
+    metrics.reset()
+    loader = make_loader()
+    base_wall = epoch(loader)
+    loader.before_first()
+    base_wall = min(base_wall, epoch(loader))
+    loader.close()
+    uncached = size_mb / base_wall
+
+    tmp = tempfile.mkdtemp(prefix="dmlc_pagecache_")
+    try:
+        metrics.reset()
+        loader = make_loader(cache=os.path.join(tmp, "pages"))
+        wall1 = epoch(loader)                   # build (write-through)
+        pack1 = stage_sec("device_loader.pack")
+        write1 = stage_sec("device_loader.cache_write")
+        metrics.reset()                         # per-epoch attribution
+        loader.before_first()
+        wall2 = epoch(loader)                   # cached replay
+        pack2 = stage_sec("device_loader.pack")
+        read2 = stage_sec("device_loader.cache_read")
+        hits = int(metrics.counter("page_cache.hits").value)
+        loader.before_first()
+        wall_best = min(wall2, epoch(loader))   # best cached epoch
+        loader.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    cached = size_mb / wall_best
+    return {"metric": "ingest_cached", "value": round(cached, 1),
+            "unit": "MB/s",
+            "uncached_mbps": round(uncached, 1),
+            "epoch1_mbps": round(size_mb / wall1, 1),
+            "epoch2_mbps": round(size_mb / wall2, 1),
+            "cached_over_uncached": round(cached / uncached, 2),
+            "epoch1_over_uncached": round((size_mb / wall1) / uncached, 2),
+            "pack_sec_epoch1": round(pack1, 3),
+            "pack_sec_epoch2": round(pack2, 3),
+            "pack_frac_epoch2": round(pack2 / wall2, 4),
+            "cache_write_sec_epoch1": round(write1, 3),
+            "cache_read_sec_epoch2": round(read2, 3),
+            "cache_hits_epoch2": hits}
+
+
 def bench_libfm() -> dict:
     path = "/tmp/bench_suite.libfm"
     _gen_libsvm(path, libfm=True)
@@ -1197,6 +1281,7 @@ def bench_sp_mesh8() -> dict:
 # allreduce_bus_bw, a deliberately distinct key.
 ALL = {
     "libsvm": (bench_libsvm, "libsvm_ingest_to_device"),
+    "ingest_cached": (bench_ingest_cached, "ingest_cached"),
     "fm_train": (bench_fm_train, "fm_train_stream"),
     "deepfm_train": (bench_deepfm_train, "deepfm_train_stream"),
     "ffm_train": (bench_ffm_train, "ffm_train_stream"),
@@ -1229,7 +1314,11 @@ CPU_MESH = {"allreduce_mesh8", "sp_mesh8"}
 # they were stamped "tpu" only because jax had initialised with the grant,
 # and that init is exactly where a lost grant wedges a child for its whole
 # timeout (observed 23:39 r04: recordio hung in axon client init).
-HOST_ONLY = {"stream", "csv", "recordio", "cache", "higgs"}
+#  ingest_cached is CPU-pinned by design: the page-cache acceptance gates
+#  (cached ≥ 2× uncached, pack ≤ 5% of cached wall) are host-path
+#  properties — measuring them through the tunnel would mix link latency
+#  into a disk/pack comparison.
+HOST_ONLY = {"stream", "csv", "recordio", "cache", "higgs", "ingest_cached"}
 # superseded in the default order (ingest_scale measures workers_2 too);
 # still runnable by explicit name
 DEFAULT_SKIP = {"remote_ingest"}

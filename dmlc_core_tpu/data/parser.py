@@ -201,8 +201,14 @@ def _register_text_format(fmt: str, description: str) -> None:
         if csv_param is not None:
             parser.csv_label_col = csv_param.label_column
             parser.csv_delim = csv_param.delimiter
+        # surface the #cachefile fragment past the split: the DeviceLoader
+        # packed-page cache (pipeline.page_cache) keys its page file off it
+        # — before this, the fragment was dead config on the loader path
+        cache_file = URISpec(uri, part_index, num_parts).cache_file
+        parser.cache_file = cache_file
         if threaded:
             parser = ThreadedParser(parser)
+            parser.cache_file = cache_file
         return parser
 
 

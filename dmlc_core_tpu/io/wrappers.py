@@ -63,18 +63,58 @@ class CachedInputSplit(InputSplit):
     """First epoch streams chunks to a local cache file while serving them;
     later epochs replay the cache (reference `cached_input_split.h:148-189`).
 
-    The cache is a simple length-prefixed chunk log.  ``reset_partition`` is
-    unsupported, as in the reference (`cached_input_split.h:87`).
+    The cache is a simple length-prefixed chunk log.  Crash safety: the
+    first pass writes ``<cache>.tmp.<pid>`` and atomically renames it into
+    place before dropping the ``.done`` finalize marker, so a killed run
+    leaves no half-written cache under the real name; framing is
+    re-validated on open, so a truncated or corrupt survivor is discarded
+    and rebuilt from the source instead of silently truncating the epoch.
+    ``reset_partition`` is unsupported, as in the reference
+    (`cached_input_split.h:87`).
     """
 
     def __init__(self, base: InputSplit, cache_file: str):
         self.base = base
         self.cache_file = cache_file
-        self._cache_complete = os.path.exists(cache_file + ".done")
-        self._writer = None if self._cache_complete else open(cache_file, "wb")
+        self._tmp_file = f"{cache_file}.tmp.{os.getpid()}"
+        self._cache_complete = (os.path.exists(cache_file + ".done")
+                                and self._validate_cache())
+        if not self._cache_complete:
+            self._discard_cache()
+        self._writer = None if self._cache_complete \
+            else open(self._tmp_file, "wb")
         self._reader = None
         self._first_epoch = not self._cache_complete
         self._reset_record_iter()
+
+    def _validate_cache(self) -> bool:
+        """Walk the length-prefixed framing end to end; a short read or an
+        out-of-bounds length means a damaged cache."""
+        try:
+            size = os.path.getsize(self.cache_file)
+            with open(self.cache_file, "rb") as f:
+                pos = 0
+                while pos < size:
+                    head = f.read(8)
+                    if len(head) < 8:
+                        return False
+                    (n,) = struct.unpack("<Q", head)
+                    pos += 8 + n
+                    if pos > size:
+                        return False
+                    f.seek(n, 1)
+            return True
+        except OSError:
+            return False
+
+    def _discard_cache(self) -> None:
+        # the marker goes first: if unlink dies between the two, a marker
+        # without a cache file fails validation next open, not this order
+        for path in (self.cache_file + ".done", self.cache_file):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def next_chunk(self) -> Optional[bytes]:
         if self._first_epoch:
@@ -104,8 +144,11 @@ class CachedInputSplit(InputSplit):
 
     def _finish_cache(self) -> None:
         if self._writer is not None:
+            self._writer.flush()
+            os.fsync(self._writer.fileno())
             self._writer.close()
             self._writer = None
+            os.replace(self._tmp_file, self.cache_file)
             with open(self.cache_file + ".done", "w") as f:
                 f.write("ok")
         self._cache_complete = True
@@ -118,7 +161,7 @@ class CachedInputSplit(InputSplit):
             self.base.before_first()
             if self._writer is not None:
                 self._writer.close()
-            self._writer = open(self.cache_file, "wb")
+            self._writer = open(self._tmp_file, "wb")
             return
         self._first_epoch = False
         if self._reader is not None:
@@ -131,7 +174,14 @@ class CachedInputSplit(InputSplit):
 
     def close(self) -> None:
         if self._writer is not None:
+            # incomplete first pass: drop the partial tmp file — a future
+            # open must rebuild from the source, not trust half a log
             self._writer.close()
+            self._writer = None
+            try:
+                os.unlink(self._tmp_file)
+            except OSError:
+                pass
         if self._reader is not None:
             self._reader.close()
         self.base.close()

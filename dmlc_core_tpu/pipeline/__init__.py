@@ -4,7 +4,10 @@ from .packing import pack_flat, pack_rowmajor, batch_slices, PackStats  # noqa: 
 from .device_loader import DeviceLoader  # noqa: F401
 from .ingest_service import (serve_ingest, RemoteIngestLoader,  # noqa: F401
                              ingest_worker_main)
+from .page_cache import (PageCacheReader, PageCacheWriter,  # noqa: F401
+                         open_reader as open_page_reader, page_path)
 
 __all__ = ["pack_flat", "pack_rowmajor", "batch_slices", "PackStats",
            "serve_ingest", "RemoteIngestLoader", "ingest_worker_main",
-           "DeviceLoader"]
+           "DeviceLoader", "PageCacheReader", "PageCacheWriter",
+           "open_page_reader", "page_path"]

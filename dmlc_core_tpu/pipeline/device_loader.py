@@ -42,6 +42,7 @@ import numpy as np
 from ..data.parser import ParserBase
 from ..telemetry import trace as teltrace
 from ..utils import ThreadedIter, check
+from . import page_cache
 from .packing import PackStats, batch_slices, pack_flat, pack_rowmajor
 
 __all__ = ["DeviceLoader", "make_decoder"]
@@ -238,6 +239,11 @@ class _BufPool:
         return np.empty(words, np.int32)
 
     def put(self, buf: np.ndarray) -> None:
+        if not buf.flags.writeable:
+            # an mmap'd page-cache view: recycling it would hand a
+            # read-only buffer to a packer as scratch — drop it instead
+            # (the map stays alive as long as any view does)
+            return
         with self._lock:
             if len(self._bufs) < self.cap:
                 self._bufs.append(buf)
@@ -410,6 +416,16 @@ class DeviceLoader:
                    service (:mod:`dmlc_core_tpu.pipeline.ingest_service`).
                    Requires the fused path (flat layout, no sharding, no
                    fields).  Recycle consumed buffers via ``recycle(buf)``.
+    cache:         packed-page epoch cache (:mod:`.page_cache`).  "auto"
+                   (default): enabled when the source URI carried a
+                   ``#cachefile`` fragment (the page file lands at
+                   ``<fragment>.pages`` with the fragment's per-partition
+                   suffix) and the loader is on the fused path.  A path
+                   string enables it at that exact location; None/False
+                   disables.  Epoch 1 mirrors fused buffers to disk off
+                   the hot path; epochs ≥2 mmap the pages and skip
+                   chunk→parse→pack entirely.  Stale/truncated caches are
+                   detected by fingerprint and rebuilt silently.
     """
 
     def __init__(self, source, batch_rows: int, nnz_cap: int,
@@ -418,7 +434,7 @@ class DeviceLoader:
                  prefetch: int = 2, drop_remainder: bool = False,
                  id_mod: int = 0, put_threads="auto",
                  wire_compact="auto", fields: bool = False,
-                 emit: str = "device"):
+                 emit: str = "device", cache="auto"):
         check(layout in ("flat", "rowmajor"), f"bad layout {layout!r}")
         check(emit in ("device", "host"), f"bad emit {emit!r}")
         if emit == "host":
@@ -439,6 +455,9 @@ class DeviceLoader:
         self.fields = bool(fields)
         self.stats = PackStats()
         self.emit = emit
+        self._cache_path = self._resolve_cache(cache)
+        self._cache_writer: Optional[page_cache.PageCacheWriter] = None
+        self._cache_reader: Optional[page_cache.PageCacheReader] = None
         put_threads = max(1, int(put_threads))
         depth = max(2, int(prefetch), put_threads)
         self._pool = _BufPool(cap=2 * depth + 2)
@@ -497,9 +516,158 @@ class DeviceLoader:
                 and getattr(self.source, "text_format", None)
                 in native.SpPacker.FORMATS)
 
+    # ---------------- packed-page epoch cache ----------------
+    def _resolve_cache(self, cache) -> Optional[str]:
+        if cache in (None, False, ""):
+            return None
+        fused = (self.layout == "flat" and self.sharding is None
+                 and not self.fields)
+        if cache == "auto":
+            if not fused:
+                return None
+            cf = self._src_attr("cache_file")
+            return page_cache.page_path(cf) if cf else None
+        check(fused, "cache= requires the fused path "
+                     "(flat layout, no sharding, no fields)")
+        return str(cache)
+
+    def _src_attr(self, name: str, default=None):
+        """An attribute off the source, looking through one wrapper layer
+        (ThreadedParser.base) — where create_parser hangs format knobs."""
+        v = getattr(self.source, name, None)
+        if v is None:
+            v = getattr(getattr(self.source, "base", None), name, None)
+        return default if v is None else v
+
+    def _cache_split(self):
+        """The file-backed InputSplit under the source, or None (page
+        caching needs stat-able source identity)."""
+        obj = self.source
+        for _ in range(8):
+            if hasattr(obj, "files"):
+                return obj
+            nxt = getattr(obj, "base", None)
+            if nxt is None:
+                nxt = getattr(obj, "source", None)
+            if nxt is None or nxt is obj:
+                return None
+            obj = nxt
+        return None
+
+    def _cache_fingerprint(self) -> Optional[dict]:
+        """Source identity (file list + sizes + mtimes) plus the full pack
+        config.  Recomputed at every epoch start, so a touched source
+        file, a repartition (``reset_partition``), or any config change
+        shifts the fingerprint and forces a silent rebuild."""
+        import os
+        split = self._cache_split()
+        if split is None:
+            return None
+        files = []
+        for fi in getattr(split, "files", []):
+            try:
+                mtime = os.stat(fi.path).st_mtime_ns
+            except OSError:
+                mtime = None
+            files.append([fi.path, int(fi.size), mtime])
+        if not files:
+            return None
+        pack_path = ("streampack" if self._use_streampack() else
+                     "native" if self._use_native_pack() else "python")
+        return {
+            "page_format": page_cache.FORMAT_VERSION,
+            "files": files,
+            "part": [int(getattr(split, "part_index", 0)),
+                     int(getattr(split, "num_parts", 1))],
+            "batch_rows": int(self.batch_rows),
+            "nnz_cap": int(self.nnz_cap),
+            "layout": self.layout,
+            "id_mod": int(self.id_mod),
+            "wire_compact": self.wire_compact,
+            "drop_remainder": bool(self.drop_remainder),
+            "pack_path": pack_path,
+            "text_format": self._src_attr("text_format"),
+            "csv": [self._src_attr("csv_label_col", -1),
+                    self._src_attr("csv_delim", ",")],
+        }
+
+    def _serve_cached(self, reader: page_cache.PageCacheReader) -> Iterator:
+        """Epoch from the page file: mmap'd read-only fused views go
+        straight to the transfer stage, no parse/pack at all.  The pool's
+        writeable guard keeps the views out of the recycle pool when
+        consumers hand them back."""
+        self._cache_reader = reader
+        try:
+            with teltrace.span("page_cache.serve_epoch",
+                               pages=reader.npages):
+                it = reader.pages()
+                while True:
+                    with self._m_cache_read.time():
+                        page = next(it, None)
+                    if page is None:
+                        return
+                    meta, rows, view = page
+                    self._m_cache_bytes_read.add(view.nbytes)
+                    yield ("fused", view, meta, rows)
+        finally:
+            self._cache_reader = None
+            reader.close()
+
+    def _write_through(self, fingerprint: dict) -> Iterator:
+        """First epoch against an absent/stale cache: serve the normal
+        parse→pack stream while mirroring every fused buffer to the
+        background page writer.  Backpressure or a write error drops the
+        build (the epoch is served regardless); a clean end of epoch
+        finalizes the page file atomically."""
+        writer = page_cache.PageCacheWriter(self._cache_path, fingerprint)
+        self._cache_writer = writer
+        ok = False
+        try:
+            for item in self._host_items_uncached():
+                if item[0] == "fused" and writer.active:
+                    _, buf, meta, rows = item
+                    words = _fused_words_meta(self.batch_rows, int(meta))
+                    with self._m_cache_write.time():
+                        if writer.offer(buf, int(meta), rows, words):
+                            self._m_cache_bytes_written.add(words * 4)
+                        else:
+                            self._m_cache_drops.add(1)
+                yield item
+            ok = True
+        finally:
+            self._cache_writer = None
+            if not (ok and writer.finalize()):
+                writer.abort()
+
     def _host_items(self) -> Iterator:
         """Yield host-side items: ('fused', buf, B, rows|None) for the
-        one-transfer path, ('arrays', dict) for sharded/rowmajor batches."""
+        one-transfer path, ('arrays', dict) for sharded/rowmajor batches.
+        With a page cache configured, a valid cache replays mmap'd fused
+        pages and a miss rebuilds it write-through."""
+        if self._cache_path is None:
+            yield from self._host_items_uncached()
+            return
+        self._maybe_bind()
+        fingerprint = self._cache_fingerprint()
+        reader = None
+        if fingerprint is not None:
+            reader = page_cache.open_reader(
+                self._cache_path, fingerprint,
+                expected_words=lambda meta: _fused_words_meta(
+                    self.batch_rows, int(meta)))
+        if reader is not None:
+            self._m_cache_hits.add(1)
+            yield from self._serve_cached(reader)
+            return
+        if fingerprint is None:
+            # source identity unknowable (no file-backed split under the
+            # source) — serve uncached rather than risk a stale replay
+            yield from self._host_items_uncached()
+            return
+        self._m_cache_misses.add(1)
+        yield from self._write_through(fingerprint)
+
+    def _host_items_uncached(self) -> Iterator:
         self._maybe_bind()
         if self._use_streampack():
             yield from self._host_items_streampack()
@@ -746,6 +914,14 @@ class DeviceLoader:
         self._m_h2d_pool = metrics.stage("device_loader.h2d_pool")
         self._m_batches = metrics.counter("device_loader.batches")
         self._m_rows = metrics.throughput("device_loader.rows")
+        self._m_cache_read = metrics.stage("device_loader.cache_read")
+        self._m_cache_write = metrics.stage("device_loader.cache_write")
+        self._m_cache_hits = metrics.counter("page_cache.hits")
+        self._m_cache_misses = metrics.counter("page_cache.misses")
+        self._m_cache_drops = metrics.counter("page_cache.drops")
+        self._m_cache_bytes_read = metrics.counter("page_cache.bytes_read")
+        self._m_cache_bytes_written = metrics.counter(
+            "page_cache.bytes_written")
 
     # -- consumer side --
     def __iter__(self):
@@ -773,6 +949,13 @@ class DeviceLoader:
             self._iter.destroy()
         self._drain_inflight()
         self._pool.clear()
+        # a mid-epoch close leaves the pack generator suspended inside the
+        # cache stream — drop its build / map deterministically, not at GC
+        writer, reader = self._cache_writer, self._cache_reader
+        if writer is not None:
+            writer.abort()
+        if reader is not None:
+            reader.close()
         if hasattr(self.source, "close"):
             self.source.close()
 

@@ -73,9 +73,16 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                  batch_rows: int, nnz_cap: int, port: int,
                  host: str = "0.0.0.0", id_mod: int = 0,
                  wire_compact="auto", max_epochs: int = 0,
+                 cache="auto",
                  ready_event: Optional[threading.Event] = None) -> None:
     """Serve fused ingest frames for one partition; blocks forever (or for
-    ``max_epochs`` connections when > 0 — tests use this to terminate)."""
+    ``max_epochs`` connections when > 0 — tests use this to terminate).
+
+    ``cache`` passes through to ``DeviceLoader``: with a ``#cachefile``
+    URI fragment (or an explicit path) the worker's packed-page cache
+    (:mod:`.page_cache`) makes every served epoch after the first an mmap
+    replay — the worker's parse/pack cost is paid once per source, not
+    once per training epoch."""
     from ..data import create_parser
 
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -120,7 +127,7 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                                       nthreads=nthreads, threaded=threaded),
                         batch_rows=batch_rows, nnz_cap=nnz_cap,
                         id_mod=id_mod, wire_compact=wire_compact,
-                        emit="host")
+                        emit="host", cache=cache)
                     frames = 0
                     for item in loader:
                         kind, buf, meta, rows = item
@@ -449,7 +456,7 @@ def ingest_worker_main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     if len(args) < 5:
         print("usage: dmlc-ingest-worker <uri> <part> <nparts> <fmt> "
-              "<port> [batch_rows=N] [nnz_cap=N] [id_mod=N]",
+              "<port> [batch_rows=N] [nnz_cap=N] [id_mod=N] [cache=PATH]",
               file=sys.stderr)
         return 2
     uri, part, nparts, fmt, port = (args[0], int(args[1]), int(args[2]),
@@ -457,7 +464,7 @@ def ingest_worker_main(argv=None) -> int:
     kw = dict(batch_rows=16384, nnz_cap=512 * 1024, id_mod=0)
     for a in args[5:]:
         k, v = a.split("=", 1)
-        kw[k] = int(v)
+        kw[k] = v if k == "cache" else int(v)
     serve_ingest(uri, part, nparts, fmt, port=port, **kw)
     return 0
 

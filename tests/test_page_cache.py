@@ -1,0 +1,445 @@
+"""Packed-page epoch cache (`pipeline/page_cache.py` + DeviceLoader
+integration): byte-identical replay, fingerprint invalidation, partition
+isolation, crash safety (truncation + fault-injected kill mid-write), and
+the CachedInputSplit atomic-rename satellite."""
+
+import glob
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dmlc_core_tpu.data import create_parser  # noqa: E402
+from dmlc_core_tpu.io import create_input_split  # noqa: E402
+from dmlc_core_tpu.pipeline import DeviceLoader  # noqa: E402
+from dmlc_core_tpu.pipeline import page_cache  # noqa: E402
+from dmlc_core_tpu.utils import clear_faults  # noqa: E402
+from dmlc_core_tpu.utils.metrics import metrics  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    metrics.reset()
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _write_libsvm(path, rows=900, seed=3):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(rows):
+            n = int(rng.integers(1, 6))
+            idx = sorted(rng.choice(500, n, replace=False).tolist())
+            f.write(f"{i % 2} "
+                    + " ".join(f"{j}:{rng.random():.3f}" for j in idx)
+                    + "\n")
+
+
+def _mk_loader(src, cache="auto", part=0, nparts=1, **kw):
+    kw.setdefault("batch_rows", 128)
+    kw.setdefault("nnz_cap", 1024)
+    return DeviceLoader(
+        create_parser(str(src), part, nparts, "libsvm",
+                      nthreads=1, threaded=False),
+        cache=cache if cache in (None, "auto") else str(cache), **kw)
+
+
+def _epoch(loader):
+    return [{k: np.asarray(v) for k, v in b.items()} for b in loader]
+
+
+def _assert_epochs_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert sorted(x) == sorted(y)
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k])
+
+
+def _rows_served(batches):
+    # padded rows carry weight 0, real rows weight > 0
+    return int(sum((b["weights"] > 0).sum() for b in batches))
+
+
+def test_cached_epochs_byte_identical(tmp_path):
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    with DeviceLoader(create_parser(str(src), 0, 1, "libsvm",
+                                    nthreads=1, threaded=False),
+                      128, 1024) as ref:
+        base = _epoch(ref)
+
+    loader = _mk_loader(src, cache=tmp_path / "pc")
+    try:
+        ep1 = _epoch(loader)                 # miss → write-through build
+        assert metrics.counter("page_cache.misses").value == 1
+        assert os.path.exists(tmp_path / "pc")
+        metrics.reset()
+        loader.before_first()
+        ep2 = _epoch(loader)                 # hit → mmap replay
+        assert metrics.counter("page_cache.hits").value == 1
+        assert metrics.counter("page_cache.misses").value == 0
+        assert metrics.counter("page_cache.bytes_read").value > 0
+        # the whole point: no parse, no pack on a cached epoch
+        assert metrics.stage("device_loader.pack").total_sec == 0.0
+        assert metrics.stage("parser.parse").total_sec == 0.0
+        loader.before_first()
+        ep3 = _epoch(loader)
+    finally:
+        loader.close()
+    _assert_epochs_equal(base, ep1)
+    _assert_epochs_equal(base, ep2)
+    _assert_epochs_equal(base, ep3)
+
+
+def test_cache_invalidated_on_source_change(tmp_path):
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src, rows=600, seed=1)
+    cache = tmp_path / "pc"
+    l1 = _mk_loader(src, cache=cache)
+    _epoch(l1)
+    l1.close()
+
+    _write_libsvm(src, rows=700, seed=2)     # different size and content
+    metrics.reset()
+    l2 = _mk_loader(src, cache=cache)
+    try:
+        ep2 = _epoch(l2)
+        assert metrics.counter("page_cache.misses").value == 1
+        assert metrics.counter("page_cache.hits").value == 0
+        assert _rows_served(ep2) == 700      # the NEW data, not the cache
+        l2.before_first()
+        ep3 = _epoch(l2)                     # rebuilt cache now serves
+        assert metrics.counter("page_cache.hits").value == 1
+        _assert_epochs_equal(ep2, ep3)
+    finally:
+        l2.close()
+
+
+def test_cache_invalidated_on_mtime_only(tmp_path):
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    cache = tmp_path / "pc"
+    l1 = _mk_loader(src, cache=cache)
+    _epoch(l1)
+    l1.close()
+
+    st = os.stat(src)
+    os.utime(src, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    metrics.reset()
+    l2 = _mk_loader(src, cache=cache)
+    try:
+        _epoch(l2)
+        assert metrics.counter("page_cache.misses").value == 1
+    finally:
+        l2.close()
+
+
+def test_cache_invalidated_on_pack_config_change(tmp_path):
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    cache = tmp_path / "pc"
+    l1 = _mk_loader(src, cache=cache, nnz_cap=1024)
+    base = _epoch(l1)
+    l1.close()
+
+    metrics.reset()
+    l2 = _mk_loader(src, cache=cache, nnz_cap=2048)
+    try:
+        ep = _epoch(l2)
+        assert metrics.counter("page_cache.misses").value == 1
+        assert _rows_served(ep) == _rows_served(base)
+    finally:
+        l2.close()
+
+
+def test_partition_suffix_isolation(tmp_path):
+    """The URI fragment's .splitN.partK suffix keeps ranks' page files
+    apart, and each partition replays only its own shard."""
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    cc = tmp_path / "cc"
+    uri = f"{src}#{cc}"
+    per_part = []
+    for part in (0, 1):
+        metrics.reset()                      # before construction: the
+        loader = _mk_loader(uri, part=part, nparts=2)  # pack thread is eager
+        try:
+            ep1 = _epoch(loader)
+            assert metrics.counter("page_cache.misses").value == 1
+            loader.before_first()
+            ep2 = _epoch(loader)
+            assert metrics.counter("page_cache.hits").value == 1
+            _assert_epochs_equal(ep1, ep2)
+            per_part.append(ep1)
+        finally:
+            loader.close()
+        assert os.path.exists(f"{cc}.split2.part{part}.pages")
+    assert (_rows_served(per_part[0]) + _rows_served(per_part[1])) == 900
+
+
+def test_reset_partition_invalidates(tmp_path):
+    """Repartitioning between epochs shifts the fingerprint: the loader
+    must serve the NEW partition from source, then cache it."""
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    parser = create_parser(str(src), 0, 2, "libsvm",
+                           nthreads=1, threaded=False)
+    loader = DeviceLoader(parser, 128, 1024, cache=str(tmp_path / "pc"))
+    try:
+        ep_p0 = _epoch(loader)
+        parser.source.reset_partition(1, 2)
+        metrics.reset()
+        loader.before_first()
+        ep_p1 = _epoch(loader)
+        assert metrics.counter("page_cache.misses").value == 1
+        assert metrics.counter("page_cache.hits").value == 0
+        assert _rows_served(ep_p0) + _rows_served(ep_p1) == 900
+        loader.before_first()
+        ep_p1b = _epoch(loader)              # rebuilt for part 1 → hit
+        assert metrics.counter("page_cache.hits").value == 1
+        _assert_epochs_equal(ep_p1, ep_p1b)
+    finally:
+        loader.close()
+
+
+def test_truncated_cache_rebuilt(tmp_path):
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    cache = tmp_path / "pc"
+    l1 = _mk_loader(src, cache=cache)
+    base = _epoch(l1)
+    l1.close()
+
+    size = os.path.getsize(cache)
+    with open(cache, "r+b") as f:
+        f.truncate(size // 2)                # footer + index gone
+    metrics.reset()
+    l2 = _mk_loader(src, cache=cache)
+    try:
+        ep = _epoch(l2)
+        assert metrics.counter("page_cache.misses").value == 1
+        _assert_epochs_equal(base, ep)
+        l2.before_first()
+        _assert_epochs_equal(base, _epoch(l2))
+        assert metrics.counter("page_cache.hits").value == 1
+    finally:
+        l2.close()
+
+
+def test_corrupt_footer_rebuilt(tmp_path):
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    cache = tmp_path / "pc"
+    l1 = _mk_loader(src, cache=cache)
+    base = _epoch(l1)
+    l1.close()
+
+    with open(cache, "r+b") as f:            # flip the finalize magic
+        f.seek(-4, os.SEEK_END)
+        f.write(b"XXXX")
+    metrics.reset()
+    l2 = _mk_loader(src, cache=cache)
+    try:
+        _assert_epochs_equal(base, _epoch(l2))
+        assert metrics.counter("page_cache.misses").value == 1
+    finally:
+        l2.close()
+
+
+def test_chaos_kill_mid_write_rebuilds(tmp_path, monkeypatch):
+    """DMLC_FAULT_SPEC kills the page writer mid-file: the epoch is still
+    served in full, no cache survives under the real name (no tmp litter
+    either), and the next run rebuilds cleanly."""
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    cache = tmp_path / "pc"
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "page_cache.write:error=1:after=2")
+    clear_faults()                           # re-arm from the env var
+    l1 = _mk_loader(src, cache=cache)
+    try:
+        ep1 = _epoch(l1)                     # served despite the dead build
+        assert _rows_served(ep1) == 900
+    finally:
+        l1.close()
+    assert not os.path.exists(cache)
+    assert glob.glob(f"{cache}.tmp.*") == []
+
+    monkeypatch.delenv("DMLC_FAULT_SPEC")
+    clear_faults()
+    metrics.reset()
+    l2 = _mk_loader(src, cache=cache)
+    try:
+        ep2 = _epoch(l2)                     # rebuild succeeds now
+        assert metrics.counter("page_cache.misses").value == 1
+        assert os.path.exists(cache)
+        l2.before_first()
+        ep3 = _epoch(l2)
+        assert metrics.counter("page_cache.hits").value == 1
+        _assert_epochs_equal(ep1, ep2)
+        _assert_epochs_equal(ep2, ep3)
+    finally:
+        l2.close()
+
+
+def test_uri_fragment_enables_page_cache(tmp_path):
+    """#cachefile on the URI auto-enables the page cache (cache='auto'),
+    coexisting with CachedInputSplit's raw-chunk log on the same path."""
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    cc = tmp_path / "cc"
+    loader = _mk_loader(f"{src}#{cc}")
+    try:
+        ep1 = _epoch(loader)
+        loader.before_first()
+        ep2 = _epoch(loader)
+        _assert_epochs_equal(ep1, ep2)
+    finally:
+        loader.close()
+    assert os.path.exists(f"{cc}.pages")     # page cache
+    assert os.path.exists(cc)                # chunk log, both live
+    assert os.path.exists(f"{cc}.done")
+    assert metrics.counter("page_cache.hits").value >= 1
+
+
+def test_emit_host_cached_views_not_recycled(tmp_path):
+    """emit='host' consumers recycle() every buffer; mmap'd page views
+    must bounce off the pool (writeable guard) and later epochs must stay
+    intact — a poisoned pool would corrupt subsequent builds."""
+    from dmlc_core_tpu.pipeline.device_loader import _fused_words_meta
+
+    src = tmp_path / "d.libsvm"
+    _write_libsvm(src)
+    loader = _mk_loader(src, cache=tmp_path / "pc", emit="host")
+
+    def host_epoch():
+        out = []
+        saw_readonly = False
+        while True:
+            item = loader.next_batch()
+            if item is None:
+                return out, saw_readonly
+            _, buf, meta, _rows = item
+            words = _fused_words_meta(128, int(meta))
+            out.append(bytes(np.ascontiguousarray(buf[:words]).tobytes()))
+            saw_readonly = saw_readonly or not buf.flags.writeable
+            loader.recycle(buf)
+
+    try:
+        ep1, ro1 = host_epoch()
+        assert not ro1                        # build epoch: pool buffers
+        loader.before_first()
+        ep2, ro2 = host_epoch()
+        assert ro2                            # cached epoch: mmap views
+        loader.before_first()
+        ep3, _ = host_epoch()
+    finally:
+        loader.close()
+    assert ep1 == ep2 == ep3
+
+
+def test_page_file_format_probes(tmp_path):
+    """Reader-level validation: unfinalized tmp never validates, a valid
+    file round-trips pages exactly, fingerprint mismatch returns None."""
+    path = str(tmp_path / "p.pages")
+    fp = {"k": 1}
+    w = page_cache.PageCacheWriter(path, fp, queue_pages=4)
+    payloads = [np.arange(16, dtype=np.int32) + i for i in range(3)]
+    for i, p in enumerate(payloads):
+        assert w.offer(p, meta=100 + i, rows=None if i else 7, words=16)
+    assert not os.path.exists(path)          # nothing before finalize
+    assert w.finalize()
+    assert os.path.exists(path)
+    assert glob.glob(f"{path}.tmp.*") == []
+
+    assert page_cache.open_reader(path, {"k": 2}) is None   # stale
+    r = page_cache.open_reader(path, fp, expected_words=lambda m: 16)
+    assert r is not None and r.npages == 3
+    got = list(r.pages())
+    r.close()
+    for i, (meta, rows, view) in enumerate(got):
+        assert meta == 100 + i
+        assert rows == (7 if i == 0 else None)
+        assert not view.flags.writeable
+        np.testing.assert_array_equal(view, payloads[i])
+    # wrong expected word count ⇒ rejected, not served
+    assert page_cache.open_reader(path, fp,
+                                  expected_words=lambda m: 32) is None
+
+
+def test_chunk_cache_truncated_rebuilt(tmp_path):
+    """CachedInputSplit satellite: a damaged chunk log behind a surviving
+    .done marker is discarded and rebuilt from source — never allowed to
+    truncate the epoch."""
+    src = tmp_path / "d.txt"
+    with open(src, "w") as f:
+        for i in range(200):
+            f.write(f"line-{i:04d}\n")
+    cache = str(tmp_path / "chunks")
+    uri = f"{src}#{cache}"
+
+    def drain(split):
+        chunks = []
+        while True:
+            c = split.next_chunk()
+            if c is None:
+                return chunks
+            chunks.append(bytes(c))
+
+    s1 = create_input_split(uri, 0, 1, "text")
+    first = drain(s1)
+    s1.close()
+    assert os.path.exists(cache) and os.path.exists(cache + ".done")
+
+    with open(cache, "r+b") as f:            # chop mid-record
+        f.truncate(os.path.getsize(cache) - 5)
+    s2 = create_input_split(uri, 0, 1, "text")
+    rebuilt = drain(s2)
+    assert b"".join(rebuilt) == b"".join(first)
+    # after the rebuild pass the cache is whole again and replays
+    s2.before_first()
+    replay = drain(s2)
+    s2.close()
+    assert b"".join(replay) == b"".join(first)
+
+
+def test_chunk_cache_killed_first_pass_leaves_nothing(tmp_path):
+    """An abandoned first pass must leave no file under the final cache
+    name (atomic tmp + rename), so the next open rebuilds from source."""
+    src = tmp_path / "d.txt"
+    with open(src, "w") as f:
+        for i in range(50):
+            f.write(f"line-{i:04d}\n")
+    cache = str(tmp_path / "chunks")
+    s = create_input_split(f"{src}#{cache}", 0, 1, "text")
+    assert s.next_chunk() is not None        # partial first pass
+    s.close()
+    assert not os.path.exists(cache)
+    assert not os.path.exists(cache + ".done")
+    assert glob.glob(f"{cache}.tmp.*") == []
+
+
+def test_chunk_cache_log_is_length_prefixed(tmp_path):
+    """The on-disk chunk log framing the validator walks is the framing
+    the writer produces (guards against silent format drift)."""
+    src = tmp_path / "d.txt"
+    with open(src, "w") as f:
+        f.write("hello\nworld\n")
+    cache = str(tmp_path / "chunks")
+    s = create_input_split(f"{src}#{cache}", 0, 1, "text")
+    while s.next_chunk() is not None:
+        pass
+    s.close()
+    with open(cache, "rb") as f:
+        blob = f.read()
+    pos, total = 0, 0
+    while pos < len(blob):
+        (n,) = struct.unpack_from("<Q", blob, pos)
+        pos += 8 + n
+        total += n
+    assert pos == len(blob) and total == 12
