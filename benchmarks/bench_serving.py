@@ -75,8 +75,24 @@ def main() -> int:
         "scenarios": {},
     }
 
-    def scenario(name, *, max_queue=256, **load_kw):
+    def scenario(name, *, max_queue=256, arm_flight=False, **load_kw):
         metrics.reset()
+        monitor = None
+        flight_dir = None
+        if arm_flight:
+            # full observability layer on: armed flight recorder + an SLO
+            # monitor ticking fast (rule bound high enough to never fire —
+            # measuring evaluation cost, not dump cost)
+            import tempfile
+
+            from dmlc_core_tpu.telemetry import flight as _flight
+            from dmlc_core_tpu.telemetry.anomaly import (SloMonitor,
+                                                         parse_slo_spec)
+            flight_dir = tempfile.mkdtemp(prefix="bench_flight_")
+            _flight.flight_recorder.arm(flight_dir)
+            monitor = SloMonitor(
+                parse_slo_spec("serving.latency_s:field=p99:max=1000s"),
+                interval_s=0.5).start()
         engine = InferenceEngine(model, params, postprocess="sigmoid")
         srv = PredictionServer(engine, max_queue=max_queue,
                                warmup=True).start()
@@ -86,6 +102,11 @@ def main() -> int:
                            features=features, **load_kw)
         finally:
             srv.stop()
+            if monitor is not None:
+                monitor.stop()
+            if arm_flight:
+                from dmlc_core_tpu.telemetry import flight as _flight
+                _flight.flight_recorder.disarm()
         rep["compile_count"] = engine.compile_count
         rep["warmup_plus_load_s"] = time.monotonic() - t0
         snap = metrics.snapshot()
@@ -114,6 +135,18 @@ def main() -> int:
     scenario("pipelined", concurrency=1, pipeline_depth=32)
     scenario("concurrent", concurrency=4, pipeline_depth=16)
     scenario("overload", concurrency=8, pipeline_depth=32, max_queue=16)
+    # flight-recorder overhead: back-to-back identical runs, recorder off
+    # vs armed (+SLO monitor at 2Hz); the acceptance bar is <2% on p50
+    scenario("recorder_off", concurrency=1, pipeline_depth=32)
+    scenario("recorder_on", concurrency=1, pipeline_depth=32,
+             arm_flight=True)
+    off_p50 = report["scenarios"]["recorder_off"]["latency_ms"]["p50"]
+    on_p50 = report["scenarios"]["recorder_on"]["latency_ms"]["p50"]
+    report["flight_recorder_p50_overhead"] = (
+        (on_p50 - off_p50) / off_p50 if off_p50 > 0 else 0.0)
+    log(f"flight recorder p50 overhead: "
+        f"{report['flight_recorder_p50_overhead'] * 100:+.2f}% "
+        f"({off_p50:.3f}ms -> {on_p50:.3f}ms)")
 
     ov = report["scenarios"]["overload"]
     report["overload_shed_fraction"] = (

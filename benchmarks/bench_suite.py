@@ -1365,19 +1365,26 @@ def run_one(name: str) -> None:
         bench.require_tpu_or_exit(platform)
     log(f"{name}: running on platform={platform}")
     try:
-        r = ALL[name][0]()
-    except Exception as e:  # noqa: BLE001 - report and continue
-        r = {"metric": METRIC_OF.get(name, name), "error": str(e)}
-    prefix = os.environ.get("DMLC_TELEMETRY_OUT")
-    if prefix:
-        # per-config observability artifact: the full registry snapshot +
-        # Chrome trace of whatever spans the config produced (each config
-        # is its own process, so the dump is per-config by construction)
         try:
-            from dmlc_core_tpu import telemetry
-            telemetry.dump_artifacts(f"{prefix}_{name}")
-        except Exception as e:  # noqa: BLE001 — telemetry never fails a run
-            log(f"telemetry dump failed: {e}")
+            r = ALL[name][0]()
+        except Exception as e:  # noqa: BLE001 - report and continue
+            r = {"metric": METRIC_OF.get(name, name), "error": str(e)}
+    finally:
+        # flush telemetry in a finally: a scenario that dies mid-run
+        # (SIGINT, OOM-killed worker raising SystemExit, a BaseException
+        # the reporting path can't survive) is EXACTLY the run whose
+        # telemetry you need on disk
+        prefix = os.environ.get("DMLC_TELEMETRY_OUT")
+        if prefix:
+            # per-config observability artifact: the full registry
+            # snapshot + Chrome trace of whatever spans the config
+            # produced (each config is its own process, so the dump is
+            # per-config by construction)
+            try:
+                from dmlc_core_tpu import telemetry
+                telemetry.dump_artifacts(f"{prefix}_{name}")
+            except Exception as e:  # noqa: BLE001 — telemetry never
+                log(f"telemetry dump failed: {e}")    # fails a run
     r["platform"] = platform
     print(json.dumps(r), flush=True)
 

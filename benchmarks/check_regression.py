@@ -1,0 +1,162 @@
+"""Regression gate over the committed ``BENCH_*.json`` trajectory.
+
+Every benchmark writes one ``BENCH_<family>_r<round>.json`` artifact per
+round (``BENCH_serving_r06.json``, ``BENCH_capacity_r05.json``, bare
+``BENCH_r05.json``).  Until now those were a folder of JSON — nothing
+failed when a PR made serving 30% slower.  This tool turns the
+trajectory into a gate:
+
+* group artifacts by family, order by round number;
+* flatten the newest and the previous round into dotted numeric keys
+  (``scenarios.concurrent.latency_ms.p50``);
+* classify each shared key by name — throughput-like tokens
+  (qps/rate/throughput/mb_s/rows) regress when they DROP, latency-like
+  tokens (latency/p50/p95/p99/seconds/ms/wall/overhead) regress when
+  they RISE; keys matching neither heuristic are informational only;
+* exit 1 when any shared key moved in its bad direction by more than
+  the threshold (default 10%, ``--threshold 0.25`` / env
+  ``DMLC_BENCH_THRESHOLD``).
+
+A family with fewer than two rounds passes vacuously (first round of a
+new bench is the baseline, not a regression).  Tiny absolute values are
+ignored (``--min-abs``, default 1e-9) — a 0.0001ms → 0.0002ms "100%
+regression" is measurement noise, not signal.
+
+Usage::
+
+    python benchmarks/check_regression.py [--dir REPO]
+        [--threshold 0.1] [--min-abs 1e-9] [--family serving] [-v]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: BENCH_<family>_r<round>.json; bare BENCH_r05.json → family "core"
+_BENCH_RE = re.compile(r"^BENCH_(?:(?P<family>.+)_)?r(?P<round>\d+)"
+                       r"(?P<partial>_partial)?\.json$")
+
+_HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
+                  "goodput", "ok", "hits", "speedup", "mfu")
+_LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
+                 "wall", "overhead", "compile", "stall", "shed", "drops",
+                 "errors", "misses")
+
+
+def _direction(key: str) -> Optional[str]:
+    """'up' = higher is better, 'down' = lower is better, None = no
+    opinion.  Lower-better tokens win ties: 'latency_ms.p50' must read
+    as latency even though 'p50' alone would too."""
+    k = key.lower()
+    if any(t in k for t in _LOWER_BETTER):
+        return "down"
+    if any(t in k for t in _HIGHER_BETTER):
+        return "up"
+    return None
+
+
+def _flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            out.update(_flatten(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(doc, (int, float)) and not isinstance(doc, bool):
+        out[prefix] = float(doc)
+    return out
+
+
+def discover(directory: str, family: Optional[str] = None
+             ) -> Dict[str, List[Tuple[int, str]]]:
+    """family → [(round, path)] sorted ascending; partials excluded."""
+    families: Dict[str, List[Tuple[int, str]]] = {}
+    for name in sorted(os.listdir(directory)):
+        m = _BENCH_RE.match(name)
+        if m is None or m.group("partial"):
+            continue
+        fam = m.group("family") or "core"
+        if family is not None and fam != family:
+            continue
+        families.setdefault(fam, []).append(
+            (int(m.group("round")), os.path.join(directory, name)))
+    for rounds in families.values():
+        rounds.sort()
+    return families
+
+
+def compare(prev_path: str, new_path: str, threshold: float,
+            min_abs: float) -> List[Dict[str, Any]]:
+    """Regressions between two artifacts: shared numeric keys that moved
+    in their bad direction past the threshold."""
+    prev = _flatten(json.load(open(prev_path)))
+    new = _flatten(json.load(open(new_path)))
+    regressions: List[Dict[str, Any]] = []
+    for key in sorted(set(prev) & set(new)):
+        direction = _direction(key)
+        if direction is None:
+            continue
+        p, n = prev[key], new[key]
+        if abs(p) < min_abs or abs(n) < min_abs:
+            continue
+        change = (n - p) / abs(p)
+        bad = change < -threshold if direction == "up" \
+            else change > threshold
+        if bad:
+            regressions.append({"key": key, "prev": p, "new": n,
+                                "change": change, "direction": direction})
+    return regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate the newest BENCH_*.json against the prior round")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=float(
+        os.environ.get("DMLC_BENCH_THRESHOLD", "0.1")),
+        help="relative move that counts as a regression (default 0.10)")
+    ap.add_argument("--min-abs", type=float, default=1e-9,
+                    help="ignore values smaller than this (noise floor)")
+    ap.add_argument("--family", default=None,
+                    help="check one family only (e.g. serving)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    families = discover(args.dir, args.family)
+    if not families:
+        print(f"check_regression: no BENCH_*.json under {args.dir}")
+        return 0
+    failed = False
+    for fam, rounds in sorted(families.items()):
+        if len(rounds) < 2:
+            print(f"{fam}: r{rounds[-1][0]:02d} only — baseline, pass")
+            continue
+        (pr, prev_path), (nr, new_path) = rounds[-2], rounds[-1]
+        regs = compare(prev_path, new_path, args.threshold, args.min_abs)
+        if regs:
+            failed = True
+            print(f"{fam}: r{pr:02d} → r{nr:02d} REGRESSED "
+                  f"({len(regs)} metric(s) past "
+                  f"{args.threshold * 100:.0f}%):")
+            for r in regs:
+                arrow = "↓" if r["direction"] == "up" else "↑"
+                print(f"  {arrow} {r['key']}: {r['prev']:g} → {r['new']:g} "
+                      f"({r['change'] * +100:+.1f}%)")
+        else:
+            print(f"{fam}: r{pr:02d} → r{nr:02d} ok")
+            if args.verbose:
+                prev = _flatten(json.load(open(prev_path)))
+                new = _flatten(json.load(open(new_path)))
+                for key in sorted(set(prev) & set(new)):
+                    if _direction(key) is not None and abs(prev[key]) > 0:
+                        print(f"    {key}: {prev[key]:g} → {new[key]:g}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -555,8 +555,15 @@ class RabitContext:
     # -- fleet telemetry --
     def push_telemetry(self) -> None:
         """Push this process's full registry state (mergeable form — see
-        ``MetricsRegistry.state``) to the tracker, tagged with our rank."""
+        ``MetricsRegistry.state``) to the tracker, tagged with our rank.
+        Device-memory/live-buffer gauges are refreshed first so the fleet
+        view carries current XLA memory state (no-op without JAX)."""
+        from ..telemetry.xla_introspect import sample_memory
         from ..utils.metrics import metrics
+        try:
+            sample_memory()
+        except Exception:   # sampling must never break the push
+            pass
         self._tracker_cmd({"cmd": "telemetry", "jobid": self.jobid,
                            "rank": self.rank, "state": metrics.state()})
 

@@ -38,6 +38,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..telemetry.aggregate import render_fleet
+from ..telemetry.anomaly import StragglerBoard
 from ..telemetry.exposition import TelemetryServer
 from ..utils import DMLCError, check, get_env, get_logger, log_info
 from ..utils.metrics import metrics
@@ -167,10 +168,15 @@ class RabitTracker:
             p = get_env("DMLC_TRACKER_METRICS_PORT", -1)
             telemetry_port = p if p >= 0 else None
         self._telemetry_states: Dict[str, dict] = {}
+        # cross-rank straggler detection over the same pushes: every
+        # rank-tagged state feeds the board, /metrics carries per-rank
+        # straggler_z / straggler_suspect gauges, /stragglers the JSON
+        self.straggler_board = StragglerBoard()
         self.telemetry: Optional[TelemetryServer] = None
         if telemetry_port is not None:
             self.telemetry = TelemetryServer(
-                port=int(telemetry_port), metrics_fn=self._render_fleet)
+                port=int(telemetry_port), metrics_fn=self._render_fleet,
+                stragglers_fn=self.straggler_board.snapshot)
 
     # -- public control --
     def start(self) -> None:
@@ -226,7 +232,12 @@ class RabitTracker:
     def _render_fleet(self) -> str:
         with self._lock:
             per_rank = dict(self._telemetry_states)
-        return render_fleet(per_rank, own_snapshot=metrics.snapshot())
+        page = render_fleet(per_rank, own_snapshot=metrics.snapshot())
+        rows = self.straggler_board.series()
+        if rows:
+            from ..telemetry.exposition import render_series
+            page += render_series(rows)
+        return page
 
     def telemetry_states(self) -> Dict[str, dict]:
         """Latest per-rank registry states pushed via ``cmd=telemetry``."""
@@ -266,6 +277,8 @@ class RabitTracker:
                 if isinstance(state, dict):
                     with self._lock:
                         self._telemetry_states[str(msg.get("rank"))] = state
+                    # outside the tracker lock: the board has its own
+                    self.straggler_board.update(msg.get("rank"), state)
             elif cmd == "heartbeat":
                 jobid = str(msg.get("jobid", ""))
                 with self._lock:

@@ -33,6 +33,7 @@ byte range).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Dict, Iterator, Optional
 
@@ -702,6 +703,7 @@ class DeviceLoader:
             yield self._pack_host(carry.flush(), fused)
 
     def _pack_host(self, block, fused: bool):
+        t0 = time.monotonic()
         with teltrace.span("device_loader.pack",
                            rows=getattr(block, "size", self.batch_rows)), \
                 self._m_pack.time():
@@ -719,7 +721,9 @@ class DeviceLoader:
                 buf = _host_fused(host, self.batch_rows, self.nnz_cap,
                                   out=self._pool.get(
                                       fused_words(self.batch_rows, self.nnz_cap)))
+                self._stall_pack.observe(time.monotonic() - t0)
                 return ("fused", buf, self.nnz_cap, host["_rows"])
+        self._stall_pack.observe(time.monotonic() - t0)
         return ("arrays", host)
 
     def _host_items_streampack(self) -> Iterator:
@@ -843,6 +847,7 @@ class DeviceLoader:
         immediately — concurrency comes from the pool's threads, and the
         ring (not thread-safe) stays unused."""
         self._maybe_bind()
+        t0 = time.monotonic()
         # pool mode times under its own stage: K workers accumulate
         # overlapping seconds, which must not be read as serial h2d time
         with teltrace.span("device_loader.h2d", sync=sync), \
@@ -870,6 +875,7 @@ class DeviceLoader:
                        for k, v in host.items()}
                 if sync:
                     jax.block_until_ready(out)
+        self._stall_h2d.observe(time.monotonic() - t0)
         self._m_batches.add(1)
         if rows_real is not None:
             self._m_rows.add(rows_real)
@@ -908,6 +914,12 @@ class DeviceLoader:
         # cached handles (locked registry lookups are off the per-batch
         # path); re-bind when the registry generation changes
         from ..utils.metrics import metrics
+        if not hasattr(self, "_stall_pack"):
+            # stall detectors keep their EWMA history across registry
+            # generations (they rebind their own gauges internally)
+            from ..telemetry.anomaly import StallDetector
+            self._stall_pack = StallDetector("device_loader.pack")
+            self._stall_h2d = StallDetector("device_loader.h2d")
         self._m_gen = metrics.generation
         self._m_pack = metrics.stage("device_loader.pack")
         self._m_h2d = metrics.stage("device_loader.h2d")

@@ -34,6 +34,7 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -94,6 +95,11 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
     log_info("ingest worker: part %d/%d of %s on :%d", part, nparts, uri,
              srv.getsockname()[1])
     served = 0
+    # per-frame stall detection: a frame covers produce (parse+pack or
+    # cache read) + send, so a wedged source, a stalled disk, or a
+    # blocked peer all surface as anomaly.stall_z.ingest.frame
+    from ..telemetry.anomaly import StallDetector
+    stall = StallDetector("ingest.frame")
     try:
         while not max_epochs or served < max_epochs:
             conn, addr = srv.accept()
@@ -129,6 +135,7 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                         id_mod=id_mod, wire_compact=wire_compact,
                         emit="host", cache=cache)
                     frames = 0
+                    t_frame = time.monotonic()
                     for item in loader:
                         kind, buf, meta, rows = item
                         check(kind == "fused", "host emit must be fused")
@@ -148,6 +155,9 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                         _send_all(conn, memoryview(buf[:words]).cast("B"))
                         loader.recycle(buf)
                         frames += 1
+                        now = time.monotonic()
+                        stall.observe(now - t_frame)
+                        t_frame = now
                     _send_all(conn, _FRAME.pack(0, 0, 0))  # end of stream
                     sp.attrs["frames"] = frames
             except Exception as e:  # noqa: BLE001 — a server: one bad

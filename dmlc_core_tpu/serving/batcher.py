@@ -25,6 +25,7 @@ queued requests immediately (the process-is-dying half).
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from concurrent.futures import Future
@@ -246,7 +247,17 @@ class MicroBatcher:
                     scores = self.engine.predict(ids, vals, row_ptr)
             except BaseException as e:  # noqa: BLE001 — fan the failure
                 # out to the waiting clients; the worker must survive to
-                # serve the next batch
+                # serve the next batch.  An engine failure is incident
+                # evidence — note it (and dump, when armed) so the batch
+                # that died is in the black box, not just the client logs
+                fl = sys.modules.get("dmlc_core_tpu.telemetry.flight")
+                if fl is not None and not isinstance(e, RequestTooLarge):
+                    fl.flight_recorder.note(
+                        "engine_failure", error=f"{type(e).__name__}: {e}",
+                        requests=len(live), rows=int(sum(p.rows
+                                                         for p in live)))
+                    fl.dump_incident("engine_failure",
+                                     error=f"{type(e).__name__}: {e}")
                 for p in live:
                     if not p.future.done():
                         p.future.set_exception(e)

@@ -25,11 +25,13 @@ straight from a `utils.checkpoint` directory via
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..telemetry import trace as teltrace
+from ..telemetry import xla_introspect
 from ..utils.logging import DMLCError, check, log_info
 from ..utils.metrics import metrics
 
@@ -216,32 +218,45 @@ class InferenceEngine:
             "weights": jax.ShapeDtypeStruct((bucket.rows,), f32),
         }
 
+    @staticmethod
+    def _bucket_key(bucket: ShapeBucket) -> str:
+        return f"r{bucket.rows}x{bucket.nnz}"
+
     def _get_compiled(self, bucket: ShapeBucket):
         exe = self._compiled.get(bucket)
         if exe is not None:
+            xla_introspect.watchdog.note_hit(self._bucket_key(bucket))
             return exe
         with self._compile_lock:
             exe = self._compiled.get(bucket)
             if exe is not None:
+                xla_introspect.watchdog.note_hit(self._bucket_key(bucket))
                 return exe
             import jax
+            t0 = time.monotonic()
             jitted = jax.jit(self._forward_fn(),
                              donate_argnums=(1,) if self._donate else ())
             exe = jitted.lower(self._param_avals,
                                self._batch_avals(bucket)).compile()
+            wall_s = time.monotonic() - t0
             self._compiled[bucket] = exe
             self.compile_count += 1
             self._maybe_rebind()
             self._m_compiles.add(1)
-            log_info("serving: compiled bucket rows=%d nnz=%d "
+            xla_introspect.watchdog.note_compile(
+                self._bucket_key(bucket), wall_s)
+            log_info("serving: compiled bucket rows=%d nnz=%d in %.2fs "
                      "(%d/%d buckets hot)", bucket.rows, bucket.nnz,
-                     len(self._compiled), len(self.ladder))
+                     wall_s, len(self._compiled), len(self.ladder))
             return exe
 
     def warmup_all(self) -> None:
         """Compile every bucket AND push one dummy batch through each —
         first-request latency pays neither tracing nor any lazy runtime
-        init.  Called before the server starts accepting."""
+        init.  Called before the server starts accepting.  Afterward the
+        retrace watchdog treats every further compile as an alert: the
+        ladder is complete, so a compile means traffic fell off it."""
+        xla_introspect.watchdog.begin_warmup()
         for bucket in self.ladder:
             exe = self._get_compiled(bucket)
             dummy = _pad_to_bucket(
@@ -249,6 +264,7 @@ class InferenceEngine:
                 np.zeros(1, np.int32), np.zeros(1, np.float32),
                 np.array([0, 1], np.int64))
             np.asarray(exe(self._params, dummy))
+        xla_introspect.watchdog.mark_steady()
 
     # -- serving path ---------------------------------------------------
     def predict(self, ids: np.ndarray, vals: np.ndarray,
@@ -269,7 +285,11 @@ class InferenceEngine:
         check(len(ids) == len(vals), "ids/vals length mismatch")
         check(int(row_ptr[0]) == 0 and int(row_ptr[-1]) == len(ids),
               "row_ptr does not cover ids")
-        bucket = self.ladder.select(rows, max(len(ids), 1))
+        try:
+            bucket = self.ladder.select(rows, max(len(ids), 1))
+        except RequestTooLarge as e:
+            xla_introspect.watchdog.note_ladder_miss(str(e))
+            raise
         batch = _pad_to_bucket(bucket, ids, vals, row_ptr)
         params = self._params          # atomic read: hot-reload safe
         exe = self._get_compiled(bucket)

@@ -42,6 +42,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..telemetry import anomaly as telanomaly
+from ..telemetry import flight as telflight
 from ..telemetry import trace as teltrace
 from ..telemetry.exposition import TelemetryServer
 from ..utils.faults import FaultInjected, fault_point
@@ -142,6 +144,12 @@ class PredictionServer:
         if metrics_port is not None:
             self.telemetry = TelemetryServer(
                 port=int(metrics_port), health_fn=lambda: self.health)
+        # observability companions (each an exact no-op when its env is
+        # unset): flight recorder arms on DMLC_FLIGHT_DIR; the SLO
+        # monitor compiles DMLC_SLO_SPEC and starts on server start
+        telflight.maybe_arm_from_env()
+        self.slo_monitor: Optional[telanomaly.SloMonitor] = \
+            telanomaly.maybe_monitor_from_env(autostart=False)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "PredictionServer":
@@ -150,6 +158,8 @@ class PredictionServer:
         self._accept_thread.start()
         if self.telemetry is not None:
             self.telemetry.start()
+        if self.slo_monitor is not None:
+            self.slo_monitor.start()
         log_info("serving: listening on %s:%d (%d buckets, queue=%d)",
                  self.host, self.port, len(self.engine.ladder),
                  self.batcher.max_queue)
@@ -160,6 +170,8 @@ class PredictionServer:
         requests get their answers), then drop connections."""
         self._stopping = True
         self._watch_stop.set()
+        if self.slo_monitor is not None:
+            self.slo_monitor.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
         # shutdown() before close(): the accept thread blocked inside
@@ -203,12 +215,17 @@ class PredictionServer:
     # -- health ----------------------------------------------------------
     @property
     def health(self) -> str:
-        """``ok`` | ``degraded`` | ``overloaded`` from batcher queue depth.
+        """``ok`` | ``degraded`` | ``overloaded`` from batcher queue depth
+        and live SLO breaches.
 
         ``degraded`` starts at ``DMLC_SERVING_DEGRADED_RATIO`` (default
         0.75) of ``max_queue``; ``overloaded`` means the admission limit is
-        reached and new submits are being shed.  Also exported as the gauge
-        ``serving.server.health`` (0 ok / 1 degraded / 2 overloaded)."""
+        reached and new submits are being shed.  A currently-breached
+        ``DMLC_SLO_SPEC`` rule (``slo.active_breaches`` > 0) degrades an
+        otherwise-ok replica — a load balancer should drain a replica that
+        is violating its objectives even when its queue looks healthy.
+        Also exported as the gauge ``serving.server.health``
+        (0 ok / 1 degraded / 2 overloaded)."""
         depth = self.batcher.queue_depth
         cap = max(1, self.batcher.max_queue)
         if depth >= cap:
@@ -217,6 +234,8 @@ class PredictionServer:
             state, level = "degraded", 1
         else:
             state, level = "ok", 0
+        if level == 0 and metrics.gauge("slo.active_breaches").value > 0:
+            state, level = "degraded", 1
         metrics.gauge("serving.server.health").set(level)
         return state
 

@@ -11,9 +11,16 @@ Layers (see ``docs/observability.md``):
   ``/metrics`` / ``/healthz`` / ``/spans`` HTTP exporter.
 * :mod:`telemetry.aggregate` — merge rank-tagged registry states into
   the tracker's fleet view.
+* :mod:`telemetry.flight` — always-on flight recorder dumping incident
+  bundles on fatal paths, injected faults, SLO breaches, or ``/flight``.
+* :mod:`telemetry.anomaly` — streaming stall/straggler detection and the
+  declarative ``DMLC_SLO_SPEC`` rule monitor.
+* :mod:`telemetry.xla_introspect` — jit retrace watchdog and device
+  memory gauges.
 
 Everything here is stdlib-only on top of ``utils.metrics`` — safe to
-import in any process, including JAX-less tooling.
+import in any process, including JAX-less tooling (the XLA sampler is a
+guarded no-op without JAX).
 """
 
 from __future__ import annotations
@@ -22,12 +29,18 @@ import json
 from typing import Optional
 
 from .aggregate import merge_states, render_fleet, state_to_snapshot
+from .anomaly import (SloMonitor, SloRule, SloSpecError, StallDetector,
+                      StragglerBoard, StreamingStat, maybe_monitor_from_env,
+                      parse_slo_spec)
 from .chrome_trace import to_chrome_trace, write_chrome_trace
 from .exposition import (TelemetryServer, maybe_start_from_env,
                          render_prometheus, render_series)
+from .flight import (FlightRecorder, dump_incident, flight_recorder,
+                     maybe_arm_from_env)
 from .trace import (Span, SpanRecorder, TraceContext, activate, add_event,
                     current, current_trace_id, format_id, new_trace_id,
                     recorder, span, start_span)
+from .xla_introspect import RetraceWatchdog, sample_memory, watchdog
 
 __all__ = [
     "TraceContext", "Span", "SpanRecorder", "recorder", "span",
@@ -38,6 +51,12 @@ __all__ = [
     "maybe_start_from_env",
     "merge_states", "state_to_snapshot", "render_fleet",
     "dump_artifacts",
+    "FlightRecorder", "flight_recorder", "dump_incident",
+    "maybe_arm_from_env",
+    "StreamingStat", "StallDetector", "StragglerBoard",
+    "SloRule", "SloMonitor", "SloSpecError", "parse_slo_spec",
+    "maybe_monitor_from_env",
+    "RetraceWatchdog", "watchdog", "sample_memory",
 ]
 
 

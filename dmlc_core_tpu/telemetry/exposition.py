@@ -51,6 +51,14 @@ def _sanitize(name: str) -> str:
     return name
 
 
+def _escape_label_value(v: Any) -> str:
+    """Text-format 0.0.4 label-value escaping: backslash first (so the
+    escapes it introduces aren't re-escaped), then newline and quote."""
+    return (str(v).replace("\\", "\\\\")
+                  .replace("\n", "\\n")
+                  .replace('"', '\\"'))
+
+
 def _fmt_labels(labels: Optional[Dict[str, str]],
                 extra: Optional[Dict[str, str]] = None) -> str:
     merged: Dict[str, str] = dict(labels or {})
@@ -58,9 +66,8 @@ def _fmt_labels(labels: Optional[Dict[str, str]],
         merged.update(extra)
     if not merged:
         return ""
-    inner = ",".join(
-        '{}="{}"'.format(k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
-        for k, v in sorted(merged.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(merged.items()))
     return "{" + inner + "}"
 
 
@@ -156,9 +163,10 @@ def render_prometheus(snapshot: Dict[str, Dict[str, Any]],
 class TelemetryServer:
     """Daemon-thread HTTP exporter: ``/metrics`` (Prometheus text),
     ``/healthz`` (JSON status, 503 when overloaded), ``/spans`` (recent
-    span records as JSON).
+    span records as JSON), ``/flight`` (on-demand incident bundle), and
+    ``/stragglers`` (tracker only — cross-rank straggler board JSON).
 
-    All three content callbacks are injectable so the same class serves a
+    All content callbacks are injectable so the same class serves a
     process-local registry (serving server, standalone exporter) or the
     tracker's merged fleet view.  ``port=0`` binds an ephemeral port —
     read it back from :attr:`port` (tests and same-host discovery).
@@ -168,6 +176,8 @@ class TelemetryServer:
                  metrics_fn: Optional[Callable[[], str]] = None,
                  health_fn: Optional[Callable[[], str]] = None,
                  spans_fn: Optional[Callable[[], List[Dict[str, Any]]]] = None,
+                 flight_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 stragglers_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  ) -> None:
         if metrics_fn is None:
             from ..utils.metrics import metrics as _registry
@@ -176,12 +186,27 @@ class TelemetryServer:
             health_fn = self._default_health
         if spans_fn is None:
             spans_fn = _trace.recorder.snapshot
+        if flight_fn is None:
+            flight_fn = self._default_flight
         self._metrics_fn = metrics_fn
         self._health_fn = health_fn
         self._spans_fn = spans_fn
+        self._flight_fn = flight_fn
+        self._stragglers_fn = stragglers_fn
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_flight() -> Dict[str, Any]:
+        """``GET /flight``: build (and, when armed, dump to disk) an
+        incident bundle from the process-global flight recorder."""
+        from . import flight as _flight
+        path = _flight.flight_recorder.dump("endpoint", force=True)
+        doc = _flight.flight_recorder.bundle("endpoint")
+        if path is not None:
+            doc["dumped_to"] = path
+        return doc
 
     @staticmethod
     def _default_health() -> str:
@@ -234,6 +259,23 @@ class TelemetryServer:
                         self._send(200, "application/json",
                                    json.dumps({"spans": outer._spans_fn()})
                                    .encode("utf-8"))
+                    elif path == "/flight":
+                        self._send(200, "application/json",
+                                   json.dumps(outer._flight_fn(),
+                                              default=str)
+                                   .encode("utf-8"))
+                    elif path == "/stragglers":
+                        if outer._stragglers_fn is None:
+                            # worker exporters have no cross-rank view —
+                            # only the tracker mounts a straggler board
+                            self._send(404, "text/plain",
+                                       b"no straggler board here "
+                                       b"(tracker-only endpoint)\n")
+                        else:
+                            self._send(200, "application/json",
+                                       json.dumps(outer._stragglers_fn(),
+                                                  default=str)
+                                       .encode("utf-8"))
                     else:
                         self._send(404, "text/plain", b"not found\n")
                 except Exception as e:   # scrape must never kill the server
@@ -247,7 +289,9 @@ class TelemetryServer:
             daemon=True)
         self._thread.start()
         log_info("telemetry exporter listening on %s:%d "
-                 "(/metrics /healthz /spans)", self._requested[0], self.port)
+                 "(/metrics /healthz /spans /flight%s)",
+                 self._requested[0], self.port,
+                 " /stragglers" if self._stragglers_fn is not None else "")
         return self
 
     def stop(self) -> None:
@@ -264,7 +308,17 @@ def maybe_start_from_env() -> Optional[TelemetryServer]:
     """Start a process-local exporter when ``DMLC_METRICS_PORT`` is set
     (0 = ephemeral).  Returns the running server or None.  Startup
     failures (port in use) are logged, not raised — telemetry must not
-    take the workload down."""
+    take the workload down.
+
+    Also activates the env-driven observability companions — the flight
+    recorder (``DMLC_FLIGHT_DIR``) and the SLO monitor
+    (``DMLC_SLO_SPEC``) — each an exact no-op when its env is unset, so
+    one call is the whole "observability on" switch for any process.
+    """
+    from . import anomaly as _anomaly
+    from . import flight as _flight
+    _flight.maybe_arm_from_env()
+    _anomaly.maybe_monitor_from_env()
     port = get_env("DMLC_METRICS_PORT", -1)
     if port < 0:
         return None

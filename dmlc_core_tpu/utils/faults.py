@@ -44,6 +44,7 @@ from __future__ import annotations
 import contextlib
 import os
 import random
+import sys
 import threading
 import time
 from typing import Dict, Iterator, List, Optional
@@ -127,6 +128,15 @@ class _Rule:
             time.sleep(delay)
         if raise_error:
             metrics.counter(f"faults.{site}.errors").add(1)
+            # tell the flight recorder (sys.modules — faults never imports
+            # telemetry) so chaos runs leave the same evidence trail a
+            # real incident would
+            fl = sys.modules.get("dmlc_core_tpu.telemetry.flight")
+            if fl is not None:
+                try:
+                    fl.note_fault(site)
+                except Exception:
+                    pass    # the black box must never mask the fault
             raise FaultInjected(f"injected fault at {site!r}")
 
 

@@ -18,8 +18,10 @@ import json
 import logging as _pylogging
 import os
 import sys
+import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
     "DMLCError",
@@ -38,6 +40,7 @@ __all__ = [
     "log_fatal",
     "set_log_sink",
     "set_log_context",
+    "get_log_tail",
     "get_logger",
     "IdOverflowError",
 ]
@@ -84,21 +87,46 @@ def set_log_sink(sink: Optional[Callable[[str, str], None]]) -> None:
 # Process-wide log correlation fields.  ``rank`` is set by the collective
 # layer once the tracker assigns it (env DMLC_RANK seeds launcher-spawned
 # processes); the live trace id is looked up per record.
+#
+# Writers (collective registration, server startup, worker threads) can
+# race each other and the readers in every logging call, so updates go
+# through copy-on-write under a lock: readers grab the dict reference
+# once — always a complete, immutable-by-convention mapping — and never
+# observe a half-applied update.
 _log_ctx: Dict[str, Any] = {}
+_log_ctx_lock = threading.Lock()
 _r = os.environ.get("DMLC_RANK")
 if _r is not None and _r.lstrip("-").isdigit():
     _log_ctx["rank"] = int(_r)
 del _r
 
+# In-process tail ring for the flight recorder: every emitted line, post
+# context-stamping, bounded by DMLC_LOG_TAIL (deque handles its own
+# locking for append; snapshots copy under the ctx lock for a stable view).
+_log_tail: deque = deque(
+    maxlen=max(1, int(os.environ.get("DMLC_LOG_TAIL", "256") or 256)))
+
 
 def set_log_context(**fields: Any) -> None:
     """Attach correlation fields (``rank=...``) to every subsequent log
-    record; ``None`` removes a field."""
-    for k, v in fields.items():
-        if v is None:
-            _log_ctx.pop(k, None)
-        else:
-            _log_ctx[k] = v
+    record; ``None`` removes a field.  Safe under concurrent threads:
+    the context dict is replaced wholesale, never mutated in place."""
+    global _log_ctx
+    with _log_ctx_lock:
+        ctx = dict(_log_ctx)
+        for k, v in fields.items():
+            if v is None:
+                ctx.pop(k, None)
+            else:
+                ctx[k] = v
+        _log_ctx = ctx
+
+
+def get_log_tail() -> List[str]:
+    """The last N emitted log lines (N = ``DMLC_LOG_TAIL``, default 256),
+    oldest first — what the flight recorder snapshots into a bundle."""
+    with _log_ctx_lock:
+        return list(_log_tail)
 
 
 def _live_trace_id() -> Optional[str]:
@@ -118,6 +146,8 @@ def _live_trace_id() -> Optional[str]:
 def _record_fields(severity: str, msg: str) -> Dict[str, Any]:
     rec: Dict[str, Any] = {
         "ts": time.time(), "level": severity, "msg": msg}
+    # one reference read: set_log_context swaps the whole dict, so this
+    # view is always internally consistent without taking the lock
     rec.update(_log_ctx)
     trace_id = _live_trace_id()
     if trace_id is not None:
@@ -131,6 +161,7 @@ def _emit(severity: str, msg: str) -> None:
         # JSON-lines for log shippers: write the line directly (the text
         # formatter's "[time] LEVEL " prefix would corrupt the JSON)
         line = json.dumps(rec, default=str)
+        _log_tail.append(line)
         if _custom_sink is not None:
             _custom_sink(severity, line)
         else:
@@ -140,6 +171,9 @@ def _emit(severity: str, msg: str) -> None:
                       if k not in ("ts", "level", "msg"))
     if suffix:
         msg = f"{msg} [{suffix}]"
+    _log_tail.append(
+        time.strftime("%H:%M:%S", time.localtime(rec["ts"]))
+        + f" {severity} {msg}")
     if _custom_sink is not None:
         _custom_sink(severity, msg)
         return

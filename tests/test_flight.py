@@ -1,0 +1,508 @@
+"""Flight recorder, anomaly/straggler detection, SLO rules, and the XLA
+retrace watchdog — including the end-to-end incident drill (fault-injected
+stall → SLO breach → straggler flag on tracker /metrics → incident bundle
+with a loadable Chrome trace naming the breached rule)."""
+
+import json
+import os
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dmlc_core_tpu.telemetry import anomaly, exposition, flight
+from dmlc_core_tpu.telemetry import trace as teltrace
+from dmlc_core_tpu.telemetry import xla_introspect
+from dmlc_core_tpu.utils.faults import fault_point, inject_faults
+from dmlc_core_tpu.utils.metrics import MetricsRegistry, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    """Fresh spans, fresh registry state for the names these tests touch,
+    and a disarmed, rate-limit-free global recorder."""
+    teltrace.recorder.clear()
+    monkeypatch.setattr(flight.flight_recorder, "_dir", None)
+    monkeypatch.setattr(flight.flight_recorder, "_min_interval", 0.0)
+    monkeypatch.setattr(flight.flight_recorder, "_last_dump",
+                        -float("inf"))
+    flight.flight_recorder._snaps.clear()
+    flight.flight_recorder._notes.clear()
+    metrics.reset()
+    yield
+    teltrace.recorder.clear()
+    metrics.reset()
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _assert_chrome_trace_valid(doc):
+    """Schema-validate a Chrome trace-event JSON object (the contract
+    Perfetto/chrome://tracing loads)."""
+    assert isinstance(doc, dict)
+    assert isinstance(doc["traceEvents"], list)
+    for ev in doc["traceEvents"]:
+        assert isinstance(ev["name"], str)
+        assert ev["ph"] in ("X", "b", "e", "i", "M")
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float))
+            assert ev["dur"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_incident_bundle_schema_golden(tmp_path):
+    """The on-disk bundle layout and incident JSON schema are the
+    operator contract — pin them."""
+    rec = flight.FlightRecorder()
+    rec._min_interval = 0.0
+    rec.note("fault_injected", site="x.y")
+    metrics.counter("drill.work").add(1)
+    rec.note_snapshot()
+    metrics.counter("drill.work").add(3)
+    with teltrace.span("drill.step"):
+        pass
+    path = rec.arm(str(tmp_path)).dump("unit_test", why="golden")
+    assert path is not None and os.path.isdir(path)
+    assert sorted(os.listdir(path)) == ["incident.json", "log_tail.txt",
+                                        "trace.json"]
+    doc = json.load(open(os.path.join(path, "incident.json")))
+    for key in ("schema", "reason", "detail", "ts", "pid", "host", "rank",
+                "slo_spec", "fault_spec", "metrics", "metrics_delta",
+                "notes", "span_count", "files"):
+        assert key in doc, key
+    assert doc["schema"] == flight.INCIDENT_SCHEMA == "dmlc.flight.incident/1"
+    assert doc["reason"] == "unit_test"
+    assert doc["detail"] == {"why": "golden"}
+    assert doc["notes"][0]["kind"] == "fault_injected"
+    assert doc["metrics"]["drill.work"]["value"] == 4
+    # counter moved since the ring snapshot → it shows in the delta
+    assert doc["metrics_delta"]["deltas"]["drill.work"] == 3
+    _assert_chrome_trace_valid(
+        json.load(open(os.path.join(path, "trace.json"))))
+
+
+def test_dump_unarmed_is_none_and_rate_limited(tmp_path):
+    rec = flight.FlightRecorder()
+    assert rec.dump("nope") is None          # not armed → no-op
+    rec.arm(str(tmp_path))
+    rec._min_interval = 3600.0
+    assert rec.dump("first") is not None
+    assert rec.dump("suppressed") is None    # within the window
+    assert rec.dump("forced", force=True) is not None
+
+
+def test_note_ring_is_bounded():
+    rec = flight.FlightRecorder(note_capacity=8)
+    for i in range(50):
+        rec.note("n", i=i)
+    notes = rec.notes()
+    assert len(notes) == 8 and notes[-1]["i"] == 49
+
+
+def test_injected_error_fault_leaves_flight_evidence(tmp_path):
+    """utils.faults → flight: an injected ERROR notes + dumps a bundle
+    (the chaos run's evidence trail matches a real incident's)."""
+    flight.flight_recorder.arm(str(tmp_path))
+    with inject_faults("drill.boom:error=1"):
+        with pytest.raises(Exception):
+            fault_point("drill.boom")
+    kinds = [n["kind"] for n in flight.flight_recorder.notes()]
+    assert "fault_injected" in kinds
+    assert any(d.startswith("incident-") for d in os.listdir(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# stall detection
+# ---------------------------------------------------------------------------
+
+def test_stall_detector_flags_outlier():
+    det = anomaly.StallDetector("unit.stage", z_threshold=6.0,
+                                min_samples=16, rel_floor=0.5)
+    for _ in range(30):
+        det.observe(0.010)
+    z = det.observe(0.200)                  # 20x the typical duration
+    assert z > 6.0
+    assert metrics.counter("anomaly.stalls.unit.stage").value == 1
+    assert any(n["kind"] == "stage_stall"
+               for n in flight.flight_recorder.notes())
+
+
+def test_stall_detector_quiet_stream_no_false_positive():
+    det = anomaly.StallDetector("unit.quiet", z_threshold=6.0,
+                                min_samples=16, rel_floor=0.5)
+    for i in range(200):
+        det.observe(0.010 + (i % 7) * 1e-4)     # ±7% jitter
+    assert metrics.counter("anomaly.stalls.unit.quiet").value == 0
+
+
+# ---------------------------------------------------------------------------
+# straggler board
+# ---------------------------------------------------------------------------
+
+def _stage_state(count, total_sec):
+    return {"train.step": {"type": "stage", "count": count,
+                           "total_sec": total_sec}}
+
+
+def test_straggler_board_flags_synthetic_slow_rank():
+    board = anomaly.StragglerBoard(z_threshold=4.0, min_ranks=3)
+    # 4 ranks, 3 pushes each: ranks 0-2 do 10ms steps, rank 3 does 100ms
+    for push in range(1, 4):
+        for rank in range(4):
+            per = 0.100 if rank == 3 else 0.010
+            board.update(rank, _stage_state(push * 50, push * 50 * per))
+    assert board.suspects() == ["3"]
+    snap = board.snapshot()
+    assert snap["stragglers"] == ["3"]
+    assert snap["stages"]["train.step"]["3"]["straggler"] is True
+    assert snap["stages"]["train.step"]["0"]["straggler"] is False
+    rows = dict((labels["rank"], s) for labels, s in board.series())
+    assert rows["3"]["straggler_suspect"]["value"] == 1
+    assert rows["0"]["straggler_suspect"]["value"] == 0
+
+
+def test_straggler_board_counter_reset_safe():
+    """A restarted rank (counters reset to 0) must not produce a negative
+    increment or a bogus flag."""
+    board = anomaly.StragglerBoard(min_ranks=3)
+    for rank in range(3):
+        board.update(rank, _stage_state(100, 1.0))
+        board.update(rank, _stage_state(200, 2.0))
+    board.update(0, _stage_state(10, 0.1))      # rank 0 restarted
+    assert board.suspects() == []
+
+
+def test_straggler_board_needs_min_ranks():
+    board = anomaly.StragglerBoard(min_ranks=3)
+    for push in range(1, 3):
+        board.update(0, _stage_state(push * 10, push * 0.1))
+        board.update(1, _stage_state(push * 10, push * 1.0))
+    assert board.evaluate() == {}               # 2 ranks < min_ranks
+
+
+# ---------------------------------------------------------------------------
+# SLO grammar + monitor
+# ---------------------------------------------------------------------------
+
+def test_slo_spec_parsing():
+    rules = anomaly.parse_slo_spec(
+        "serving.latency_s:field=p99:max=50ms,"
+        "q.depth:max=192,rate:min=1.5:for=3")
+    assert [r.metric for r in rules] == ["serving.latency_s", "q.depth",
+                                        "rate"]
+    assert rules[0].max_v == pytest.approx(0.05)
+    assert rules[0].field == "p99"
+    assert rules[2].min_v == 1.5 and rules[2].for_count == 3
+
+
+@pytest.mark.parametrize("bad", [
+    "", "   ", ":max=1", "m:max", "m:nope=1", "m:field=p99",
+    "m:max=abc", "m:max=1:for=x",
+])
+def test_slo_spec_bad_specs_raise(bad):
+    with pytest.raises(anomaly.SloSpecError):
+        anomaly.parse_slo_spec(bad)
+
+
+def test_slo_env_unset_is_exact_noop(monkeypatch):
+    monkeypatch.delenv("DMLC_SLO_SPEC", raising=False)
+    assert anomaly.maybe_monitor_from_env() is None
+
+
+def test_slo_default_fields_by_type():
+    reg = MetricsRegistry()
+    reg.gauge("g").set(7)
+    h = reg.histogram("h")
+    for v in [1.0] * 100:
+        h.observe(v)
+    snap = reg.snapshot()
+    assert anomaly.SloRule("g", None, 5.0, None, 1).check(snap) is not None
+    assert anomaly.SloRule("h", None, 0.5, None, 1).check(snap) is not None
+    # absent metric is NOT a breach
+    assert anomaly.SloRule("missing", None, 0.0, None, 1).check(snap) is None
+
+
+def test_slo_for_requires_consecutive_breaches():
+    reg = MetricsRegistry()
+    rule = anomaly.SloRule("g", None, 10.0, None, 3)
+    reg.gauge("g").set(99)
+    assert rule.check(reg.snapshot()) is None       # 1st
+    assert rule.check(reg.snapshot()) is None       # 2nd
+    reg.gauge("g").set(0)
+    assert rule.check(reg.snapshot()) is None       # reset
+    reg.gauge("g").set(99)
+    assert rule.check(reg.snapshot()) is None
+    assert rule.check(reg.snapshot()) is None
+    fired = rule.check(reg.snapshot())              # 3rd consecutive
+    assert fired is not None and fired["consecutive"] == 3
+
+
+def test_slo_monitor_breach_sets_gauge_and_dumps(tmp_path):
+    flight.flight_recorder.arm(str(tmp_path))
+    reg = MetricsRegistry()
+    reg.gauge("q.depth").set(500)
+    mon = anomaly.SloMonitor(anomaly.parse_slo_spec("q.depth:max=100"),
+                             registry=reg, interval_s=3600,
+                             spec="q.depth:max=100")
+    fired = mon.evaluate_once()
+    assert len(fired) == 1 and fired[0]["rule"].startswith("q.depth")
+    assert reg.gauge("slo.active_breaches").value == 1
+    assert reg.counter("slo.breaches").value == 1
+    bundles = [d for d in os.listdir(tmp_path) if "slo_breach" in d]
+    assert bundles
+    doc = json.load(open(os.path.join(tmp_path, bundles[0],
+                                      "incident.json")))
+    assert doc["detail"]["breaches"][0]["rule"].startswith("q.depth")
+    # recovery clears the gauge
+    reg.gauge("q.depth").set(1)
+    assert mon.evaluate_once() == []
+    assert reg.gauge("slo.active_breaches").value == 0
+
+
+def test_serving_health_degrades_on_slo_breach():
+    """An otherwise-healthy server reports degraded while a rule is
+    breached (the load-balancer drain signal)."""
+    jax = pytest.importorskip("jax")
+    from dmlc_core_tpu.models.cli import MODEL_REGISTRY, TrainParams
+    from dmlc_core_tpu.serving import InferenceEngine, PredictionServer
+
+    p = TrainParams()
+    p.init({"data": "x", "model": "logreg", "features": "64", "task": "binary"})
+    model = MODEL_REGISTRY["logreg"](p)
+    engine = InferenceEngine(model, model.init(jax.random.PRNGKey(0)))
+    srv = PredictionServer(engine, warmup=False)
+    try:
+        assert srv.health == "ok"
+        metrics.gauge("slo.active_breaches").set(2)
+        assert srv.health == "degraded"
+        assert metrics.gauge("serving.server.health").value == 1
+        metrics.gauge("slo.active_breaches").set(0)
+        assert srv.health == "ok"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# retrace watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_alerts_on_compile_after_steady():
+    reg = MetricsRegistry()
+    wd = xla_introspect.RetraceWatchdog(registry=reg)
+    assert wd.note_compile("r8x512", 1.2) is False      # cold: expected
+    wd.mark_steady()
+    assert not wd.alerted
+    assert wd.note_compile("r8x512", 1.3) is True       # retrace!
+    assert wd.alerted
+    assert reg.counter("xla.retrace_alerts").value == 1
+    assert reg.gauge("xla.retrace_alert").value == 1
+    assert reg.counter("xla.compiles").value == 2
+    wd.reset_alert()
+    assert not wd.alerted and reg.gauge("xla.retrace_alert").value == 0
+
+
+def test_watchdog_begin_warmup_reopens_compile_window():
+    """A checkpoint hot-reload re-warms a fresh engine; those compiles
+    are declared, not retraces — only post-window compiles alert."""
+    reg = MetricsRegistry()
+    wd = xla_introspect.RetraceWatchdog(registry=reg)
+    wd.note_compile("r8x512", 1.0)
+    wd.mark_steady()
+    wd.begin_warmup()
+    assert wd.note_compile("r8x512", 1.0) is False
+    wd.mark_steady()
+    assert wd.note_compile("r8x512", 1.0) is True
+
+
+def test_watchdog_ladder_miss_alert():
+    """The satellite case: a request falling off the no-retrace ladder
+    raises the alert and leaves flight evidence."""
+    from dmlc_core_tpu.serving.engine import BucketLadder, RequestTooLarge
+    ladder = BucketLadder([(8, 512)])
+    with pytest.raises(RequestTooLarge):
+        try:
+            ladder.select(1000, 1 << 20)
+        except RequestTooLarge as e:
+            xla_introspect.watchdog.note_ladder_miss(str(e))
+            raise
+    assert metrics.counter("xla.ladder_misses").value == 1
+    assert metrics.gauge("xla.retrace_alert").value == 1
+    assert any(n["kind"] == "ladder_miss"
+               for n in flight.flight_recorder.notes())
+    xla_introspect.watchdog.reset_alert()
+
+
+def test_engine_predict_too_large_counts_ladder_miss():
+    jax = pytest.importorskip("jax")
+    import numpy as np
+
+    from dmlc_core_tpu.models.cli import MODEL_REGISTRY, TrainParams
+    from dmlc_core_tpu.serving import InferenceEngine
+    from dmlc_core_tpu.serving.engine import BucketLadder, RequestTooLarge
+
+    p = TrainParams()
+    p.init({"data": "x", "model": "logreg", "features": "64", "task": "binary"})
+    model = MODEL_REGISTRY["logreg"](p)
+    engine = InferenceEngine(model, model.init(jax.random.PRNGKey(0)),
+                             buckets=BucketLadder([(4, 64)]))
+    before = metrics.counter("xla.ladder_misses").value
+    ids = np.zeros(1000, np.int32)
+    with pytest.raises(RequestTooLarge):
+        engine.predict(ids, np.zeros(1000, np.float32),
+                       np.arange(0, 1001, 100, dtype=np.int64)[:11])
+    assert metrics.counter("xla.ladder_misses").value == before + 1
+    xla_introspect.watchdog.reset_alert()
+
+
+def test_sample_memory_without_jax_is_quiet(monkeypatch):
+    """sample_memory never raises; with JAX importable it sets the
+    live-buffer gauge, without it it returns False."""
+    reg = MetricsRegistry()
+    assert xla_introspect.sample_memory(reg) in (True, False)
+
+
+# ---------------------------------------------------------------------------
+# exposition endpoints
+# ---------------------------------------------------------------------------
+
+def test_flight_endpoint_returns_bundle(tmp_path):
+    flight.flight_recorder.arm(str(tmp_path))
+    flight.flight_recorder.note("unit", marker="endpoint-test")
+    srv = exposition.TelemetryServer(port=0).start()
+    try:
+        code, body = _get(f"http://127.0.0.1:{srv.port}/flight")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["schema"] == "dmlc.flight.incident/1"
+        assert doc["reason"] == "endpoint"
+        assert any(n["kind"] == "unit" for n in doc["notes"])
+        assert doc["dumped_to"].startswith(str(tmp_path))
+    finally:
+        srv.stop()
+
+
+def test_stragglers_endpoint_worker_404_tracker_json():
+    srv = exposition.TelemetryServer(port=0).start()
+    try:
+        code, _ = _get(f"http://127.0.0.1:{srv.port}/stragglers")
+        assert code == 404                  # workers have no fleet view
+    finally:
+        srv.stop()
+    board = anomaly.StragglerBoard()
+    srv = exposition.TelemetryServer(port=0,
+                                     stragglers_fn=board.snapshot).start()
+    try:
+        code, body = _get(f"http://127.0.0.1:{srv.port}/stragglers")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["stragglers"] == [] and "z_threshold" in doc
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end incident drill
+# ---------------------------------------------------------------------------
+
+def test_end_to_end_incident_drill(tmp_path, monkeypatch):
+    """The acceptance drill, in one flow:
+
+    1. a ``DMLC_FAULT_SPEC``-injected stall hits a monitored stage;
+    2. the stall flags ``anomaly.stalls.*`` which breaches a
+       ``DMLC_SLO_SPEC`` rule;
+    3. the tracker ``/metrics`` flags the straggling rank that the same
+       stall would produce fleet-side;
+    4. the flight recorder emits a bundle whose Chrome trace is
+       schema-valid and whose incident JSON names the breached rule.
+    """
+    from dmlc_core_tpu.parallel.tracker import RabitTracker, send_json
+
+    monkeypatch.setenv("DMLC_SLO_SPEC",
+                       "anomaly.stalls.drill.stage:max=0")
+    monkeypatch.setenv("DMLC_FAULT_SPEC",
+                       "drill.stage:latency=80ms:lp=1:after=30")
+    flight.maybe_arm_from_env()             # unset FLIGHT_DIR → still None
+    flight.flight_recorder.arm(str(tmp_path))
+
+    # (1)+(2) — the stalled stage, under a span so the trace has content
+    det = anomaly.StallDetector("drill.stage", z_threshold=6.0,
+                                min_samples=16, rel_floor=0.5)
+    with teltrace.span("drill.run"):
+        for _ in range(32):
+            t0 = time.monotonic()
+            with teltrace.span("drill.stage.step"):
+                fault_point("drill.stage")  # 31st+ call sleeps 80ms
+            det.observe(time.monotonic() - t0)
+    assert metrics.counter("anomaly.stalls.drill.stage").value >= 1
+
+    mon = anomaly.maybe_monitor_from_env(autostart=False)
+    assert mon is not None                  # spec set → monitor exists
+    fired = mon.evaluate_once()
+    assert len(fired) == 1
+    assert fired[0]["rule"].startswith("anomaly.stalls.drill.stage")
+    assert metrics.gauge("slo.active_breaches").value == 1
+
+    # (3) — fleet side: the same slow stage, pushed rank-tagged
+    t = RabitTracker(num_workers=4, host_ip="127.0.0.1", telemetry_port=0)
+    t.start()
+    try:
+        def push(rank, count, total):
+            s = socket.create_connection((t.host_ip, t.port), timeout=5)
+            try:
+                send_json(s, {"cmd": "telemetry", "jobid": f"j{rank}",
+                              "rank": rank,
+                              "state": {"drill.stage": {
+                                  "type": "stage", "count": count,
+                                  "total_sec": total}}})
+            finally:
+                s.close()
+
+        for step in range(1, 4):
+            for rank in range(4):
+                per = 0.120 if rank == 2 else 0.012    # rank 2 straggles
+                push(rank, step * 40, step * 40 * per)
+        deadline = time.monotonic() + 10.0
+        while (time.monotonic() < deadline
+               and len(t.telemetry_states()) < 4):
+            time.sleep(0.02)
+        assert t.straggler_board.suspects() == ["2"]
+        code, body = _get(f"http://127.0.0.1:{t.telemetry.port}/metrics")
+        assert code == 200
+        assert 'dmlc_straggler_suspect{rank="2"} 1' in body.splitlines()
+        assert 'dmlc_straggler_suspect{rank="0"} 0' in body.splitlines()
+        code, body = _get(
+            f"http://127.0.0.1:{t.telemetry.port}/stragglers")
+        assert code == 200 and json.loads(body)["stragglers"] == ["2"]
+    finally:
+        t.stop()
+
+    # (4) — the evidence: bundle on disk names the rule, trace loads
+    bundles = sorted(d for d in os.listdir(tmp_path)
+                     if "slo_breach" in d)
+    assert bundles, f"no slo_breach bundle in {os.listdir(tmp_path)}"
+    bundle = os.path.join(str(tmp_path), bundles[-1])
+    doc = json.load(open(os.path.join(bundle, "incident.json")))
+    assert doc["schema"] == "dmlc.flight.incident/1"
+    assert doc["reason"] == "slo_breach"
+    assert (doc["detail"]["breaches"][0]["rule"]
+            .startswith("anomaly.stalls.drill.stage"))
+    assert doc["slo_spec"] == "anomaly.stalls.drill.stage:max=0"
+    assert doc["fault_spec"] == "drill.stage:latency=80ms:lp=1:after=30"
+    assert any(n["kind"] == "stage_stall" for n in doc["notes"])
+    trace_doc = json.load(open(os.path.join(bundle, "trace.json")))
+    _assert_chrome_trace_valid(trace_doc)
+    names = {ev["name"] for ev in trace_doc["traceEvents"]}
+    assert "drill.stage.step" in names
+    assert os.path.getsize(os.path.join(bundle, "log_tail.txt")) > 0

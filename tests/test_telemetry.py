@@ -171,6 +171,24 @@ def test_render_prometheus_sanitizes_and_labels():
     assert 'dmlc_weird_name_1_total{rank="3"} 1' in text
 
 
+def test_render_prometheus_hostile_label_values_golden():
+    """Label values carrying backslash, newline, and double-quote must be
+    escaped per the Prometheus 0.0.4 text format (backslash first, so the
+    escapes the other two introduce aren't re-escaped)."""
+    reg = MetricsRegistry()
+    reg.counter("c").add(1)
+    text = exposition.render_prometheus(
+        reg.snapshot(),
+        labels={"path": 'C:\\tmp\n"x"', "host": "plain"})
+    assert ('dmlc_c_total{host="plain",path="C:\\\\tmp\\n\\"x\\""} 1'
+            in text.splitlines())
+    # and the page stays one-line-per-sample: a raw newline in a label
+    # value would split the sample across lines
+    for ln in text.splitlines():
+        if not ln.startswith("#"):
+            float(ln.rsplit(" ", 1)[1])
+
+
 def test_render_series_single_type_header():
     """The same family across label sets must emit ONE # TYPE header."""
     r0, r1 = MetricsRegistry(), MetricsRegistry()
