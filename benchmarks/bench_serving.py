@@ -11,6 +11,9 @@ Sweeps the load axes that matter for a serving replica:
   single        1 connection, depth 1 — pure round-trip latency floor
   pipelined     1 connection, deep pipeline — micro-batcher amortization
   concurrent    N connections — contended throughput (the capacity point)
+  concurrent_ragged  the same load through ``ragged=True`` (capacity
+                ladder + runtime ``nnz_used`` instead of the 2-D bucket
+                grid) — the padding-tax comparison point
   overload      queue bound set tiny — verifies explicit shed, measures
                 goodput under 4x admission pressure
 
@@ -75,7 +78,8 @@ def main() -> int:
         "scenarios": {},
     }
 
-    def scenario(name, *, max_queue=256, arm_flight=False, **load_kw):
+    def scenario(name, *, max_queue=256, arm_flight=False, engine_kw=None,
+                 **load_kw):
         metrics.reset()
         monitor = None
         flight_dir = None
@@ -93,7 +97,8 @@ def main() -> int:
             monitor = SloMonitor(
                 parse_slo_spec("serving.latency_s:field=p99:max=1000s"),
                 interval_s=0.5).start()
-        engine = InferenceEngine(model, params, postprocess="sigmoid")
+        engine = InferenceEngine(model, params, postprocess="sigmoid",
+                                 **(engine_kw or {}))
         srv = PredictionServer(engine, max_queue=max_queue,
                                warmup=True).start()
         t0 = time.monotonic()
@@ -114,6 +119,11 @@ def main() -> int:
             k: snap["serving.latency_s"][k] * 1e3
             for k in ("p50", "p95", "p99", "mean")}
         rep["batch_occupancy"] = snap["serving.batcher.occupancy"]["value"]
+        # FLOP-basis padding tax per request (padded: bucket nnz over true
+        # nnz; ragged: 1.0 by construction) — the number the ragged mode
+        # exists to retire
+        pad = snap.get("serving.engine.padding_ratio")
+        rep["padding_ratio"] = pad["mean"] if pad else None
         # the whole registry rides in the artifact so observability data
         # (queue depths, retry counters, latency quantiles) is diffable
         # across rounds without re-running the bench
@@ -134,6 +144,12 @@ def main() -> int:
     scenario("single", concurrency=1, pipeline_depth=1)
     scenario("pipelined", concurrency=1, pipeline_depth=32)
     scenario("concurrent", concurrency=4, pipeline_depth=16)
+    # same capacity-point load through the ragged engine (ISSUE 6):
+    # 3-tier capacity ladder + runtime nnz_used instead of the 2-D bucket
+    # grid — compare qps at equal p99 and padding_ratio against
+    # "concurrent" above
+    scenario("concurrent_ragged", concurrency=4, pipeline_depth=16,
+             engine_kw={"ragged": True})
     scenario("overload", concurrency=8, pipeline_depth=32, max_queue=16)
     # flight-recorder overhead: back-to-back identical runs, recorder off
     # vs armed (+SLO monitor at 2Hz); the acceptance bar is <2% on p50
@@ -155,6 +171,24 @@ def main() -> int:
     cc = report["scenarios"]["concurrent"]
     report["qps"] = cc["qps"]
     report["latency_ms"] = cc["latency_ms"]
+    # ragged-vs-bucket at the capacity point: the ISSUE 6 headline pair —
+    # qps at equal (load, p99 budget) plus the padding ratio each engine
+    # paid and how many programs it had to compile to serve the sweep
+    cr = report["scenarios"]["concurrent_ragged"]
+    report["ragged_vs_padded"] = {
+        "qps_padded": cc["qps"], "qps_ragged": cr["qps"],
+        "p99_ms_padded": cc["latency_ms"]["p99"],
+        "p99_ms_ragged": cr["latency_ms"]["p99"],
+        "padding_ratio_padded": cc["padding_ratio"],
+        "padding_ratio_ragged": cr["padding_ratio"],
+        "compiles_padded": cc["compile_count"],
+        "compiles_ragged": cr["compile_count"],
+    }
+    log(f"ragged vs padded: qps {cc['qps']:.0f} -> {cr['qps']:.0f}, "
+        f"p99 {cc['latency_ms']['p99']:.2f} -> "
+        f"{cr['latency_ms']['p99']:.2f}ms, padding_ratio "
+        f"{cc['padding_ratio']:.2f} -> {cr['padding_ratio']:.2f}, "
+        f"compiles {cc['compile_count']} -> {cr['compile_count']}")
 
     if telemetry_prefix:
         # one short SYNCHRONOUS predict sequence: run_load drives async

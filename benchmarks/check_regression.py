@@ -42,10 +42,14 @@ _BENCH_RE = re.compile(r"^BENCH_(?:(?P<family>.+)_)?r(?P<round>\d+)"
                        r"(?P<partial>_partial)?\.json$")
 
 _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
-                  "goodput", "ok", "hits", "speedup", "mfu")
+                  "goodput", "ok", "hits", "speedup", "mfu", "fill")
+# padding_ratio (padded-nnz / true-nnz, ISSUE 6 ragged path): 1.0 is the
+# floor, every point above it is padding tax — lower is better.  The
+# ragged scenario families (ingest_ragged, *_ragged serving scenarios)
+# need no extra tokens: their qps/latency/rows keys classify as usual.
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
-                 "errors", "misses")
+                 "errors", "misses", "padding_ratio", "truncated")
 
 
 def _direction(key: str) -> Optional[str]:

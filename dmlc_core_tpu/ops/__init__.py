@@ -10,6 +10,9 @@ from .csr import csr_dense_matvec, csr_embed_sum, fm_pairwise  # noqa: F401
 __all__ = ["csr_dense_matvec", "csr_embed_sum", "fm_pairwise",
            "embed_bag", "embed_bag_pallas", "embed_bag_reference",
            "fm_embed_terms",
+           "ragged_segment_sum", "ragged_dense_matvec",
+           "ragged_embed_sum", "ragged_fm_pairwise",
+           "mask_ragged", "mask_batch",
            "make_ring_attention", "reference_attention",
            "make_ulysses_attention"]
 
@@ -23,6 +26,12 @@ def __getattr__(name):
         "embed_bag_pallas": "pallas_embed",
         "fm_embed_terms": "pallas_embed",
         "embed_bag_reference": "pallas_embed",
+        "ragged_segment_sum": "ragged_csr",
+        "ragged_dense_matvec": "ragged_csr",
+        "ragged_embed_sum": "ragged_csr",
+        "ragged_fm_pairwise": "ragged_csr",
+        "mask_ragged": "ragged_csr",
+        "mask_batch": "ragged_csr",
         "make_ring_attention": "ring_attention",
         "reference_attention": "ring_attention",
         "make_ulysses_attention": "ulysses",

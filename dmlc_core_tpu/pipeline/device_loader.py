@@ -44,7 +44,8 @@ from ..data.parser import ParserBase
 from ..telemetry import trace as teltrace
 from ..utils import ThreadedIter, check
 from . import page_cache
-from .packing import PackStats, batch_slices, pack_flat, pack_rowmajor
+from .packing import (PackStats, batch_slices, pack_flat, pack_ragged,
+                      pack_rowmajor, ragged_slices)
 
 __all__ = ["DeviceLoader", "make_decoder"]
 
@@ -417,6 +418,19 @@ class DeviceLoader:
                    service (:mod:`dmlc_core_tpu.pipeline.ingest_service`).
                    Requires the fused path (flat layout, no sharding, no
                    fields).  Recycle consumed buffers via ``recycle(buf)``.
+    ragged:        pack by **cumulative true nnz** against ``nnz_cap``
+                   instead of padding every batch to it: batches keep the
+                   flat-CSR capacity shapes but carry ``nnz_used`` /
+                   ``rows_used`` prefix scalars and garbage tails
+                   (``pack_ragged``) — consumers mask via
+                   ``ops.ragged_csr`` (``mask_batch``) or the ragged
+                   kernels.  Never truncates: a row that alone exceeds
+                   ``nnz_cap`` raises.  Requires the flat layout and no
+                   sharding, forces the python per-array path (the fused
+                   wire formats carry no prefix words), and disables the
+                   page cache (fused-path only; the ``ragged``
+                   fingerprint field keeps stale padded pages from ever
+                   serving a ragged loader).
     cache:         packed-page epoch cache (:mod:`.page_cache`).  "auto"
                    (default): enabled when the source URI carried a
                    ``#cachefile`` fragment (the page file lands at
@@ -435,9 +449,18 @@ class DeviceLoader:
                  prefetch: int = 2, drop_remainder: bool = False,
                  id_mod: int = 0, put_threads="auto",
                  wire_compact="auto", fields: bool = False,
-                 emit: str = "device", cache="auto"):
+                 emit: str = "device", cache="auto",
+                 ragged: bool = False):
         check(layout in ("flat", "rowmajor"), f"bad layout {layout!r}")
         check(emit in ("device", "host"), f"bad emit {emit!r}")
+        if ragged:
+            check(layout == "flat" and sharding is None,
+                  "ragged=True requires the flat layout and no sharding "
+                  "(prefix scalars don't shard over a batch axis)")
+            check(emit == "device",
+                  "ragged=True is incompatible with emit='host' (the "
+                  "fused wire layouts carry no nnz_used prefix)")
+        self.ragged = bool(ragged)
         if emit == "host":
             check(layout == "flat" and sharding is None and not fields,
                   "emit='host' requires the fused path "
@@ -493,7 +516,8 @@ class DeviceLoader:
     def _use_native_pack(self) -> bool:
         from .. import native
         return (self.layout == "flat" and self.sharding is None
-                and not self.fields and native.has_packer())
+                and not self.fields and not self.ragged
+                and native.has_packer())
 
     def _use_streampack(self) -> bool:
         """Fused native parse→pack: text chunks straight into wire batches,
@@ -522,7 +546,7 @@ class DeviceLoader:
         if cache in (None, False, ""):
             return None
         fused = (self.layout == "flat" and self.sharding is None
-                 and not self.fields)
+                 and not self.fields and not self.ragged)
         if cache == "auto":
             if not fused:
                 return None
@@ -586,6 +610,10 @@ class DeviceLoader:
             "id_mod": int(self.id_mod),
             "wire_compact": self.wire_compact,
             "drop_remainder": bool(self.drop_remainder),
+            # new pack-config field (ISSUE 6): shifts every pre-ragged
+            # fingerprint once, so pages written before this field existed
+            # rebuild instead of silently serving a ragged-incompatible pack
+            "ragged": bool(self.ragged),
             "pack_path": pack_path,
             "text_format": self._src_attr("text_format"),
             "csv": [self._src_attr("csv_label_col", -1),
@@ -670,6 +698,9 @@ class DeviceLoader:
 
     def _host_items_uncached(self) -> Iterator:
         self._maybe_bind()
+        if self.ragged:
+            yield from self._host_items_ragged()
+            return
         if self._use_streampack():
             yield from self._host_items_streampack()
             return
@@ -701,6 +732,54 @@ class DeviceLoader:
                         yield self._pack_host(full, fused)
         if carry is not None and carry.rows > 0 and not self.drop_remainder:
             yield self._pack_host(carry.flush(), fused)
+
+    def _host_items_ragged(self) -> Iterator:
+        """Ragged packing: accumulate source blocks in row order, cut by
+        cumulative true nnz (``ragged_slices``), and hold back the last —
+        possibly partial — cut so rows from the next source block can top
+        it up (the carry discipline of the padded path, but the "is it
+        full" test is the nnz budget, not the row count)."""
+        from ..data.row_block import RowBlockContainer
+
+        def _nnz(b) -> int:
+            o = b.offsets
+            return int(o[-1] - o[0])
+
+        acc = RowBlockContainer()
+        acc_nnz = 0
+        for blk in self._blocks():
+            acc.push_block(blk)
+            acc_nnz += _nnz(blk)
+            if acc.size < self.batch_rows and acc_nnz < self.nnz_cap:
+                continue
+            big = acc.get_block()
+            acc = RowBlockContainer()
+            acc_nnz = 0
+            pieces = list(ragged_slices(big, self.batch_rows,
+                                        self.nnz_cap))
+            for piece in pieces[:-1]:
+                yield self._pack_host_ragged(piece)
+            acc.push_block(pieces[-1])      # may still take more rows
+            acc_nnz = _nnz(pieces[-1])
+        if acc.size:
+            big = acc.get_block()
+            pieces = list(ragged_slices(big, self.batch_rows,
+                                        self.nnz_cap))
+            if self.drop_remainder:
+                pieces = pieces[:-1]        # final partial batch dropped
+            for piece in pieces:
+                yield self._pack_host_ragged(piece)
+
+    def _pack_host_ragged(self, block):
+        t0 = time.monotonic()
+        with teltrace.span("device_loader.pack", rows=block.size,
+                           ragged=True), self._m_pack.time():
+            host = pack_ragged(block, self.batch_rows, self.nnz_cap,
+                               self.stats, id_mod=self.id_mod,
+                               want_fields=self.fields)
+            host["_rows"] = block.size
+        self._stall_pack.observe(time.monotonic() - t0)
+        return ("arrays", host)
 
     def _pack_host(self, block, fused: bool):
         t0 = time.monotonic()

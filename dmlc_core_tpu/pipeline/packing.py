@@ -11,22 +11,39 @@ arrays before hitting the TPU.  Two layouts:
   overflow ``nnz_cap`` are truncated (counted in ``truncated``).
 * :func:`pack_rowmajor` — row-padded ``ids/vals[batch_rows, k_cap]`` for the
   Pallas embedding-bag kernel.
+* :func:`pack_ragged` — same flat layout as :func:`pack_flat` but **no
+  tail zeroing and no truncation**: the nnz-sized arrays are
+  ``np.empty`` capacity buffers valid only up to an explicit ``nnz_used``
+  prefix word (``ops.ragged_csr`` consumes them; everything past the
+  prefix is garbage by contract).  Batches are cut by *cumulative true
+  nnz* against the capacity (:func:`ragged_slices`), so fill level — not
+  a padding ceiling — sets throughput; a row that alone exceeds the
+  capacity raises instead of being silently clipped.
 
 Padding rows carry ``weight 0`` so losses ignore them without masking logic.
+
+Truncation is **surfaced** (ISSUE 6 satellite): any pack that drops
+values bumps the process-global ``pipeline.pack.truncated_values`` /
+``pipeline.pack.truncated_rows`` counters and logs a rate-limited
+WARNING, so existing ``pack_flat`` users learn they are losing data
+instead of discovering it in eval metrics.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 from ..data.row_block import RowBlock
-from ..utils.logging import IdOverflowError
+from ..utils.logging import IdOverflowError, log_warning
+from ..utils.metrics import metrics
 
-__all__ = ["pack_flat", "pack_rowmajor", "batch_slices", "PackStats",
-           "IdOverflowError"]
+__all__ = ["pack_flat", "pack_rowmajor", "pack_ragged", "batch_slices",
+           "ragged_slices", "PackStats", "IdOverflowError"]
 
 
 @dataclass
@@ -34,6 +51,44 @@ class PackStats:
     rows: int = 0
     padded_rows: int = 0
     truncated_values: int = 0
+    truncated_rows: int = 0
+    # padding-ratio accounting (padded_nnz / true_nnz is the headline
+    # padding tax): true_nnz = values the data actually holds, padded_nnz
+    # = values the dense math reduces over (nnz_cap per flat batch; true
+    # nnz per ragged batch — that is the whole point)
+    true_nnz: int = 0
+    padded_nnz: int = 0
+
+    @property
+    def padding_ratio(self) -> float:
+        return self.padded_nnz / self.true_nnz if self.true_nnz else 1.0
+
+
+_trunc_warn_lock = threading.Lock()
+_trunc_warn_last = [0.0]
+_TRUNC_WARN_EVERY_S = 60.0
+
+
+def _note_truncation(values: int, rows: int, where: str) -> None:
+    """Satellite fix for silent ``pack_flat`` truncation: bump the
+    process-global counters and WARN (at most once per minute — packing
+    runs per batch on the hot path)."""
+    if values <= 0:
+        return
+    metrics.counter("pipeline.pack.truncated_values").add(values)
+    metrics.counter("pipeline.pack.truncated_rows").add(rows)
+    now = time.monotonic()
+    with _trunc_warn_lock:
+        fire = now - _trunc_warn_last[0] >= _TRUNC_WARN_EVERY_S
+        if fire:
+            _trunc_warn_last[0] = now
+    if fire:
+        log_warning(
+            "%s dropped %d value(s) across %d row(s) that overflowed the "
+            "batch capacity — data is being truncated; raise nnz_cap/k_cap "
+            "or switch to the ragged path (pack_ragged / ragged ops), "
+            "which never truncates (total drops: see "
+            "pipeline.pack.truncated_values)", where, values, rows)
 
 
 def _ids32(idx: np.ndarray, id_mod: int) -> np.ndarray:
@@ -144,6 +199,7 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
         # one-by-one to the longest rows — short rows keep everything and
         # only the minimum number of values is dropped
         keep = _waterfill(counts, nnz_cap)
+        trunc_rows = int(np.count_nonzero(keep < counts))
         pos = 0
         for r in range(n):
             k = int(keep[r])
@@ -159,6 +215,7 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
                 fields[pos:pos + k] = block.fields[b:b + k]
             pos += k
         truncated = total - pos
+        _note_truncation(truncated, trunc_rows, "pack_flat")
         row_ptr[0] = 0
         row_ptr[1:n + 1] = np.cumsum(keep)
         row_ptr[n + 1:] = pos
@@ -172,6 +229,10 @@ def pack_flat(block: RowBlock, batch_rows: int, nnz_cap: int,
         stats.rows += n
         stats.padded_rows += batch_rows - n
         stats.truncated_values += truncated
+        if truncated:
+            stats.truncated_rows += trunc_rows
+        stats.true_nnz += total - truncated
+        stats.padded_nnz += nnz_cap
     out = {"ids": ids, "vals": vals, "row_ptr": row_ptr,
            "labels": labels, "weights": weights}
     if want_segments:
@@ -200,10 +261,12 @@ def pack_rowmajor(block: RowBlock, batch_rows: int, k_cap: int,
               if want_fields else None)
     offsets = block.offsets.astype(np.int64)
     truncated = 0
+    trunc_rows = 0
     for r in range(n):
         b, e = int(offsets[r]), int(offsets[r + 1])
         k = min(e - b, k_cap)
         truncated += (e - b) - k
+        trunc_rows += (e - b) > k
         ids[r, :k] = _ids32(block.indices[b:b + k], id_mod)
         if block.values is not None:
             vals[r, :k] = block.values[b:b + k]
@@ -216,11 +279,114 @@ def pack_rowmajor(block: RowBlock, batch_rows: int, k_cap: int,
     labels[:n] = block.labels
     weights[:n] = (block.weights if block.weights is not None
                    else np.ones(n, np.float32))
+    _note_truncation(truncated, trunc_rows, "pack_rowmajor")
     if stats is not None:
         stats.rows += n
         stats.padded_rows += batch_rows - n
         stats.truncated_values += truncated
+        stats.truncated_rows += trunc_rows
+        stats.true_nnz += int(offsets[n] - offsets[0]) - truncated
+        stats.padded_nnz += batch_rows * k_cap
     out = {"ids": ids, "vals": vals, "labels": labels, "weights": weights}
+    if want_fields:
+        out["fields"] = fields
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ragged packing: capacity buffers + nnz_used prefix, never truncates
+# ---------------------------------------------------------------------------
+
+def ragged_slices(block: RowBlock, batch_rows: int,
+                  nnz_cap: int) -> Iterator[RowBlock]:
+    """Split a RowBlock into consecutive slices cut by **cumulative true
+    nnz** against ``nnz_cap`` (and rows against ``batch_rows``) — the
+    ragged twin of :func:`batch_slices`, whose cut points depend only on
+    the row count.  O(1) views; a single row whose nnz exceeds
+    ``nnz_cap`` raises ``ValueError`` (the ragged contract is *never
+    truncate* — rows that would overflow start the next batch, and a row
+    that cannot fit any batch is a config error, not data loss)."""
+    offsets = block.offsets.astype(np.int64)
+    rel = offsets - offsets[0]
+    start = 0
+    while start < block.size:
+        # largest end with rel[end] - rel[start] <= nnz_cap
+        end = int(np.searchsorted(rel, rel[start] + nnz_cap,
+                                  side="right")) - 1
+        end = min(end, start + batch_rows, block.size)
+        if end <= start:
+            raise ValueError(
+                f"row {start} holds {int(rel[start + 1] - rel[start])} "
+                f"values > nnz_cap={nnz_cap}; the ragged path never "
+                f"truncates — raise the capacity")
+        yield block.slice(start, end)
+        start = end
+
+
+def pack_ragged(block: RowBlock, batch_rows: int, nnz_cap: int,
+                stats: Optional[PackStats] = None,
+                id_mod: int = 0,
+                want_fields: bool = False) -> Dict[str, np.ndarray]:
+    """Flat-CSR **capacity** batch: same keys/shapes as
+    :func:`pack_flat` (so every downstream shape contract holds) plus
+    the ``nnz_used`` / ``rows_used`` int32 prefix words, with the
+    nnz-sized arrays allocated ``np.empty`` and written only up to
+    ``nnz_used`` — no tail zeroing, which on wide capacities is most of
+    ``pack_flat``'s host wall.  Entries past ``nnz_used`` are
+    **garbage by contract**; consumers must mask (``ops.ragged_csr``)
+    or slice.  Row-sized arrays (``row_ptr/labels/weights``) do get
+    clean tails — they are small and a zero tail removes the NaN
+    footgun for consumers that reduce over all rows.
+
+    Raises instead of truncating when the block exceeds either capacity
+    (cut upstream with :func:`ragged_slices`)."""
+    n = block.size
+    if n > batch_rows:
+        raise ValueError(f"block rows {n} > batch_rows {batch_rows}")
+    if want_fields and block.fields is None:
+        raise ValueError(
+            "want_fields=True but the source RowBlock has no fields — "
+            "parse with format='libfm'")
+    offsets = block.offsets.astype(np.int64)
+    rel = offsets - offsets[0]
+    total = int(rel[-1])
+    if total > nnz_cap:
+        raise ValueError(
+            f"block nnz {total} > nnz_cap {nnz_cap}; the ragged path "
+            f"never truncates — cut with ragged_slices")
+
+    ids = np.empty(nnz_cap, np.int32)        # garbage tails by contract
+    vals = np.empty(nnz_cap, np.float32)
+    segments = np.empty(nnz_cap, np.int32)
+    fields = np.empty(nnz_cap, np.int32) if want_fields else None
+    src_idx = slice(int(offsets[0]), int(offsets[0]) + total)
+    ids[:total] = _ids32(block.indices[src_idx], id_mod)
+    if block.values is not None:
+        vals[:total] = block.values[src_idx]
+    else:
+        vals[:total] = 1.0
+    counts = np.diff(rel)
+    segments[:total] = np.repeat(np.arange(n, dtype=np.int32), counts)
+    if want_fields:
+        fields[:total] = block.fields[src_idx]
+
+    row_ptr = np.empty(batch_rows + 1, np.int32)
+    row_ptr[:n + 1] = rel
+    row_ptr[n + 1:] = total
+    labels = np.zeros(batch_rows, np.float32)
+    weights = np.zeros(batch_rows, np.float32)
+    labels[:n] = block.labels
+    weights[:n] = (block.weights if block.weights is not None
+                   else np.ones(n, np.float32))
+
+    if stats is not None:
+        stats.rows += n
+        stats.padded_rows += batch_rows - n
+        stats.true_nnz += total
+        stats.padded_nnz += total     # ragged math reduces true nnz only
+    out = {"ids": ids, "vals": vals, "segments": segments,
+           "row_ptr": row_ptr, "labels": labels, "weights": weights,
+           "nnz_used": np.int32(total), "rows_used": np.int32(n)}
     if want_fields:
         out["fields"] = fields
     return out

@@ -6,7 +6,11 @@ users"):
 
 * :mod:`engine`  — shape-bucketed AOT jit forward over the model zoo;
   ragged CSR requests pad into a pre-compiled bucket ladder (no request
-  ever retraces) with atomic checkpoint hot-reload.
+  ever retraces) with atomic checkpoint hot-reload.  ``ragged=True``
+  (CLI ``ragged=1`` / env ``DMLC_SERVE_RAGGED``) swaps the 2-D bucket
+  grid for a 2–3 tier capacity ladder: fill level rides as a runtime
+  ``nnz_used`` scalar (``ops.ragged_csr``), request padding is
+  ``np.empty``, and scores stay bit-identical.
 * :mod:`batcher` — dynamic micro-batching (size OR delay trigger),
   bounded admission with explicit overload rejection, per-request
   deadlines, graceful drain.

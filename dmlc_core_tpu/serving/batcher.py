@@ -18,6 +18,14 @@ deadline; requests that expire while queued are failed with
 :class:`DeadlineExceeded` *without* wasting an engine slot on an answer
 nobody is waiting for.
 
+The size trigger counts **true** rows and values — not bucket ceilings —
+so with a ragged engine (``InferenceEngine(ragged=True)``) the cut batch
+is already nnz-packed: the engine ships it at its real fill level and no
+second packing pass exists.  ``serving.batcher.batch_nnz`` /
+``serving.batcher.batch_fill`` record the cut sizes so the padding tax
+(engine-side ``serving.engine.padding_ratio``) can be attributed to
+ladder shape vs traffic shape.
+
 ``close(drain=True)`` stops admissions, lets the worker flush everything
 queued, and joins — the graceful half of shutdown; ``drain=False`` fails
 queued requests immediately (the process-is-dying half).
@@ -112,6 +120,8 @@ class MicroBatcher:
         self._m_batches = m.counter("serving.batcher.batches")
         self._m_reqs = m.throughput("serving.batcher.requests")
         self._m_latency = m.histogram("serving.latency_s")
+        self._m_nnz = m.histogram("serving.batcher.batch_nnz")
+        self._m_fill = m.gauge("serving.batcher.batch_fill")
 
     def _maybe_rebind(self) -> None:
         if self._m_gen != metrics.generation:
@@ -265,6 +275,8 @@ class MicroBatcher:
             self._m_batches.add(1)
             self._m_occ.set(sum(p.rows for p in live)
                             / max(1, self.max_batch_rows))
+            self._m_nnz.observe(len(ids))
+            self._m_fill.set(len(ids) / max(1, self.max_batch_nnz))
             done_t = time.monotonic()
             r0 = 0
             for p in live:

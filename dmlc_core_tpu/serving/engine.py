@@ -13,6 +13,19 @@ other shape instead of silently retracing, so the no-retrace invariant is
 structural, not aspirational — ``compile_count`` can never exceed the
 ladder size.
 
+**Ragged mode** (``ragged=True``) keeps the no-retrace invariant but
+drops the padding tax that funds it: batches keep static *capacity*
+shapes while the fill level travels as ``nnz_used``/``rows_used``
+runtime scalars (the ``ops.ragged_csr`` layout), so one executable per
+capacity serves every fill level and the 2-D bucket grid collapses to a
+2–3 tier capacity ladder (``BucketLadder.ragged_default``).  The
+compiled forward masks the garbage tails back to the padded convention
+(``mask_batch``), so every zoo model serves unchanged and scores are
+bit-identical to the padded path.  Request padding becomes ``np.empty``
+— no host-side tail zeroing — and steady-state compile count is bounded
+by the (much smaller) ladder, which the retrace watchdog proves under
+mixed traffic.
+
 Model **hot-reload** swaps the param tree atomically (one reference
 assignment under a lock) after validating that shapes/dtypes match the
 compiled avals; requests already holding the old tree finish on the old
@@ -24,6 +37,7 @@ straight from a `utils.checkpoint` directory via
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -69,6 +83,9 @@ class BucketLadder:
         self.buckets.sort(key=lambda b: (b.rows * b.nnz, b.rows))
         self.max_rows = max(b.rows for b in self.buckets)
         self.max_nnz = max(b.nnz for b in self.buckets)
+        # precomputed areas for best_fit's bisect early-exit (the list is
+        # area-sorted, so this is a valid bisect key)
+        self._areas = [b.rows * b.nnz for b in self.buckets]
 
     @classmethod
     def default(cls, max_rows: int = 128, max_nnz: int = 8192,
@@ -87,14 +104,43 @@ class BucketLadder:
             r *= 2
         return cls(rungs)
 
-    def select(self, rows: int, nnz: int) -> ShapeBucket:
-        for b in self.buckets:          # sorted by area: first fit is best
+    @classmethod
+    def ragged_default(cls, max_rows: int = 128, max_nnz: int = 8192,
+                       tiers: int = 3) -> "BucketLadder":
+        """Capacity ladder for the ragged engine: because ``nnz_used`` is
+        a runtime scalar, capacity only bounds memory — fill level no
+        longer sets cost — so 2–3 geometric tiers replace the 2-D bucket
+        grid (compare ``default()``'s 9 rungs).  Tiers halve rows and nnz
+        together from the max."""
+        check(tiers >= 1, "need at least one capacity tier")
+        rungs = []
+        r, n = max_rows, max_nnz
+        for _ in range(tiers):
+            rungs.append((max(r, 1), max(n, 1)))
+            r //= 2
+            n //= 2
+        return cls(rungs)
+
+    def best_fit(self, rows: int, nnz: int) -> ShapeBucket:
+        """Smallest-area bucket that fits — the serving hot path.
+
+        Any bucket that fits has ``b.rows ≥ rows`` and ``b.nnz ≥ nnz``,
+        hence area ``≥ rows·nnz``; since the list is area-sorted, every
+        bucket before ``bisect_left(areas, rows·nnz)`` is provably too
+        small and the scan starts there instead of at 0 (the golden sweep
+        in ``tests/test_ragged.py`` pins selection identical to the full
+        linear scan for every (rows, nnz))."""
+        start = bisect.bisect_left(self._areas, rows * nnz)
+        for b in self.buckets[start:]:  # area-sorted: first fit is best
             if b.rows >= rows and b.nnz >= nnz:
                 return b
         raise RequestTooLarge(
             f"request ({rows} rows, {nnz} nnz) exceeds the largest bucket "
             f"({self.max_rows} rows, {self.max_nnz} nnz) — split the "
             f"request or widen the ladder")
+
+    def select(self, rows: int, nnz: int) -> ShapeBucket:
+        return self.best_fit(rows, nnz)
 
     def __len__(self) -> int:
         return len(self.buckets)
@@ -141,6 +187,36 @@ def _pad_to_bucket(bucket: ShapeBucket, ids: np.ndarray, vals: np.ndarray,
             "row_ptr": out_ptr, "labels": labels, "weights": weights}
 
 
+def _pad_to_capacity(bucket: ShapeBucket, ids: np.ndarray,
+                     vals: np.ndarray,
+                     row_ptr: np.ndarray) -> Dict[str, np.ndarray]:
+    """CSR request → ragged capacity batch: the ``pack_ragged`` layout.
+    The nnz-sized arrays are ``np.empty`` — no tail zeroing on the
+    request path, which at low fill is most of ``_pad_to_bucket``'s host
+    wall — and validity ends at the ``nnz_used``/``rows_used`` prefix
+    words (the compiled forward masks, see ``ops.ragged_csr.mask_batch``).
+    Row-sized arrays keep clean tails: they are small and the zero weight
+    is what strips padding rows from every loss/score reduction."""
+    rows = len(row_ptr) - 1
+    nnz = len(ids)
+    out_ids = np.empty(bucket.nnz, np.int32)
+    out_vals = np.empty(bucket.nnz, np.float32)
+    segments = np.empty(bucket.nnz, np.int32)
+    out_ids[:nnz] = ids
+    out_vals[:nnz] = vals
+    counts = np.diff(row_ptr.astype(np.int64))
+    segments[:nnz] = np.repeat(np.arange(rows, dtype=np.int32), counts)
+    out_ptr = np.empty(bucket.rows + 1, np.int32)
+    out_ptr[:rows + 1] = row_ptr
+    out_ptr[rows + 1:] = nnz
+    labels = np.zeros(bucket.rows, np.float32)
+    weights = np.zeros(bucket.rows, np.float32)
+    weights[:rows] = 1.0
+    return {"ids": out_ids, "vals": out_vals, "segments": segments,
+            "row_ptr": out_ptr, "labels": labels, "weights": weights,
+            "nnz_used": np.int32(nnz), "rows_used": np.int32(rows)}
+
+
 class InferenceEngine:
     """Bucketed AOT forward engine with atomic hot-reload.
 
@@ -159,13 +235,15 @@ class InferenceEngine:
     def __init__(self, model, params: Any, *,
                  buckets: Optional[BucketLadder] = None,
                  postprocess: str = "none", donate: str = "auto",
-                 warmup: bool = False) -> None:
+                 warmup: bool = False, ragged: bool = False) -> None:
         check(postprocess in ("none", "sigmoid"),
               f"bad postprocess {postprocess!r}")
         import jax
 
         self.model = model
-        self.ladder = buckets or BucketLadder.default()
+        self.ragged = bool(ragged)
+        self.ladder = buckets or (BucketLadder.ragged_default() if ragged
+                                  else BucketLadder.default())
         self._postprocess = postprocess
         self._donate = (donate == "always" or
                         (donate == "auto"
@@ -190,6 +268,7 @@ class InferenceEngine:
         self._m_fwd = m.stage("serving.engine.forward")
         self._m_occupancy = m.gauge("serving.engine.occupancy")
         self._m_version = m.gauge("serving.engine.params_version")
+        self._m_padding = m.histogram("serving.engine.padding_ratio")
 
     def _maybe_rebind(self) -> None:
         if self._m_gen != metrics.generation:
@@ -199,7 +278,16 @@ class InferenceEngine:
     def _forward_fn(self):
         import jax
 
+        ragged = self.ragged
+        if ragged:
+            from ..ops.ragged_csr import mask_batch
+
         def fwd(params, batch):
+            if ragged:
+                # garbage tails → padded convention INSIDE the compiled
+                # program: every zoo model's flat forward serves ragged
+                # batches unchanged, and the mask fuses with the gather
+                batch = mask_batch(batch)
             out = self.model.forward(params, batch)
             if self._postprocess == "sigmoid":
                 out = jax.nn.sigmoid(out)
@@ -209,7 +297,7 @@ class InferenceEngine:
     def _batch_avals(self, bucket: ShapeBucket):
         import jax
         f32, i32 = np.dtype(np.float32), np.dtype(np.int32)
-        return {
+        avals = {
             "ids": jax.ShapeDtypeStruct((bucket.nnz,), i32),
             "vals": jax.ShapeDtypeStruct((bucket.nnz,), f32),
             "segments": jax.ShapeDtypeStruct((bucket.nnz,), i32),
@@ -217,10 +305,16 @@ class InferenceEngine:
             "labels": jax.ShapeDtypeStruct((bucket.rows,), f32),
             "weights": jax.ShapeDtypeStruct((bucket.rows,), f32),
         }
+        if self.ragged:
+            # runtime fill level: scalar operands, not shape — one
+            # executable per CAPACITY serves every fill level
+            avals["nnz_used"] = jax.ShapeDtypeStruct((), i32)
+            avals["rows_used"] = jax.ShapeDtypeStruct((), i32)
+        return avals
 
-    @staticmethod
-    def _bucket_key(bucket: ShapeBucket) -> str:
-        return f"r{bucket.rows}x{bucket.nnz}"
+    def _bucket_key(self, bucket: ShapeBucket) -> str:
+        return (f"ragged-r{bucket.rows}x{bucket.nnz}" if self.ragged
+                else f"r{bucket.rows}x{bucket.nnz}")
 
     def _get_compiled(self, bucket: ShapeBucket):
         exe = self._compiled.get(bucket)
@@ -257,12 +351,12 @@ class InferenceEngine:
         retrace watchdog treats every further compile as an alert: the
         ladder is complete, so a compile means traffic fell off it."""
         xla_introspect.watchdog.begin_warmup()
+        pad = _pad_to_capacity if self.ragged else _pad_to_bucket
         for bucket in self.ladder:
             exe = self._get_compiled(bucket)
-            dummy = _pad_to_bucket(
-                bucket,
-                np.zeros(1, np.int32), np.zeros(1, np.float32),
-                np.array([0, 1], np.int64))
+            dummy = pad(bucket,
+                        np.zeros(1, np.int32), np.zeros(1, np.float32),
+                        np.array([0, 1], np.int64))
             np.asarray(exe(self._params, dummy))
         xla_introspect.watchdog.mark_steady()
 
@@ -286,23 +380,35 @@ class InferenceEngine:
         check(int(row_ptr[0]) == 0 and int(row_ptr[-1]) == len(ids),
               "row_ptr does not cover ids")
         try:
-            bucket = self.ladder.select(rows, max(len(ids), 1))
+            bucket = self.ladder.best_fit(rows, max(len(ids), 1))
         except RequestTooLarge as e:
             xla_introspect.watchdog.note_ladder_miss(str(e))
             raise
-        batch = _pad_to_bucket(bucket, ids, vals, row_ptr)
+        if self.ragged:
+            batch = _pad_to_capacity(bucket, ids, vals, row_ptr)
+        else:
+            batch = _pad_to_bucket(bucket, ids, vals, row_ptr)
         params = self._params          # atomic read: hot-reload safe
         exe = self._get_compiled(bucket)
         self._maybe_rebind()
         # nested under the batcher-activated request context when the
         # call came off a traced wire request; a new root otherwise
         with teltrace.span("serving.engine.forward", rows=rows,
-                           bucket_rows=bucket.rows, bucket_nnz=bucket.nnz):
+                           bucket_rows=bucket.rows, bucket_nnz=bucket.nnz,
+                           ragged=self.ragged):
             with self._m_fwd.time():
                 out = np.asarray(exe(params, batch))
         self._m_batches.add(1)
         self._m_rows.add(rows)
         self._m_occupancy.set(rows / bucket.rows)
+        # padded-nnz / true-nnz on the FLOP basis the compiled program
+        # commits to: the padded program reduces the whole bucket, the
+        # ragged program's semantic width is nnz_used (the XLA fallback
+        # still streams the masked tail; only the Pallas kernel retires
+        # those FLOPs — see ops.ragged_csr)
+        true_nnz = max(len(ids), 1)
+        self._m_padding.observe(1.0 if self.ragged
+                                else bucket.nnz / true_nnz)
         return out[:rows]
 
     # -- hot reload -----------------------------------------------------

@@ -406,9 +406,13 @@ def serve_main(argv=None) -> int:
     if not args.get("ckpt_dir") or not args.get("features"):
         print("usage: serving.server ckpt_dir=DIR features=N [model=fm] "
               "[dim=16] [task=binary] [port=0] [host=0.0.0.0] "
-              "[watch_s=10] [max_delay_ms=2] [max_queue=256]",
+              "[watch_s=10] [max_delay_ms=2] [max_queue=256] "
+              "[ragged=0|1]   (env DMLC_SERVE_RAGGED=1 is the default "
+              "for ragged=)",
               file=sys.stderr)
         return 2
+    import os
+
     import jax
 
     from ..models.cli import MODEL_REGISTRY, TrainParams
@@ -418,9 +422,14 @@ def serve_main(argv=None) -> int:
             "task": args.get("task", "binary")})
     model = MODEL_REGISTRY[p.model](p)
     params = model.init(jax.random.PRNGKey(0))
+    # ragged capacity engine: CLI key wins, env var is the fleet-wide
+    # default (flip a deployment without touching every launch line)
+    ragged = args.get("ragged",
+                      os.environ.get("DMLC_SERVE_RAGGED", "0"))
     engine = InferenceEngine(
         model, params,
-        postprocess="sigmoid" if p.task == "binary" else "none")
+        postprocess="sigmoid" if p.task == "binary" else "none",
+        ragged=str(ragged).lower() in ("1", "true", "yes", "on"))
     srv = PredictionServer(
         engine, host=args.get("host", "0.0.0.0"),
         port=int(args.get("port", "0")),
