@@ -219,6 +219,81 @@ def bench_ingest_cached() -> dict:
             "cache_hits_epoch2": hits}
 
 
+def bench_ingest_autotune() -> dict:
+    """Cold-start convergence of the closed-loop autotuner (ISSUE 7):
+    start from deliberately degraded defaults (parser threads 1,
+    prefetch 1), let the controller hill-climb one knob per epoch, and
+    report the steady-state rate it reaches plus how many epochs the
+    climb took.  Acceptance: steady state within 10% of the hand-tuned
+    reference measured in the same process (``ratio_vs_tuned >= 0.9``),
+    and convergence well inside the epoch budget."""
+    import bench
+    from dmlc_core_tpu.data import create_parser
+    from dmlc_core_tpu.pipeline import DeviceLoader, autotune
+    from dmlc_core_tpu.utils.metrics import metrics
+
+    path = "/tmp/bench_suite.libsvm"
+    _gen_libsvm(path)
+    size_mb = os.path.getsize(path) / MB
+    cores = bench.host_cores()
+    batch_rows = int(os.environ.get("DMLC_BENCH_ROWS", "16384"))
+    nnz_cap = int(os.environ.get("DMLC_BENCH_NNZ", str(512 * 1024)))
+    max_epochs = int(os.environ.get("DMLC_BENCH_AUTOTUNE_EPOCHS", "20"))
+
+    def epoch_rate(cfg: dict) -> float:
+        # same knob semantics as serve_ingest: parser_threads==1 keeps
+        # the single-thread streampack fast path
+        pt = int(cfg.get("parser_threads", 1))
+        nthreads, threaded = (1, False) if pt <= 1 else (pt, True)
+        loader = DeviceLoader(
+            create_parser(path, 0, 1, "libsvm", nthreads=nthreads,
+                          threaded=threaded),
+            batch_rows=batch_rows, nnz_cap=nnz_cap,
+            prefetch=int(cfg.get("prefetch", 2)))
+        t0 = time.perf_counter()
+        acc = None
+        for b in loader:
+            acc = bench.consume_batch(acc, b)
+        loader.close()
+        bench.prove_consumed(acc)
+        return size_mb / (time.perf_counter() - t0)
+
+    metrics.reset()
+    metrics.gauge("slo.active_breaches").set(0)
+    # hand-tuned reference: the non-degraded baselines, best of 2
+    tuned_cfg = {k.name: k.value
+                 for k in autotune.ingest_knob_space(cores=cores)}
+    tuned_rate = max(epoch_rate(tuned_cfg), epoch_rate(tuned_cfg))
+    # cold start from the worst rung; direct construction (key=None) so
+    # the experiment never reads or writes the persisted winner file
+    tuner = autotune.Autotuner(
+        autotune.ingest_knob_space(cores=cores, degraded=True), key=None)
+    cold_rate = 0.0
+    epochs = 0
+    for epochs in range(1, max_epochs + 1):
+        cfg = tuner.begin_epoch()
+        rate = epoch_rate(cfg)
+        if epochs == 1:
+            cold_rate = rate
+        tuner.end_epoch(rate)
+        if tuner.converged:
+            break
+    steady = epoch_rate(tuner.config())
+    # steady_state_mb_s repeats the headline under a name the regression
+    # gate classifies higher-better (check_regression's token list)
+    return {"metric": "ingest_autotune", "value": round(steady, 1),
+            "unit": "MB/s",
+            "steady_state_mb_s": round(steady, 1),
+            "epochs_to_converge": epochs,
+            "converged": bool(tuner.converged),
+            "cold_start_mbps": round(cold_rate, 1),
+            "tuned_ref_mbps": round(tuned_rate, 1),
+            "ratio_vs_tuned": round(steady / tuned_rate, 3),
+            "best_knobs": tuner.best_config(),
+            "mutations": int(metrics.counter("autotune.mutations").value),
+            "accepted": int(metrics.counter("autotune.accepted").value)}
+
+
 def bench_ingest_ragged() -> dict:
     """Ragged vs padded device batches at **equal batch budget**
     (ISSUE 6): the same file, the same (batch_rows, nnz_cap), once
@@ -1358,6 +1433,7 @@ def bench_sp_mesh8() -> dict:
 ALL = {
     "libsvm": (bench_libsvm, "libsvm_ingest_to_device"),
     "ingest_cached": (bench_ingest_cached, "ingest_cached"),
+    "ingest_autotune": (bench_ingest_autotune, "ingest_autotune"),
     "ingest_ragged": (bench_ingest_ragged, "ingest_ragged"),
     "fm_train": (bench_fm_train, "fm_train_stream"),
     "deepfm_train": (bench_deepfm_train, "deepfm_train_stream"),
@@ -1395,8 +1471,10 @@ CPU_MESH = {"allreduce_mesh8", "sp_mesh8"}
 #  (cached ≥ 2× uncached, pack ≤ 5% of cached wall) are host-path
 #  properties — measuring them through the tunnel would mix link latency
 #  into a disk/pack comparison.
+#  ingest_autotune is CPU-pinned for the same reason: the convergence
+#  experiment compares host parse/pack rates against themselves.
 HOST_ONLY = {"stream", "csv", "recordio", "cache", "higgs", "ingest_cached",
-             "ingest_ragged"}
+             "ingest_ragged", "ingest_autotune"}
 # superseded in the default order (ingest_scale measures workers_2 too);
 # still runnable by explicit name
 DEFAULT_SKIP = {"remote_ingest"}

@@ -47,9 +47,12 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 # floor, every point above it is padding tax — lower is better.  The
 # ragged scenario families (ingest_ragged, *_ragged serving scenarios)
 # need no extra tokens: their qps/latency/rows keys classify as usual.
+#  epochs_to_converge (ISSUE 7 autotuner cold start): each epoch spent
+#  searching is an epoch served on a worse config — fewer is better.
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
-                 "errors", "misses", "padding_ratio", "truncated")
+                 "errors", "misses", "padding_ratio", "truncated",
+                 "epochs_to_converge")
 
 
 def _direction(key: str) -> Optional[str]:

@@ -150,13 +150,14 @@ def _default_nthreads() -> int:
     measured 2-3x parse overlap on throttled-but-multicore hosts; on a
     genuinely serial machine the extra OpenMP threads just timeslice at
     negligible cost."""
+    from ..utils.parameter import env_int
     for var in ("DMLC_NUM_THREADS", "OMP_NUM_THREADS"):
-        env = os.environ.get(var)
-        if env:
-            try:
-                return max(1, int(env))
-            except ValueError:
-                pass
+        # lenient parse: a typo'd pin logs ONE warning and falls through
+        # to the next source instead of raising in whatever worker thread
+        # first builds a parse kernel
+        n = env_int(var, 0, minimum=1) if os.environ.get(var) else 0
+        if n:
+            return n
     try:
         n = len(os.sched_getaffinity(0))
     except (AttributeError, OSError):

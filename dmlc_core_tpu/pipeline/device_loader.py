@@ -43,6 +43,7 @@ import numpy as np
 from ..data.parser import ParserBase
 from ..telemetry import trace as teltrace
 from ..utils import ThreadedIter, check
+from . import fingerprint as fingerprint_mod
 from . import page_cache
 from .packing import (PackStats, batch_slices, pack_flat, pack_ragged,
                       pack_rowmajor, ragged_slices)
@@ -441,6 +442,12 @@ class DeviceLoader:
                    the hot path; epochs ≥2 mmap the pages and skip
                    chunk→parse→pack entirely.  Stale/truncated caches are
                    detected by fingerprint and rebuilt silently.
+    cache_queue_pages / cache_readahead:
+                   page-cache writer queue depth and ``MADV_WILLNEED``
+                   window, in pages.  0 / None (default) defer to the
+                   ``DMLC_PAGE_CACHE_QUEUE`` / ``DMLC_PAGE_CACHE_READAHEAD``
+                   env knobs; explicit values are how the autotuner
+                   (:mod:`.autotune`) applies these knobs per epoch.
     """
 
     def __init__(self, source, batch_rows: int, nnz_cap: int,
@@ -450,7 +457,8 @@ class DeviceLoader:
                  id_mod: int = 0, put_threads="auto",
                  wire_compact="auto", fields: bool = False,
                  emit: str = "device", cache="auto",
-                 ragged: bool = False):
+                 ragged: bool = False, cache_queue_pages: int = 0,
+                 cache_readahead: Optional[int] = None):
         check(layout in ("flat", "rowmajor"), f"bad layout {layout!r}")
         check(emit in ("device", "host"), f"bad emit {emit!r}")
         if ragged:
@@ -480,6 +488,10 @@ class DeviceLoader:
         self.stats = PackStats()
         self.emit = emit
         self._cache_path = self._resolve_cache(cache)
+        # page-cache knobs: 0/None defer to the (leniently parsed) env
+        # defaults; explicit values are the autotuner's application path
+        self._cache_queue_pages = max(0, int(cache_queue_pages))
+        self._cache_readahead = cache_readahead
         self._cache_writer: Optional[page_cache.PageCacheWriter] = None
         self._cache_reader: Optional[page_cache.PageCacheReader] = None
         put_threads = max(1, int(put_threads))
@@ -557,68 +569,41 @@ class DeviceLoader:
         return str(cache)
 
     def _src_attr(self, name: str, default=None):
-        """An attribute off the source, looking through one wrapper layer
-        (ThreadedParser.base) — where create_parser hangs format knobs."""
-        v = getattr(self.source, name, None)
-        if v is None:
-            v = getattr(getattr(self.source, "base", None), name, None)
-        return default if v is None else v
+        return fingerprint_mod.source_attr(self.source, name, default)
 
     def _cache_split(self):
         """The file-backed InputSplit under the source, or None (page
         caching needs stat-able source identity)."""
-        obj = self.source
-        for _ in range(8):
-            if hasattr(obj, "files"):
-                return obj
-            nxt = getattr(obj, "base", None)
-            if nxt is None:
-                nxt = getattr(obj, "source", None)
-            if nxt is None or nxt is obj:
-                return None
-            obj = nxt
-        return None
+        return fingerprint_mod.find_file_split(self.source)
 
     def _cache_fingerprint(self) -> Optional[dict]:
         """Source identity (file list + sizes + mtimes) plus the full pack
-        config.  Recomputed at every epoch start, so a touched source
-        file, a repartition (``reset_partition``), or any config change
-        shifts the fingerprint and forces a silent rebuild."""
-        import os
+        config, via the shared :mod:`.fingerprint` builder (also the basis
+        of the autotuner's tuning key — one builder, so cache invalidation
+        and tuning keys can never drift apart).  Recomputed at every epoch
+        start, so a touched source file, a repartition
+        (``reset_partition``), or any config change shifts the fingerprint
+        and forces a silent rebuild."""
         split = self._cache_split()
         if split is None:
             return None
-        files = []
-        for fi in getattr(split, "files", []):
-            try:
-                mtime = os.stat(fi.path).st_mtime_ns
-            except OSError:
-                mtime = None
-            files.append([fi.path, int(fi.size), mtime])
-        if not files:
-            return None
         pack_path = ("streampack" if self._use_streampack() else
                      "native" if self._use_native_pack() else "python")
-        return {
-            "page_format": page_cache.FORMAT_VERSION,
-            "files": files,
-            "part": [int(getattr(split, "part_index", 0)),
-                     int(getattr(split, "num_parts", 1))],
-            "batch_rows": int(self.batch_rows),
-            "nnz_cap": int(self.nnz_cap),
-            "layout": self.layout,
-            "id_mod": int(self.id_mod),
-            "wire_compact": self.wire_compact,
-            "drop_remainder": bool(self.drop_remainder),
-            # new pack-config field (ISSUE 6): shifts every pre-ragged
-            # fingerprint once, so pages written before this field existed
-            # rebuild instead of silently serving a ragged-incompatible pack
-            "ragged": bool(self.ragged),
-            "pack_path": pack_path,
-            "text_format": self._src_attr("text_format"),
-            "csv": [self._src_attr("csv_label_col", -1),
-                    self._src_attr("csv_delim", ",")],
-        }
+        return fingerprint_mod.pack_fingerprint(
+            split,
+            page_format=page_cache.FORMAT_VERSION,
+            batch_rows=self.batch_rows, nnz_cap=self.nnz_cap,
+            layout=self.layout, id_mod=self.id_mod,
+            wire_compact=self.wire_compact,
+            drop_remainder=self.drop_remainder,
+            # the ragged field (ISSUE 6) shifts every pre-ragged
+            # fingerprint once, so pages written before it existed rebuild
+            # instead of silently serving a ragged-incompatible pack
+            ragged=self.ragged,
+            pack_path=pack_path,
+            text_format=self._src_attr("text_format"),
+            csv=[self._src_attr("csv_label_col", -1),
+                 self._src_attr("csv_delim", ",")])
 
     def _serve_cached(self, reader: page_cache.PageCacheReader) -> Iterator:
         """Epoch from the page file: mmap'd read-only fused views go
@@ -648,7 +633,9 @@ class DeviceLoader:
         background page writer.  Backpressure or a write error drops the
         build (the epoch is served regardless); a clean end of epoch
         finalizes the page file atomically."""
-        writer = page_cache.PageCacheWriter(self._cache_path, fingerprint)
+        writer = page_cache.PageCacheWriter(
+            self._cache_path, fingerprint,
+            queue_pages=self._cache_queue_pages)
         self._cache_writer = writer
         ok = False
         try:
@@ -683,7 +670,8 @@ class DeviceLoader:
             reader = page_cache.open_reader(
                 self._cache_path, fingerprint,
                 expected_words=lambda meta: _fused_words_meta(
-                    self.batch_rows, int(meta)))
+                    self.batch_rows, int(meta)),
+                readahead=self._cache_readahead)
         if reader is not None:
             self._m_cache_hits.add(1)
             yield from self._serve_cached(reader)

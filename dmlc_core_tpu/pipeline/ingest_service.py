@@ -74,7 +74,7 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                  batch_rows: int, nnz_cap: int, port: int,
                  host: str = "0.0.0.0", id_mod: int = 0,
                  wire_compact="auto", max_epochs: int = 0,
-                 cache="auto",
+                 cache="auto", autotune="auto",
                  ready_event: Optional[threading.Event] = None) -> None:
     """Serve fused ingest frames for one partition; blocks forever (or for
     ``max_epochs`` connections when > 0 — tests use this to terminate).
@@ -83,8 +83,20 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
     URI fragment (or an explicit path) the worker's packed-page cache
     (:mod:`.page_cache`) makes every served epoch after the first an mmap
     replay — the worker's parse/pack cost is paid once per source, not
-    once per training epoch."""
+    once per training epoch.
+
+    ``autotune``: "auto" (default) engages the closed-loop knob search
+    (:mod:`.autotune`) only when ``DMLC_AUTOTUNE`` opts in; True forces
+    it (the ``DMLC_AUTOTUNE=0`` kill switch still wins); False is always
+    off.  With no tuner this function is byte-identical to the
+    pre-autotune behavior.  One served connection = one evaluation
+    epoch: the tuner picks parser threads / prefetch / page-cache knobs
+    for the connection's loader and judges the measured send throughput
+    afterwards, warm-starting from the config persisted for this
+    (source, host shape) when one exists."""
     from ..data import create_parser
+    from . import autotune as autotune_mod
+    from . import fingerprint as fingerprint_mod
 
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -95,6 +107,19 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
     log_info("ingest worker: part %d/%d of %s on :%d", part, nparts, uri,
              srv.getsockname()[1])
     served = 0
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    # page-cache knobs join the search only when a cache can exist here
+    cache_on = bool(cache) and (cache != "auto" or "#" in uri)
+    tuner = autotune_mod.maybe_autotuner(
+        lambda: autotune_mod.ingest_knob_space(cores=cores, cache=cache_on),
+        key=fingerprint_mod.autotune_key(
+            {"uri": uri, "part": [part, nparts], "fmt": fmt,
+             "batch_rows": int(batch_rows), "nnz_cap": int(nnz_cap),
+             "id_mod": int(id_mod)}, platform="host"),
+        gate=autotune)
     # per-frame stall detection: a frame covers produce (parse+pack or
     # cache read) + send, so a wedged source, a stalled disk, or a
     # blocked peer all surface as anomaly.stall_z.ingest.frame
@@ -104,6 +129,10 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
         while not max_epochs or served < max_epochs:
             conn, addr = srv.accept()
             loader = None
+            epoch_ok = False
+            cfg = tuner.begin_epoch() if tuner is not None else {}
+            sent_bytes = 0
+            t_epoch = time.monotonic()
             try:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 from .device_loader import DeviceLoader
@@ -113,16 +142,19 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                 # An explicit DMLC_NUM_THREADS/OMP_NUM_THREADS pin beats
                 # the heuristic (the throttled-but-multicore case
                 # _default_nthreads exists for) — defer to the defaults
-                # then, which consult those env vars.
-                try:
-                    cores = len(os.sched_getaffinity(0))
-                except (AttributeError, OSError):
-                    cores = os.cpu_count() or 1
-                pinned = (os.environ.get("DMLC_NUM_THREADS")
-                          or os.environ.get("OMP_NUM_THREADS"))
-                nthreads, threaded = ((1, False)
-                                      if cores == 1 and not pinned
-                                      else (0, True))
+                # then, which consult those env vars.  An active tuner
+                # replaces the heuristic wholesale: its parser_threads
+                # value IS the config under evaluation.
+                if tuner is not None:
+                    pt = int(cfg.get("parser_threads", 1))
+                    nthreads, threaded = (1, False) if pt == 1 \
+                        else (pt, True)
+                else:
+                    pinned = (os.environ.get("DMLC_NUM_THREADS")
+                              or os.environ.get("OMP_NUM_THREADS"))
+                    nthreads, threaded = ((1, False)
+                                          if cores == 1 and not pinned
+                                          else (0, True))
                 # one span per served epoch: stage attribution for the
                 # whole partition stream (frame-level work is too hot —
                 # the pack/h2d spans inside DeviceLoader cover it)
@@ -133,7 +165,10 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                                       nthreads=nthreads, threaded=threaded),
                         batch_rows=batch_rows, nnz_cap=nnz_cap,
                         id_mod=id_mod, wire_compact=wire_compact,
-                        emit="host", cache=cache)
+                        emit="host", cache=cache,
+                        prefetch=int(cfg.get("prefetch", 2)),
+                        cache_queue_pages=int(cfg.get("cache_queue", 0)),
+                        cache_readahead=cfg.get("cache_readahead"))
                     frames = 0
                     t_frame = time.monotonic()
                     for item in loader:
@@ -154,12 +189,14 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                             _NO_ROWS if rows is None else int(rows)))
                         _send_all(conn, memoryview(buf[:words]).cast("B"))
                         loader.recycle(buf)
+                        sent_bytes += words * 4
                         frames += 1
                         now = time.monotonic()
                         stall.observe(now - t_frame)
                         t_frame = now
                     _send_all(conn, _FRAME.pack(0, 0, 0))  # end of stream
                     sp.attrs["frames"] = frames
+                    epoch_ok = frames > 0
             except Exception as e:  # noqa: BLE001 — a server: one bad
                 # connection (trainer vanished, parse/IO error — including
                 # while CONSTRUCTING the loader) must never take down the
@@ -169,6 +206,14 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                 if loader is not None:
                     loader.close()
                 conn.close()
+                if tuner is not None:
+                    if epoch_ok:
+                        elapsed = max(1e-9, time.monotonic() - t_epoch)
+                        tuner.end_epoch(sent_bytes / 1e6 / elapsed)
+                    else:
+                        # a dead peer or empty stream measures nothing:
+                        # the pending mutation reverts un-judged
+                        tuner.abort_epoch()
             served += 1
     finally:
         srv.close()

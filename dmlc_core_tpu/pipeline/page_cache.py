@@ -61,6 +61,7 @@ import numpy as np
 
 from ..utils.faults import fault_point
 from ..utils.logging import log_info, log_warning
+from ..utils.parameter import env_int
 
 __all__ = ["FORMAT_VERSION", "PageCacheError", "PageCacheWriter",
            "PageCacheReader", "open_reader", "page_path"]
@@ -108,8 +109,10 @@ class PageCacheWriter:
         self.path = path
         self._tmp = f"{path}.tmp.{os.getpid()}"
         self._header = _fingerprint_bytes(fingerprint)
-        cap = int(queue_pages) or int(
-            os.environ.get("DMLC_PAGE_CACHE_QUEUE", "8"))
+        # lenient env parse: a malformed DMLC_PAGE_CACHE_QUEUE logs one
+        # WARNING and keeps the default — it must not raise inside the
+        # first epoch's write-through
+        cap = int(queue_pages) or env_int("DMLC_PAGE_CACHE_QUEUE", 8)
         self._q: queue.Queue = queue.Queue(max(2, cap))
         self._dead = threading.Event()
         self._finalized = False
@@ -232,7 +235,8 @@ class PageCacheReader:
     rejected up front — never discovered mid-epoch."""
 
     def __init__(self, path: str,
-                 expected_words: Optional[Callable[[int], int]] = None):
+                 expected_words: Optional[Callable[[int], int]] = None,
+                 readahead: Optional[int] = None):
         self.path = path
         with open(path, "rb") as f:
             size = os.fstat(f.fileno()).st_size
@@ -251,8 +255,10 @@ class PageCacheReader:
             self._mm.madvise(mmap.MADV_SEQUENTIAL)
         except (AttributeError, OSError, ValueError):
             pass
-        self._ra = max(0, int(
-            os.environ.get("DMLC_PAGE_CACHE_READAHEAD", "2")))
+        # explicit knob wins (autotuner plumbing); env fallback is
+        # lenient — malformed values warn once and keep the default
+        self._ra = (max(0, int(readahead)) if readahead is not None
+                    else env_int("DMLC_PAGE_CACHE_READAHEAD", 2, minimum=0))
 
     def _validate(self, size: int, expected_words) -> None:
         mm = self._mm
@@ -323,13 +329,15 @@ class PageCacheReader:
 
 
 def open_reader(path: str, fingerprint: dict,
-                expected_words: Optional[Callable[[int], int]] = None
+                expected_words: Optional[Callable[[int], int]] = None,
+                readahead: Optional[int] = None
                 ) -> Optional[PageCacheReader]:
     """A validated reader for ``path`` iff it exists, frames correctly AND
     matches ``fingerprint`` exactly; None means rebuild (absent, stale,
     truncated, version-skewed — all the same answer, never an error)."""
     try:
-        reader = PageCacheReader(path, expected_words=expected_words)
+        reader = PageCacheReader(path, expected_words=expected_words,
+                                 readahead=readahead)
     except OSError:
         return None
     except PageCacheError as e:

@@ -285,6 +285,32 @@ class MicroBatcher:
                 self._m_latency.observe(done_t - p.t_enq)
                 self._m_reqs.add(1)
 
+    # -- knob surface (autotuner) ----------------------------------------
+    def apply_knobs(self, *, max_delay_s: Optional[float] = None,
+                    max_batch_rows: Optional[int] = None,
+                    max_batch_nnz: Optional[int] = None) -> None:
+        """Mutate the cut triggers live, under the queue lock.
+
+        The safe mutation surface for the closed-loop autotuner
+        (:mod:`dmlc_core_tpu.pipeline.autotune`): values are bounded the
+        same way the constructor bounds them (a batch budget can never
+        exceed the engine's largest bucket — a mutation that compiled a
+        new shape would defeat the no-retrace ladder), and the worker
+        picks the new triggers up on its next cut."""
+        with self._cv:
+            if max_delay_s is not None:
+                check(max_delay_s >= 0, "max_delay_s must be >= 0")
+                self.max_delay_s = float(max_delay_s)
+            if max_batch_rows is not None:
+                check(1 <= max_batch_rows <= self.engine.ladder.max_rows,
+                      "max_batch_rows outside [1, ladder max]")
+                self.max_batch_rows = int(max_batch_rows)
+            if max_batch_nnz is not None:
+                check(1 <= max_batch_nnz <= self.engine.ladder.max_nnz,
+                      "max_batch_nnz outside [1, ladder max]")
+                self.max_batch_nnz = int(max_batch_nnz)
+            self._cv.notify_all()
+
     # -- lifecycle -------------------------------------------------------
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop admissions; ``drain=True`` serves everything already

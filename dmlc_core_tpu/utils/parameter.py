@@ -35,7 +35,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Type, U
 
 from .logging import ParamError
 
-__all__ = ["Parameter", "field", "FieldEntry", "get_env"]
+__all__ = ["Parameter", "field", "FieldEntry", "get_env", "env_int",
+           "parse_lenient_bool"]
 
 _NOTHING = object()
 
@@ -293,6 +294,53 @@ class Parameter(metaclass=_ParamMeta):
 
     def __eq__(self, other: Any) -> bool:
         return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+# env keys already warned about, so a malformed value logs ONE warning
+# per process instead of one per worker-thread read
+_env_warned: set = set()
+
+
+def env_int(key: str, default: int, *, minimum: Optional[int] = None) -> int:
+    """Lenient integer env read for knobs parsed on worker hot paths.
+
+    Unlike :func:`get_env`, a malformed value never raises: it logs one
+    WARNING (per key, per process) and falls back to ``default`` — a
+    typo'd ``DMLC_PAGE_CACHE_QUEUE=8x`` must degrade the knob, not kill
+    the first loader thread that reads it.  ``minimum`` clamps the
+    parsed value (the clamp is silent: a deliberate 0 meaning "off"
+    should use ``minimum=None``)."""
+    raw = os.environ.get(key)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        if key not in _env_warned:
+            _env_warned.add(key)
+            from .logging import log_warning
+            log_warning("ignoring malformed %s=%r (want an integer); "
+                        "using default %r", key, raw, default)
+        return default
+    return v if minimum is None else max(minimum, v)
+
+
+def parse_lenient_bool(key: str) -> Optional[bool]:
+    """Lenient boolean env read: None when unset, the parsed value when
+    well-formed, None + one WARNING when malformed (same contract as
+    :func:`env_int` — never raise from a knob read)."""
+    raw = os.environ.get(key)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        return _parse_bool(raw)
+    except Exception:
+        if key not in _env_warned:
+            _env_warned.add(key)
+            from .logging import log_warning
+            log_warning("ignoring malformed %s=%r (want true/false/1/0)",
+                        key, raw)
+        return None
 
 
 def get_env(key: str, default: Any) -> Any:

@@ -1,0 +1,464 @@
+"""Closed-loop autotuner (ISSUE 7): hill-climb convergence + persistence,
+freeze-on-anomaly with rollback, tuned.resolve precedence, concurrent
+tuned-file writers, and lenient env-knob parsing."""
+
+import json
+import os
+import threading
+import time
+import types
+
+import pytest
+
+from dmlc_core_tpu.pipeline import autotune as at
+from dmlc_core_tpu.pipeline import fingerprint as fp
+from dmlc_core_tpu.pipeline import tuned
+from dmlc_core_tpu.utils.metrics import metrics
+
+
+@pytest.fixture()
+def tuned_file(tmp_path, monkeypatch):
+    path = tmp_path / "tuned.json"
+    monkeypatch.setenv("DMLC_TUNED_CONFIG", str(path))
+    return path
+
+
+def _counter(name):
+    return metrics.counter(name).value
+
+
+def _run_to_convergence(tuner, objective, max_epochs=60):
+    for _ in range(max_epochs):
+        cfg = tuner.begin_epoch()
+        tuner.end_epoch(objective(cfg))
+        if tuner.converged:
+            return
+    raise AssertionError("did not converge")
+
+
+# -- controller core ---------------------------------------------------
+
+
+def test_hill_climb_finds_optimum_and_persists(tuned_file):
+    metrics.gauge("slo.active_breaches").set(0)
+    knobs = [at.Knob("threads", (1, 2, 4, 8), baseline=1),
+             at.Knob("prefetch", (1, 2, 4), baseline=1)]
+    t = at.Autotuner(knobs, key="deadbeef|c1|host", min_gain=0.01)
+    e0 = _counter("autotune.epochs")
+
+    def objective(cfg):      # unimodal peak at threads=4, prefetch=4
+        return 100.0 - 10 * abs(cfg["threads"] - 4) \
+                     - 10 * abs(cfg["prefetch"] - 4)
+
+    _run_to_convergence(t, objective)
+    assert t.best_config() == {"threads": 4, "prefetch": 4}
+    assert metrics.gauge("autotune.converged").value == 1.0
+    assert _counter("autotune.epochs") - e0 == t.epoch
+    assert metrics.gauge("autotune.knob.threads").value == 4.0
+    # converged winner persisted under the reserved autotune section
+    doc = json.loads(tuned_file.read_text())
+    saved = doc["autotune"]["deadbeef|c1|host"]
+    assert saved["knobs"] == {"threads": 4, "prefetch": 4}
+    assert saved["objective"] == pytest.approx(100.0)
+    # steady state after convergence: no further mutations proposed
+    m0 = _counter("autotune.mutations")
+    cfg = t.begin_epoch()
+    assert cfg == {"threads": 4, "prefetch": 4}
+    assert t.end_epoch(objective(cfg))["action"] == "steady"
+    assert _counter("autotune.mutations") == m0
+
+
+def test_warm_start_skips_search(tuned_file):
+    metrics.gauge("slo.active_breaches").set(0)
+    tuned.save_autotuned("k|c1|host", {"knobs": {"threads": 8},
+                                       "objective": 50.0})
+    t = at.Autotuner([at.Knob("threads", (1, 2, 4, 8), baseline=1)],
+                     key="k|c1|host")
+    assert t.converged and t.config() == {"threads": 8}
+    m0 = _counter("autotune.mutations")
+    t.begin_epoch()
+    assert t.end_epoch(49.0)["action"] == "steady"
+    assert _counter("autotune.mutations") == m0
+
+
+def test_rejected_mutation_rolls_back(tuned_file):
+    metrics.gauge("slo.active_breaches").set(0)
+    t = at.Autotuner([at.Knob("k", (1, 2, 4), baseline=2)], key=None)
+    t.begin_epoch()
+    out = t.end_epoch(10.0)                      # baseline; mutation staged
+    assert out["action"] == "baseline" and "next_knob" in out
+    mutated = t.config()["k"]
+    assert mutated != 2
+    t.begin_epoch()
+    out = t.end_epoch(5.0)                       # worse: revert
+    assert out["action"] == "reject"
+    assert t.config()["k"] != mutated or t.config()["k"] == 2
+    assert t.best_config() == {"k": 2}
+
+
+def test_abort_epoch_reverts_unjudged(tuned_file):
+    metrics.gauge("slo.active_breaches").set(0)
+    t = at.Autotuner([at.Knob("k", (1, 2, 4), baseline=1)], key=None)
+    t.begin_epoch()
+    t.end_epoch(10.0)                            # stages first mutation
+    assert t.config() != t.best_config()
+    t.begin_epoch()
+    t.abort_epoch()                              # peer died mid-epoch
+    assert t.config() == t.best_config()         # mutation reverted
+    assert t.best_config() == {"k": 1}           # ...and never judged
+    # the controller keeps going afterwards
+    t.begin_epoch()
+    t.end_epoch(10.0)
+
+
+def test_freeze_on_injected_stall_halts_and_rolls_back(tuned_file,
+                                                       monkeypatch):
+    """Satellite 4: a DMLC_FAULT_SPEC-injected stall flagged by the real
+    StallDetector must halt mutations and roll back to last-good."""
+    from dmlc_core_tpu.telemetry.anomaly import StallDetector
+    from dmlc_core_tpu.utils.faults import clear_faults, fault_point
+
+    metrics.gauge("slo.active_breaches").set(0)
+    monkeypatch.delenv("DMLC_FAULT_SPEC", raising=False)
+    clear_faults()
+    det = StallDetector("autotune_test", z_threshold=8.0, min_samples=4)
+
+    def tick():
+        t0 = time.perf_counter()
+        fault_point("autotune.test.stage")
+        det.observe(time.perf_counter() - t0)
+
+    t = at.Autotuner([at.Knob("k", (1, 2, 4), baseline=1)], key=None,
+                     backoff_epochs=2)
+    t.begin_epoch()
+    for _ in range(10):
+        tick()                                   # clean warmup epoch
+    t.end_epoch(10.0)                            # baseline; mutation staged
+    assert t.config() == {"k": 2}
+    stalls0 = _counter("anomaly.stalls.autotune_test")
+    t.begin_epoch()
+    monkeypatch.setenv("DMLC_FAULT_SPEC",
+                       "autotune.test.stage:latency=150ms")
+    tick()                                       # injected stall fires
+    monkeypatch.delenv("DMLC_FAULT_SPEC")
+    clear_faults()
+    assert _counter("anomaly.stalls.autotune_test") > stalls0
+    out = t.end_epoch(99.0)                      # great number, but flagged
+    assert out["action"] == "freeze"
+    # rolled back to last-good, the 99.0 was never believed
+    assert t.config() == t.best_config() == {"k": 1}
+    # frozen: the next epochs back off with no new mutation
+    m0 = _counter("autotune.mutations")
+    t.begin_epoch()
+    assert t.end_epoch(10.0)["action"] == "backoff"
+    t.begin_epoch()
+    assert t.end_epoch(10.0)["action"] == "backoff"
+    assert _counter("autotune.mutations") == m0
+    # pressure gone: the search resumes
+    t.begin_epoch()
+    assert t.end_epoch(10.0)["action"] == "resume"
+    assert _counter("autotune.mutations") == m0 + 1
+
+
+def test_freeze_on_active_slo_breach(tuned_file):
+    t = at.Autotuner([at.Knob("k", (1, 2), baseline=1)], key=None)
+    metrics.gauge("slo.active_breaches").set(1)
+    try:
+        t.begin_epoch()
+        assert t.end_epoch(10.0)["action"] == "freeze"
+    finally:
+        metrics.gauge("slo.active_breaches").set(0)
+
+
+# -- ambient gating (DMLC_AUTOTUNE) ------------------------------------
+
+
+def test_maybe_autotuner_gating(tuned_file, monkeypatch):
+    factory = lambda: [at.Knob("k", (1, 2))]      # noqa: E731
+    monkeypatch.delenv("DMLC_AUTOTUNE", raising=False)
+    assert at.maybe_autotuner(factory) is None            # opt-in only
+    assert at.maybe_autotuner(factory, gate=False) is None
+    assert at.maybe_autotuner(factory, gate=True) is not None
+    monkeypatch.setenv("DMLC_AUTOTUNE", "0")
+    assert at.maybe_autotuner(factory) is None            # kill switch
+    assert at.maybe_autotuner(factory, gate=True) is None  # ...beats force
+    monkeypatch.setenv("DMLC_AUTOTUNE", "1")
+    assert at.maybe_autotuner(factory) is not None
+    assert not at.enabled() if os.environ.get("DMLC_AUTOTUNE") == "0" \
+        else at.enabled()
+
+
+# -- tuned.py: precedence + concurrency --------------------------------
+
+
+def test_resolve_precedence(tuned_file, monkeypatch):
+    """explicit ctor value > env > persisted file > built-in default."""
+    monkeypatch.delenv("DMLC_PUT_THREADS", raising=False)
+    monkeypatch.delenv("DMLC_WIRE_COMPACT", raising=False)
+    # built-in defaults (no env, no file)
+    assert tuned.resolve("tpu", "auto", "auto") == (1, True)
+    assert tuned.resolve("cpu", "auto", "auto") == (1, False)
+    # persisted file replaces built-ins
+    tuned.save_tuned({"platform": "tpu", "put_threads": 4,
+                      "wire_compact": False})
+    assert tuned.resolve("tpu", "auto", "auto") == (4, False)
+    # env beats the file
+    monkeypatch.setenv("DMLC_PUT_THREADS", "2")
+    monkeypatch.setenv("DMLC_WIRE_COMPACT", "1")
+    assert tuned.resolve("tpu", "auto", "auto") == (2, True)
+    # explicit ctor values beat everything
+    assert tuned.resolve("tpu", 8, False) == (8, False)
+    # malformed env falls through to the file tier (lenient, no raise)
+    monkeypatch.setenv("DMLC_PUT_THREADS", "banana")
+    monkeypatch.setenv("DMLC_WIRE_COMPACT", "definitely")
+    assert tuned.resolve("tpu", "auto", "auto") == (4, False)
+
+
+def test_save_tuned_concurrent_writers(tuned_file):
+    """Satellite 1: N concurrent writers (platform entries AND autotune
+    entries) must all land — the read-modify-write is lock-serialized."""
+    n = 12
+    errors = []
+    barrier = threading.Barrier(n)
+
+    def write(i):
+        try:
+            barrier.wait(timeout=30)
+            if i % 2:
+                tuned.save_tuned({"platform": f"plat{i}", "value": i})
+            else:
+                tuned.save_autotuned(f"key{i}", {"knobs": {"k": i}})
+        except Exception as e:                       # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=write, args=(i,)) for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not errors
+    doc = json.loads(tuned_file.read_text())
+    for i in range(n):
+        if i % 2:
+            assert doc[f"plat{i}"]["value"] == i
+        else:
+            assert doc["autotune"][f"key{i}"]["knobs"]["k"] == i
+    # and the readers see what the writers wrote
+    assert tuned.load_tuned("plat1") == {"platform": "plat1", "value": 1}
+    assert tuned.load_autotuned("key0") == {"knobs": {"k": 0}}
+
+
+# -- env-knob hardening (satellite 3) ----------------------------------
+
+
+def test_env_int_lenient_with_one_warning(monkeypatch, caplog):
+    from dmlc_core_tpu.utils import parameter as pm
+
+    monkeypatch.setattr(pm, "_env_warned", set())
+    monkeypatch.setenv("DMLC_TEST_KNOB", "8x")
+    with caplog.at_level("WARNING"):
+        assert pm.env_int("DMLC_TEST_KNOB", 7) == 7
+        assert pm.env_int("DMLC_TEST_KNOB", 7) == 7
+    warned = [r for r in caplog.records if "DMLC_TEST_KNOB" in r.message]
+    assert len(warned) == 1                    # one WARNING, not one per use
+    monkeypatch.setenv("DMLC_TEST_KNOB", "3")
+    assert pm.env_int("DMLC_TEST_KNOB", 7, minimum=1) == 3
+    monkeypatch.setenv("DMLC_TEST_KNOB", "0")
+    assert pm.env_int("DMLC_TEST_KNOB", 7, minimum=1) == 1   # clamped
+    monkeypatch.delenv("DMLC_TEST_KNOB")
+    assert pm.env_int("DMLC_TEST_KNOB", 7) == 7
+
+
+def test_malformed_page_cache_queue_does_not_raise(tmp_path, monkeypatch):
+    from dmlc_core_tpu.pipeline.page_cache import PageCacheWriter
+    from dmlc_core_tpu.utils import parameter as pm
+
+    monkeypatch.setattr(pm, "_env_warned", set())
+    monkeypatch.setenv("DMLC_PAGE_CACHE_QUEUE", "not-a-number")
+    w = PageCacheWriter(str(tmp_path / "x.pages"), {"f": 1})
+    try:
+        assert w._q.maxsize == 8               # fell back to the default
+    finally:
+        w.abort()
+
+
+def test_malformed_num_threads_does_not_raise(monkeypatch):
+    from dmlc_core_tpu.data.parser import _default_nthreads
+    from dmlc_core_tpu.utils import parameter as pm
+
+    monkeypatch.setattr(pm, "_env_warned", set())
+    monkeypatch.setenv("DMLC_NUM_THREADS", "four")
+    monkeypatch.delenv("OMP_NUM_THREADS", raising=False)
+    assert _default_nthreads() >= 1            # heuristic fallback, no raise
+    monkeypatch.setenv("DMLC_NUM_THREADS", "3")
+    assert _default_nthreads() == 3
+
+
+# -- fingerprint / tuning keys -----------------------------------------
+
+
+def test_autotune_key_relaxed_projection():
+    base = {"page_format": 1,
+            "files": [["/d/a.svm", 100, 111], ["/d/b.svm", 200, 222]],
+            "batch_rows": 64, "nnz_cap": 1024}
+    touched = dict(base, files=[["/d/a.svm", 100, 999],
+                                ["/d/b.svm", 200, 222]])
+    resized = dict(base, files=[["/d/a.svm", 101, 111],
+                                ["/d/b.svm", 200, 222]])
+    format_bump = dict(base, page_format=2)
+    k = fp.autotune_key(base, "host", shape="c1")
+    assert fp.autotune_key(touched, "host", shape="c1") == k       # mtime
+    assert fp.autotune_key(format_bump, "host", shape="c1") == k   # version
+    assert fp.autotune_key(resized, "host", shape="c1") != k       # data
+    assert fp.autotune_key(base, "tpu", shape="c1") != k           # platform
+    assert fp.autotune_key(base, "host", shape="c8") != k          # host
+    assert k.endswith("|c1|host")
+    # un-stat-able sources still key per host+platform
+    assert fp.autotune_key(None, "host", shape="c1").endswith("|c1|host")
+
+
+def test_device_loader_fingerprint_uses_shared_builder(tmp_path):
+    """The page-cache fingerprint and the tuning key must come from one
+    builder — this pins the loader to fingerprint.pack_fingerprint."""
+    import numpy as np
+
+    from dmlc_core_tpu.data import create_parser
+    from dmlc_core_tpu.pipeline.device_loader import DeviceLoader
+
+    data = tmp_path / "t.libsvm"
+    rng = np.random.default_rng(0)
+    with open(data, "w") as f:
+        for r in range(50):
+            idx = np.sort(rng.choice(1000, size=5, replace=False))
+            f.write(f"{r % 2} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    loader = DeviceLoader(create_parser(str(data), 0, 1, "libsvm",
+                                        nthreads=1, threaded=False),
+                          batch_rows=16, nnz_cap=128, emit="host",
+                          cache=str(tmp_path / "t.pages"))
+    try:
+        got = loader._cache_fingerprint()
+        split = fp.find_file_split(loader.source)
+        assert split is not None
+        assert got["files"] == fp.split_files(split)
+        key = fp.autotune_key(got, "host")
+        assert key == fp.autotune_key(got, "host")     # deterministic
+    finally:
+        loader.close()
+
+
+# -- serving knob space -------------------------------------------------
+
+
+def test_serving_knob_space_applies_live():
+    applied = []
+    fake = types.SimpleNamespace(
+        engine=types.SimpleNamespace(
+            ladder=types.SimpleNamespace(max_rows=64, max_nnz=4096)),
+        max_delay_s=0.002, max_batch_rows=64, max_batch_nnz=4096,
+        apply_knobs=lambda **kw: applied.append(kw))
+    knobs = at.serving_knob_space(fake)
+    by = {k.name: k for k in knobs}
+    assert by["max_batch_rows"].values[-1] == 64       # bounded by ladder
+    assert by["max_batch_nnz"].values[-1] == 4096
+    assert by["max_delay_s"].value == pytest.approx(0.002)  # baseline kept
+    t = at.Autotuner(knobs, key=None)
+    t.begin_epoch()                                    # pushes live values
+    assert {"max_delay_s": 0.002} in applied
+    assert {"max_batch_rows": 64} in applied
+
+
+def test_micro_batcher_apply_knobs_bounds():
+    from dmlc_core_tpu.serving.batcher import MicroBatcher
+    from dmlc_core_tpu.utils.logging import DMLCError
+
+    engine = types.SimpleNamespace(
+        ladder=types.SimpleNamespace(max_rows=32, max_nnz=1024))
+    b = MicroBatcher(engine, max_queue=4)
+    try:
+        b.apply_knobs(max_delay_s=0.004, max_batch_rows=16,
+                      max_batch_nnz=512)
+        assert (b.max_delay_s, b.max_batch_rows, b.max_batch_nnz) \
+            == (0.004, 16, 512)
+        with pytest.raises(DMLCError):
+            b.apply_knobs(max_batch_rows=64)           # beyond the ladder
+        with pytest.raises(DMLCError):
+            b.apply_knobs(max_delay_s=-1.0)
+        assert b.max_batch_rows == 16                  # rejected, unchanged
+    finally:
+        b.close(drain=False)
+
+
+# -- end-to-end: serve_ingest wiring ------------------------------------
+
+
+def test_serve_ingest_autotunes_across_connections(tmp_path, monkeypatch):
+    """Three served connections = three evaluation epochs; the tuner must
+    count them and export knob gauges while frames flow unchanged."""
+    import numpy as np
+
+    from conftest import start_ingest_worker
+    from dmlc_core_tpu.pipeline import RemoteIngestLoader
+
+    monkeypatch.setenv("DMLC_TUNED_CONFIG", str(tmp_path / "tuned.json"))
+    monkeypatch.delenv("DMLC_AUTOTUNE", raising=False)
+    metrics.gauge("slo.active_breaches").set(0)
+    data = tmp_path / "w.libsvm"
+    rng = np.random.default_rng(1)
+    with open(data, "w") as f:
+        for r in range(300):
+            idx = np.sort(rng.choice(5000, size=8, replace=False))
+            f.write(f"{r % 2} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    e0 = _counter("autotune.epochs")
+    port = start_ingest_worker(str(data), 0, 1, max_epochs=3,
+                               autotune=True)
+    for _ in range(3):
+        rl = RemoteIngestLoader([("127.0.0.1", port)], batch_rows=64,
+                                emit="host")
+        frames = 0
+        for kind, buf, meta, rows in rl:
+            assert kind == "fused"
+            rl.recycle(buf)
+            frames += 1
+        rl.close()
+        assert frames > 0
+    deadline = time.monotonic() + 10
+    while (_counter("autotune.epochs") - e0 < 3
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert _counter("autotune.epochs") - e0 == 3
+    assert metrics.gauge("autotune.knob.parser_threads").value >= 1
+
+
+def test_serve_ingest_autotune_off_is_noop(tmp_path, monkeypatch):
+    """DMLC_AUTOTUNE unset (or =0): serve_ingest must not construct a
+    controller — no autotune.* activity at all."""
+    import numpy as np
+
+    from conftest import start_ingest_worker
+    from dmlc_core_tpu.pipeline import RemoteIngestLoader
+
+    monkeypatch.setenv("DMLC_AUTOTUNE", "0")
+    data = tmp_path / "n.libsvm"
+    rng = np.random.default_rng(2)
+    with open(data, "w") as f:
+        for r in range(100):
+            idx = np.sort(rng.choice(1000, size=5, replace=False))
+            f.write(f"{r % 2} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    e0 = _counter("autotune.epochs")
+    m0 = _counter("autotune.mutations")
+    port = start_ingest_worker(str(data), 0, 1, max_epochs=1,
+                               autotune=True)   # kill switch beats force
+    rl = RemoteIngestLoader([("127.0.0.1", port)], batch_rows=64,
+                            emit="host")
+    frames = 0
+    for kind, buf, meta, rows in rl:
+        rl.recycle(buf)
+        frames += 1
+    rl.close()
+    assert frames > 0
+    time.sleep(0.2)
+    assert _counter("autotune.epochs") == e0
+    assert _counter("autotune.mutations") == m0

@@ -293,8 +293,14 @@ def test_device_loader_ragged_fingerprint_field():
     dl = DeviceLoader(Src(), batch_rows=8, nnz_cap=64, ragged=True)
     try:
         import inspect
+
+        from dmlc_core_tpu.pipeline import fingerprint as fp
+
+        # the shared builder carries the flag...
+        assert '"ragged"' in inspect.getsource(fp.pack_fingerprint)
+        # ...and the loader threads its own setting into it
         src = inspect.getsource(type(dl)._cache_fingerprint)
-        assert '"ragged"' in src
+        assert "ragged=self.ragged" in src
         assert dl.ragged is True
     finally:
         dl.close()
