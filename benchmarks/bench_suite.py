@@ -1412,6 +1412,116 @@ def bench_sp_mesh8() -> dict:
             "note": "B1 H8 S2048 D64 causal attention, seq sharded 8-way"}
 
 
+_RESHARD_CHILD = r"""
+import sys, time
+import numpy as np
+from dmlc_core_tpu.parallel import RabitContext
+from dmlc_core_tpu.parallel.reshard import snapshot_tree, redistribute
+from dmlc_core_tpu.utils.checkpoint import CheckpointManager
+
+uri, port, jobid, tmp, mode = sys.argv[1:6]
+ctx = RabitContext(uri, int(port), jobid=jobid)
+mgr = CheckpointManager(tmp)
+world = ctx.world_size
+if mode == "reshard":
+    snap = None
+    if ctx.rank != world - 1:            # rank world-1 plays the reborn
+        _, state = mgr.restore(step=0)
+        snap = snapshot_tree(state)
+    ctx.allreduce(np.zeros(1))           # align: measure the protocol,
+    t0 = time.perf_counter()             # not rank start skew
+    restored, st = redistribute(ctx, snap, generation=0)
+    wall = time.perf_counter() - t0
+    assert restored
+    print("WALL %d %.6f %d %d %d" % (ctx.rank, wall, st.bytes_moved,
+                                     st.leaves_from_peers,
+                                     st.leaves_from_checkpoint), flush=True)
+else:                                    # the old path: full reload
+    ctx.allreduce(np.zeros(1))
+    t0 = time.perf_counter()
+    _, state = mgr.restore(step=0)
+    for a in state.values():
+        a[0, 0]                          # fault in, apples-to-apples
+    wall = time.perf_counter() - t0
+    print("WALL %d %.6f 0 0 0" % (ctx.rank, wall), flush=True)
+ctx.shutdown()
+"""
+
+
+def bench_elastic_reshard() -> dict:
+    """Checkpoint-free recovery cost (ISSUE 9): wall time for the elastic
+    resharder to hand a reborn rank the full state live from survivors,
+    against the old path — every rank of the restarted cohort reloading
+    the full checkpoint from disk (the restore stampede).  3 real worker
+    PROCESSES over the tracker + loopback sockets (threads would share
+    one GIL and throttle both sides of the transfer); state is replicated
+    (the elastic-averaging layout of examples/elastic_train.py), the
+    last rank plays the reborn non-holder.  Cost = the slowest rank's
+    wall, barrier-aligned inside each child."""
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    from dmlc_core_tpu.parallel import RabitTracker
+    from dmlc_core_tpu.utils.checkpoint import CheckpointManager
+
+    world = 3
+    # default 4x the suite's data target: recovery cost only matters once
+    # the state is big enough that a full-cohort reload visibly stalls
+    # training, and fixed protocol costs (tracker rounds, ownership
+    # broadcast, final allreduce) would dominate a tiny transfer
+    state_mb = int(os.environ.get("DMLC_BENCH_RESHARD_MB",
+                                  str(4 * TARGET_MB)))
+    nleaves, cols = 8, 256
+    rows = max(1, (state_mb * MB) // (4 * cols * nleaves))
+    rng = np.random.default_rng(7)
+    state = {f"layer{i}": rng.random((rows, cols), dtype=np.float32)
+             for i in range(nleaves)}
+    nbytes = sum(a.nbytes for a in state.values())
+
+    def cohort(tmp: str, mode: str):
+        tracker = RabitTracker(num_workers=world, host_ip="127.0.0.1")
+        tracker.start()
+        envd = tracker.worker_envs()
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _RESHARD_CHILD,
+             envd["DMLC_TRACKER_URI"], str(envd["DMLC_TRACKER_PORT"]),
+             f"b{i}", tmp, mode],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for i in range(world)]
+        walls, reborn = {}, (0, 0, 0)
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            if p.returncode != 0:
+                raise RuntimeError(f"reshard child rc={p.returncode}: "
+                                   f"{err[-500:]}")
+            for ln in out.splitlines():
+                if ln.startswith("WALL "):
+                    _, r, w, b, fp, fc = ln.split()
+                    walls[int(r)] = float(w)
+                    if int(fp) or int(b):
+                        reborn = (int(b), int(fp), int(fc))
+        return max(walls.values()), reborn
+
+    with tempfile.TemporaryDirectory(prefix="bench_reshard_") as tmp:
+        CheckpointManager(tmp).save(0, state)
+        reload_wall, _ = cohort(tmp, "reload")
+        reshard_wall, (bytes_moved, from_peers, from_ckpt) = cohort(
+            tmp, "reshard")
+
+    return {"metric": "reshard_wall_s", "value": round(reshard_wall, 4),
+            "unit": "s", "state_mb": round(nbytes / MB, 1), "world": world,
+            "leaves": nleaves,
+            "ckpt_reload_wall_s": round(reload_wall, 4),
+            "reshard_vs_reload_speedup": round(reload_wall
+                                               / max(reshard_wall, 1e-9), 2),
+            "bytes_moved": int(bytes_moved),
+            "leaves_from_peers": int(from_peers),
+            "leaves_from_checkpoint": int(from_ckpt)}
+
+
 # Run order = dict order.  The virtual-mesh configs (subprocess CPU runs,
 # no tunnel involved) come before the long device-bound train loop: a
 # wedged tunnel grant mid-fm_train (observed r03: >1h stall inside one
@@ -1454,6 +1564,7 @@ ALL = {
     "stream": (bench_stream, "stream_read"),
     "allreduce_mesh8": (bench_allreduce_mesh8, "allreduce_mesh8_psum_wall"),
     "sp_mesh8": (bench_sp_mesh8, "sp_mesh8_attention_wall"),
+    "elastic_reshard": (bench_elastic_reshard, "reshard_wall_s"),
 }
 
 
@@ -1473,8 +1584,10 @@ CPU_MESH = {"allreduce_mesh8", "sp_mesh8"}
 #  into a disk/pack comparison.
 #  ingest_autotune is CPU-pinned for the same reason: the convergence
 #  experiment compares host parse/pack rates against themselves.
+#  elastic_reshard is host-path by construction: it measures the control
+#  plane (tracker + loopback sockets + disk), not the device.
 HOST_ONLY = {"stream", "csv", "recordio", "cache", "higgs", "ingest_cached",
-             "ingest_ragged", "ingest_autotune"}
+             "ingest_ragged", "ingest_autotune", "elastic_reshard"}
 # superseded in the default order (ingest_scale measures workers_2 too);
 # still runnable by explicit name
 DEFAULT_SKIP = {"remote_ingest"}

@@ -49,6 +49,10 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 # need no extra tokens: their qps/latency/rows keys classify as usual.
 #  epochs_to_converge (ISSUE 7 autotuner cold start): each epoch spent
 #  searching is an epoch served on a worse config — fewer is better.
+#  The reshard family (ISSUE 9, BENCH_reshard_r*.json) needs no extra
+#  tokens either: reshard_wall_s / ckpt_reload_wall_s gate lower-better
+#  via "wall", reshard_vs_reload_speedup gates higher-better via
+#  "speedup".
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
                  "errors", "misses", "padding_ratio", "truncated",

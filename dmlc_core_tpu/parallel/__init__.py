@@ -2,18 +2,23 @@
 client, cluster launchers (reference ``tracker/`` — SURVEY §2.5, §5.8)."""
 
 from .mesh import (make_mesh, parse_mesh_spec, data_parallel_mesh,  # noqa: F401
-                   process_mesh_info)
+                   process_mesh_info, row_partition, remap_rows)
 from .collectives import (allreduce, broadcast, allgather,  # noqa: F401
                           reduce_scatter, MeshCollectives)
 from .tracker import (RabitTracker, PSTracker, compute_tree,  # noqa: F401
                       compute_ring)
 from .rabit import RabitContext  # noqa: F401
-from .elastic import ElasticJaxMesh  # noqa: F401
+from .reshard import (StateHandle, ReshardStats, HostSnapshot,  # noqa: F401
+                      snapshot_tree, redistribute)
+from .elastic import ElasticJaxMesh, ResyncResult  # noqa: F401
 
 __all__ = [
     "PSTracker",
     "make_mesh", "parse_mesh_spec", "data_parallel_mesh", "process_mesh_info",
+    "row_partition", "remap_rows",
     "allreduce", "broadcast", "allgather", "reduce_scatter", "MeshCollectives",
     "RabitTracker", "compute_tree", "compute_ring", "RabitContext",
-    "ElasticJaxMesh",
+    "StateHandle", "ReshardStats", "HostSnapshot", "snapshot_tree",
+    "redistribute",
+    "ElasticJaxMesh", "ResyncResult",
 ]

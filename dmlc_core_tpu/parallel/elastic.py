@@ -37,25 +37,82 @@ Proven end-to-end in
 ``tests/test_tracker_rabit.py::test_elastic_jax_mesh_rejoin_after_kill``:
 rank 2 of 3 is killed mid-job, relaunched with a bumped attempt, and the
 post-rejoin global-mesh reduction is bit-correct on every process.
+
+**Checkpoint-free recovery** (:mod:`.reshard`): registering a
+:class:`~.reshard.StateHandle` via :meth:`ElasticJaxMesh.register_state`
+upgrades the rebuild from "teardown + callers reload from checkpoint" to
+live redistribution — survivors snapshot their pytree shards to host
+memory before teardown, the new cohort agrees a shard-ownership map over
+the control plane, and missing shards move point-to-point to
+reborn/remapped ranks, with leaf-granular checkpoint reads only for
+shards no survivor holds.  ``resync()`` then returns the restored state
+(:class:`ResyncResult`), not just "rebuilt".
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
 from ..utils import check, get_env, log_info, log_warning
+from ..utils.metrics import metrics
+from ..utils.parameter import env_int, parse_lenient_bool
+from . import reshard as _reshard
 from .rabit import RabitContext
 
-__all__ = ["ElasticJaxMesh"]
+__all__ = ["ElasticJaxMesh", "ResyncResult"]
 
 _BOUNDED_SHUTDOWN: Optional[bool] = None
 
 # deliberately leaked coordination handles from torn-down generations on
 # jaxes without a bounded shutdown barrier — see _teardown's clear_state
 _ZOMBIE_HANDLES: list = []
+
+
+def _reshard_enabled() -> bool:
+    """``DMLC_RESHARD=0`` kill switch: fall back to the pre-reshard
+    behavior (rebuild only; callers restore from checkpoint)."""
+    v = parse_lenient_bool("DMLC_RESHARD")
+    return True if v is None else v
+
+
+def _data_plane_enabled() -> bool:
+    """``DMLC_ELASTIC_DATA_PLANE=0`` runs the elastic protocol —
+    generation agreement, ordered barriers, live resharding — WITHOUT
+    ``jax.distributed`` teardown/init.  For cohorts whose collectives all
+    ride the control plane (single-device CPU dev runs, jaxes without
+    multi-process CPU support) the data-plane rebuild is pure overhead;
+    everything else in the rejoin protocol is identical."""
+    v = parse_lenient_bool("DMLC_ELASTIC_DATA_PLANE")
+    return True if v is None else v
+
+
+class ResyncResult:
+    """Outcome of a sync point — truthy iff the mesh was rebuilt, so
+    existing ``if mesh.resync():`` call sites keep working.  On a rebuild
+    with a registered :class:`~.reshard.StateHandle`, ``state`` is the
+    redistributed pytree (None when nothing was restored) and ``stats``
+    the :class:`~.reshard.ReshardStats` for the round."""
+
+    __slots__ = ("rebuilt", "generation", "state", "stats")
+
+    def __init__(self, rebuilt: bool, generation: int,
+                 state: Any = None, stats: Any = None) -> None:
+        self.rebuilt = rebuilt
+        self.generation = generation
+        self.state = state
+        self.stats = stats
+
+    def __bool__(self) -> bool:
+        return self.rebuilt
+
+    def __repr__(self) -> str:
+        return (f"ResyncResult(rebuilt={self.rebuilt}, "
+                f"generation={self.generation}, "
+                f"state={'<restored>' if self.state is not None else None}, "
+                f"stats={self.stats})")
 
 
 def _bounded_shutdown_supported() -> bool:
@@ -110,6 +167,22 @@ class ElasticJaxMesh:
         # DMLC_NUM_ATTEMPT is the launcher's rebirth marker (every backend
         # sets it on retry) — the same signal that flips rabit to recover.
         self._dirty = get_env("DMLC_NUM_ATTEMPT", 0) > 0
+        self._state_handle: Optional[_reshard.StateHandle] = None
+        self._last_reshard: Tuple[Any, Any] = (None, None)
+
+    def register_state(self, handle: "_reshard.StateHandle") -> None:
+        """Register the live state to preserve across generation bumps.
+
+        With a handle registered, ``ensure()`` snapshots
+        ``handle.get_state()`` to host memory BEFORE tearing the data
+        plane down and redistributes it across the new cohort afterwards
+        (:func:`~.reshard.redistribute`), so :meth:`resync` returns the
+        restored state instead of just "rebuilt".  COLLECTIVE: register
+        at the same point relative to control-plane collectives on every
+        rank — the redistribute rounds run inside ``ensure()`` cohort-wide
+        (register on all ranks or none; ``DMLC_RESHARD=0`` disables
+        uniformly via the env)."""
+        self._state_handle = handle
 
     # -- data-plane lifecycle --------------------------------------------
     def _coordinator(self, gen: int) -> str:
@@ -173,11 +246,17 @@ class ElasticJaxMesh:
 
     def _barrier(self, tag: str) -> None:
         """Control-plane rendezvous (cheap host allreduce; the rabit layer
-        re-links around dead/reborn peers on its own)."""
+        re-links around dead/reborn peers on its own).  A failed barrier
+        means the teardown ordering it was pacing is NOT guaranteed —
+        count it and mark the mesh dirty so the next sync point forces a
+        generation bump instead of silently desyncing the cohort."""
         try:
             self.ctx.allreduce(np.array([0], np.int64), "max")
         except Exception as e:  # noqa: BLE001
-            log_warning("elastic: %s barrier failed (%s)", tag, e)
+            metrics.counter("elastic.barrier_failures").add(1)
+            self._dirty = True
+            log_warning("elastic: %s barrier failed (%s) — mesh marked "
+                        "dirty, next sync point will bump", tag, e)
 
     def ensure(self, gen: int) -> None:
         """Make this process a member of mesh generation ``gen``.
@@ -196,29 +275,49 @@ class ElasticJaxMesh:
         check(gen >= 0, "generation must be >= 0")
         if gen == self.generation:
             return
-        import jax
-        # without this, the coordination client's error-polling thread
-        # LOG(FATAL)s the WHOLE process the moment any peer dies ("client.h
-        # Terminating process because the JAX distributed service detected
-        # fatal errors") — survivors must outlive a peer death to rejoin.
-        # the flag is version-dependent: degrade to a warning on JAX
-        # builds that dropped/renamed it instead of refusing to start
-        try:
-            jax.config.update("jax_enable_recoverability", True)
-        except Exception as e:  # noqa: BLE001 — flag absent in this JAX
-            log_warning("elastic: jax_enable_recoverability unavailable "
-                        "(%s) — peer-death survival depends on this JAX "
-                        "build's defaults", e)
+        handle = self._state_handle
+        reshard_on = handle is not None and _reshard_enabled()
+        snap = None
+        if reshard_on:
+            # snapshot live shards to HOST memory before anything is torn
+            # down: device arrays (donated or not) die with the backend,
+            # host copies do not.  A failed snapshot degrades this rank to
+            # a non-holder (peers/checkpoint cover it), never blocks the
+            # rebuild.
+            try:
+                state = handle.get_state()
+            except Exception as e:  # noqa: BLE001 — degrade, don't wedge
+                log_warning("elastic: state snapshot failed (%s) — this "
+                            "rank recovers from peers/checkpoint", e)
+                state = None
+            if state is not None:
+                snap = _reshard.snapshot_tree(state)
+        data_plane = _data_plane_enabled()
+        if data_plane:
+            import jax
+            # without this, the coordination client's error-polling thread
+            # LOG(FATAL)s the WHOLE process the moment any peer dies
+            # ("client.h Terminating process because the JAX distributed
+            # service detected fatal errors") — survivors must outlive a
+            # peer death to rejoin.  the flag is version-dependent: degrade
+            # to a warning on JAX builds that dropped/renamed it instead of
+            # refusing to start
+            try:
+                jax.config.update("jax_enable_recoverability", True)
+            except Exception as e:  # noqa: BLE001 — flag absent in this JAX
+                log_warning("elastic: jax_enable_recoverability unavailable "
+                            "(%s) — peer-death survival depends on this JAX "
+                            "build's defaults", e)
         self._barrier("pre-rebuild")
         if self.process_id != 0:
-            if self.generation >= 0:
+            if self.generation >= 0 and data_plane:
                 self._teardown()
             self._barrier("followers-down")
         else:
             self._barrier("followers-down")
-            if self.generation >= 0:
+            if self.generation >= 0 and data_plane:
                 self._teardown()
-        if self.generation < 0:
+        if self.generation < 0 and data_plane:
             # a process that COMPUTED before joining (a reborn rank redoes
             # its epoch from checkpoint first — see initialize()'s rebirth
             # caveat) has an initialized backend, and
@@ -228,31 +327,48 @@ class ElasticJaxMesh:
             import jax.extend as jex
             jex.backend.clear_backends()
         log_info("elastic: joining mesh generation %d at %s "
-                 "(process %d/%d)", gen, self._coordinator(gen),
-                 self.process_id, self.num_processes)
-        # short heartbeat/shutdown budgets (env-tunable): a dead peer must
-        # be detected in seconds, and teardown of a broken generation must
-        # be BOUNDED — the default 300 s shutdown timeout lets the gen-g
-        # service (process 0) and a surviving client block each other long
-        # enough that the gen-g+1 rendezvous misses ITS window.  The next
-        # generation is a fresh service on a fresh port; nothing of the
-        # old one is worth waiting minutes for.
-        kw = {}
-        if _bounded_shutdown_supported():
-            kw = dict(
-                heartbeat_timeout_seconds=int(
-                    os.environ.get("DMLC_ELASTIC_HEARTBEAT_S", "10")),
-                shutdown_timeout_seconds=int(
-                    os.environ.get("DMLC_ELASTIC_SHUTDOWN_S", "10")))
-        # a jax that predates the budget kwargs still rebuilds the mesh;
-        # its dead-peer detection is just slower and its teardown goes
-        # through the barrier-less path in _teardown
-        jax.distributed.initialize(
-            coordinator_address=self._coordinator(gen),
-            num_processes=self.num_processes,
-            process_id=self.process_id, **kw)
+                 "(process %d/%d%s)", gen, self._coordinator(gen),
+                 self.process_id, self.num_processes,
+                 "" if data_plane else ", control plane only")
+        if data_plane:
+            # short heartbeat/shutdown budgets (env-tunable): a dead peer
+            # must be detected in seconds, and teardown of a broken
+            # generation must be BOUNDED — the default 300 s shutdown
+            # timeout lets the gen-g service (process 0) and a surviving
+            # client block each other long enough that the gen-g+1
+            # rendezvous misses ITS window.  The next generation is a
+            # fresh service on a fresh port; nothing of the old one is
+            # worth waiting minutes for.
+            kw = {}
+            if _bounded_shutdown_supported():
+                kw = dict(
+                    heartbeat_timeout_seconds=env_int(
+                        "DMLC_ELASTIC_HEARTBEAT_S", 10, minimum=1),
+                    shutdown_timeout_seconds=env_int(
+                        "DMLC_ELASTIC_SHUTDOWN_S", 10, minimum=1))
+            # a jax that predates the budget kwargs still rebuilds the
+            # mesh; its dead-peer detection is just slower and its teardown
+            # goes through the barrier-less path in _teardown
+            jax.distributed.initialize(
+                coordinator_address=self._coordinator(gen),
+                num_processes=self.num_processes,
+                process_id=self.process_id, **kw)
         self.generation = gen
         self._dirty = False
+        if reshard_on:
+            # redistribute AFTER the new generation is up so reborn and
+            # remapped ranks participate; peers → leaf-granular checkpoint
+            # → cohort-wide error (see reshard.redistribute)
+            restored, stats = _reshard.redistribute(
+                self.ctx, snap, plan=handle.plan,
+                checkpoint=handle.resolve_checkpoint(),
+                checkpoint_step=handle.checkpoint_step,
+                template=handle.resolve_template(), generation=gen)
+            self._last_reshard = (restored, stats)
+            if restored is not None and handle.set_state is not None:
+                handle.set_state(restored)
+        else:
+            self._last_reshard = (None, None)
 
     # -- failure handling -------------------------------------------------
     def mark_failed(self) -> None:
@@ -260,10 +376,15 @@ class ElasticJaxMesh:
         exception); the next :meth:`resync` proposes a bump."""
         self._dirty = True
 
-    def resync(self) -> bool:
+    def resync(self) -> "ResyncResult":
         """Sync point: agree on the cohort's generation over the control
-        plane and re-initialize if it moved.  Returns True iff the mesh
-        was rebuilt (callers then restore device state from checkpoint).
+        plane and re-initialize if it moved.  Returns a
+        :class:`ResyncResult` — truthy iff the mesh was rebuilt (drop-in
+        for the old bool).  With a :meth:`register_state` handle, a
+        rebuild carries the redistributed state in ``.state`` (survivor
+        shards reassembled over the control plane; checkpoint only for
+        shards no survivor held), so callers re-place it with the new
+        mesh's sharding instead of reloading from checkpoint.
 
         Two host ``allreduce(max)`` rounds — the rabit layer re-links
         around dead/reborn peers on its own (tracker ``recover``), so this
@@ -283,9 +404,10 @@ class ElasticJaxMesh:
             np.array([propose], np.int64), "max")[0])
         agreed = max(agreed, 0)   # first-ever sync point: start at gen 0
         if agreed == self.generation:
-            return False
+            return ResyncResult(False, self.generation)
         self.ensure(agreed)
-        return True
+        restored, stats = self._last_reshard
+        return ResyncResult(True, self.generation, restored, stats)
 
     def initialize(self) -> None:
         """First join: generation 0, or — when reborn — whatever the
@@ -329,10 +451,13 @@ class ElasticJaxMesh:
         if self.generation < 0:
             return
         self._barrier("pre-close")
+        data_plane = _data_plane_enabled()
         if self.process_id != 0:
-            self._teardown(final=True)
+            if data_plane:
+                self._teardown(final=True)
             self._barrier("followers-out")
         else:
             self._barrier("followers-out")
-            self._teardown(final=True)
+            if data_plane:
+                self._teardown(final=True)
         self.generation = -1

@@ -18,7 +18,7 @@ rank/world view (process_index ≙ the reference's ``DMLC_TASK_ID``).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..utils import DMLCError, check
 
 __all__ = ["make_mesh", "parse_mesh_spec", "process_mesh_info",
-           "data_parallel_mesh"]
+           "data_parallel_mesh", "row_partition", "remap_rows"]
 
 
 def parse_mesh_spec(spec: str) -> Dict[str, int]:
@@ -73,6 +73,45 @@ def make_mesh(spec: str = "dp=-1",
 
 def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return make_mesh("dp=-1", devices)
+
+
+def row_partition(n_rows: int, parts: int) -> List[Tuple[int, int]]:
+    """Contiguous near-equal ``[start, stop)`` row ranges — the
+    reference's ``ResetPartition(rank, nsplit)`` contract, reused by the
+    elastic resharder as the canonical target layout for row-sharded
+    leaves.  The first ``n_rows % parts`` ranges carry one extra row, so
+    the layout is a pure function of ``(n_rows, parts)`` and every cohort
+    member computes identical shard boundaries without communicating."""
+    check(parts > 0, f"row_partition needs parts > 0, got {parts}")
+    check(n_rows >= 0, f"row_partition needs n_rows >= 0, got {n_rows}")
+    base, extra = divmod(n_rows, parts)
+    out: List[Tuple[int, int]] = []
+    start = 0
+    for r in range(parts):
+        stop = start + base + (1 if r < extra else 0)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+def remap_rows(n_rows: int, old_parts: int, new_parts: int
+               ) -> List[List[Tuple[int, int, int]]]:
+    """Shrink/grow remap plan: for each NEW rank, which ``(old_rank,
+    start, stop)`` global row ranges feed its new shard.  Both layouts
+    are :func:`row_partition`, so when the cohort resizes the resharder
+    can tell every survivor exactly which peers hold the rows its new
+    shard needs — e.g. 3→2: new rank 0 keeps its old rows and pulls the
+    head of old rank 1's; nothing touches a checkpoint."""
+    old = row_partition(n_rows, old_parts)
+    plan: List[List[Tuple[int, int, int]]] = []
+    for (ns, ne) in row_partition(n_rows, new_parts):
+        feeds: List[Tuple[int, int, int]] = []
+        for old_rank, (os_, oe) in enumerate(old):
+            lo, hi = max(ns, os_), min(ne, oe)
+            if lo < hi:
+                feeds.append((old_rank, lo, hi))
+        plan.append(feeds)
+    return plan
 
 
 def process_mesh_info() -> Dict[str, int]:

@@ -1,32 +1,50 @@
-"""Elastic data-parallel training that SURVIVES a worker crash.
+"""Elastic data-parallel training that SURVIVES a worker crash — with
+checkpoint-free recovery.
 
 Usage (the launcher respawns crashed ranks; ``--elastic`` is required)::
 
     python -m dmlc_core_tpu.parallel.launcher.submit \
         --cluster tpu -n 3 --elastic --max-attempts 2 -- \
         python examples/elastic_train.py <uri> [--epochs E] \
-            [--crash-rank R --crash-epoch E]
+            [--crash-rank R --crash-epoch E] [--state-ckpt-dir D]
 
 Each rank trains a FactorizationMachine on ITS partition of the input
-(the reference's ``ResetPartition(rank, n)`` contract), with two planes
-of fault tolerance working together:
+(the reference's ``ResetPartition(rank, n)`` contract) and the cohort
+synchronizes by elastic averaging at every epoch boundary, with three
+planes of fault tolerance working together:
 
 * **control plane** — rabit collectives through the tracker: epoch-loss
-  reduction, checkpoint (seq fast-forward on rebirth);
+  reduction, parameter averaging, a tiny position checkpoint (seq
+  fast-forward on rebirth);
 * **data plane** — :class:`ElasticJaxMesh`: every epoch boundary is a
   sync point (``resync``); when a rank dies mid-epoch, the launcher
-  respawns it with a bumped ``DMLC_NUM_ATTEMPT``, the reborn rank
-  restores its rabit checkpoint, and the WHOLE cohort rebuilds the
-  jax.distributed mesh at the next generation — training continues with
-  no manual intervention.
+  respawns it with a bumped ``DMLC_NUM_ATTEMPT`` and the WHOLE cohort
+  rebuilds the jax.distributed mesh at the next generation;
+* **state plane** — a :class:`StateHandle` registered on the mesh: on a
+  generation bump, survivors' model + optimizer state moves to the
+  reborn rank over the control plane (``parallel/reshard.py``) — NO
+  epoch is replayed and NO checkpoint is read while any survivor holds
+  the state.  The rabit checkpoint carries only the epoch number; the
+  optional ``--state-ckpt-dir`` arms the per-leaf last-resort path.
+
+The reborn rank skips compute on its join epoch (it contributes zeros to
+the averaging collectives to stay frame-aligned) and receives the full
+averaged state bit-equal to the survivors' via the resharder.  Each
+epoch prints a machine-readable ``EPOCH {json}`` line with the loss, the
+state digest, and the reshard counters — chaos tests assert loss-curve
+continuity and bit-equality from these.
 
 ``--crash-rank/--crash-epoch`` inject a one-shot crash (first attempt
-only) to demonstrate the rejoin live; tests drive exactly that path.
+only); the ``fault_point("elastic.epoch")`` probe site arms the same
+kill through ``DMLC_FAULT_SPEC`` (e.g.
+``elastic.epoch:error=1:times=1:after=1`` kills on the second epoch).
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
+import json
 import os
 import sys
 
@@ -42,6 +60,8 @@ def main() -> None:
     ap.add_argument("--batch-rows", type=int, default=128)
     ap.add_argument("--crash-rank", type=int, default=-1)
     ap.add_argument("--crash-epoch", type=int, default=-1)
+    ap.add_argument("--state-ckpt-dir", default="",
+                    help="arm the resharder's per-leaf checkpoint fallback")
     args = ap.parse_args()
 
     import jax
@@ -49,17 +69,21 @@ def main() -> None:
 
     from dmlc_core_tpu.data import create_parser
     from dmlc_core_tpu.models import FactorizationMachine, FusedTrainer
-    from dmlc_core_tpu.parallel import ElasticJaxMesh, RabitContext
+    from dmlc_core_tpu.parallel import (ElasticJaxMesh, RabitContext,
+                                        StateHandle)
     from dmlc_core_tpu.pipeline import DeviceLoader
+    from dmlc_core_tpu.utils.checkpoint import flatten_tree, unflatten_like
+    from dmlc_core_tpu.utils.faults import FaultInjected, fault_point
 
     attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
     ctx = RabitContext.from_env()
     start_epoch = 0
-    saved = None
+    joining = False
     if attempt > 0:
         saved = ctx.load_checkpoint()     # rabit seq fast-forwards here
         if saved is not None:
             start_epoch = saved["epoch"] + 1
+            joining = True
         print(f"rank {ctx.rank} reborn (attempt {attempt}), "
               f"resuming at epoch {start_epoch}", flush=True)
     mesh = ElasticJaxMesh(ctx)            # launcher provides the base port
@@ -70,59 +94,120 @@ def main() -> None:
         # still restore a rabit seq that matches the survivors — who ran
         # ensure(0)'s two control-plane barriers before epoch 0's first
         # collective
-        ctx.checkpoint({"epoch": -1, "params": None, "opt_state": None})
+        ctx.checkpoint({"epoch": -1})
     # A REBORN rank must NOT initialize here: survivors are blocked in the
     # epoch-loss allreduce, so the reborn's next collective must be that
-    # same allreduce (after re-running its epoch from the checkpoint) —
-    # the mesh join happens at the shared sync point's resync(), where
-    # the frame positions line up.  initialize()-on-rebirth is only
-    # correct when the survivors' next collective is also resync (the
-    # pattern tests/test_tracker_rabit.py's worker uses).
+    # same allreduce — the mesh join happens at the shared sync point's
+    # resync(), where the frame positions line up.
 
     model = FactorizationMachine(num_features=args.features, dim=args.dim)
     opt = optax.adam(5e-2)
-    to_dev = jax.tree_util.tree_map
-    params = (to_dev(jax.numpy.asarray, saved["params"]) if saved else None)
-    opt_state = (to_dev(jax.numpy.asarray, saved["opt_state"])
-                 if saved else None)
+    tmap = jax.tree_util.tree_map
 
+    # deterministic zero template: identical structure/dtypes on every
+    # rank — the averaging contribution of a joining rank, the resharder's
+    # container template, and the first epoch's state shell
+    params0 = model.init(jax.random.PRNGKey(0))
+    template = {
+        "params": tmap(lambda a: np.zeros_like(np.asarray(a)), params0),
+        "opt_state": tmap(lambda a: np.zeros_like(np.asarray(a)),
+                          opt.init(params0)),
+    }
+
+    # the state plane: box["state"] is this rank's live host-side state;
+    # None while joining, so the reborn recovers WHOLLY from peers and
+    # the chaos test can assert bit-equality of the full transfer
+    box = {"state": None}
+    handle = StateHandle(
+        lambda: box["state"], template=template,
+        checkpoint=args.state_ckpt_dir or None)
+    mesh.register_state(handle)
+
+    def digest(tree) -> str:
+        flat = flatten_tree(tree)
+        h = hashlib.sha1()
+        for p in sorted(flat):
+            a = np.ascontiguousarray(flat[p])
+            h.update(p.encode())
+            h.update(str(a.dtype).encode())
+            h.update(a.tobytes())
+        return h.hexdigest()[:16]
+
+    params = opt_state = None
     for epoch in range(start_epoch, args.epochs):
-        loader = DeviceLoader(
-            create_parser(args.uri, ctx.rank, ctx.world_size, "libsvm"),
-            batch_rows=args.batch_rows, nnz_cap=args.batch_rows * 16,
-            id_mod=args.features, emit="host")
-        trainer = FusedTrainer(model, opt, loader, k=8, params=params,
-                               opt_state=opt_state)
-        try:
-            loss = trainer.run_epoch()
-        finally:
-            loader.close()
-        params, opt_state = trainer.params, trainer.opt_state
+        contributing = not (joining and epoch == start_epoch)
+        loss = 0.0
+        if contributing:
+            loader = DeviceLoader(
+                create_parser(args.uri, ctx.rank, ctx.world_size, "libsvm"),
+                batch_rows=args.batch_rows, nnz_cap=args.batch_rows * 16,
+                id_mod=args.features, emit="host")
+            trainer = FusedTrainer(model, opt, loader, k=8, params=params,
+                                   opt_state=opt_state)
+            try:
+                loss = trainer.run_epoch()
+            finally:
+                loader.close()
+            params, opt_state = trainer.params, trainer.opt_state
         if (attempt == 0 and ctx.rank == args.crash_rank
                 and epoch == args.crash_epoch):
             print(f"rank {ctx.rank} CRASHING at epoch {epoch}", flush=True)
             os._exit(7)
-        # Epoch sync point, in collective order: (1) loss reduction,
-        # (2) mesh resync — a death anywhere surfaces here and the data
-        # plane rebuilds — then (3) the rabit checkpoint LAST, so a
-        # reborn rank's restored seq equals the survivors' seq at the
-        # next epoch's entry (a checkpoint taken before resync would
-        # desynchronize the control-plane frame guard on rebirth).
-        # Host snapshots are taken BEFORE resync: a rebuild tears the
-        # backend down and live device arrays die with it.
-        host_params = to_dev(np.asarray, params)
-        host_opt = to_dev(np.asarray, opt_state)
-        mean_loss = float(ctx.allreduce(
-            np.array([loss], np.float64))[0]) / ctx.world_size
-        rebuilt = mesh.resync()
-        if rebuilt:
-            params = to_dev(jax.numpy.asarray, host_params)
-            opt_state = to_dev(jax.numpy.asarray, host_opt)
-        ctx.checkpoint({"epoch": epoch, "params": host_params,
-                        "opt_state": host_opt})
+        try:
+            # chaos kill site: armed by DMLC_FAULT_SPEC, fires AFTER local
+            # compute and BEFORE the sync collectives — the shape of a real
+            # mid-epoch death (survivors block in the loss allreduce until
+            # the launcher respawns this rank)
+            fault_point("elastic.epoch")
+        except FaultInjected:
+            print(f"rank {ctx.rank} CRASHING at epoch {epoch}", flush=True)
+            os._exit(7)
+
+        # Epoch sync point, in collective order — identical on every rank:
+        # (1) loss + liveness reduction, (2) elastic averaging of every
+        # state leaf (joining ranks contribute zeros), (3) mesh resync —
+        # a death anywhere surfaces here, the data plane rebuilds, and the
+        # resharder hands reborn ranks the averaged state — then (4) the
+        # rabit position checkpoint LAST, so a reborn rank's restored seq
+        # equals the survivors' seq at the next epoch's entry.
+        flag = 1.0 if contributing else 0.0
+        agg = ctx.allreduce(np.array([loss * flag, flag], np.float64))
+        live = max(agg[1], 1.0)
+        mean_loss = float(agg[0]) / live
+        host = ({"params": tmap(np.asarray, params),
+                 "opt_state": tmap(np.asarray, opt_state)}
+                if contributing else template)
+        flat = flatten_tree(host)
+        avg = {}
+        for path in sorted(flat):
+            leaf = flat[path]
+            summed = ctx.allreduce(np.ascontiguousarray(
+                np.atleast_1d(leaf), dtype=np.float64))
+            mean = summed / live
+            if np.issubdtype(leaf.dtype, np.integer):
+                mean = np.rint(mean)
+            avg[path] = mean.astype(leaf.dtype).reshape(leaf.shape)
+        host = unflatten_like(template, avg)
+        box["state"] = host if contributing else None
+        res = mesh.resync()
+        if res.rebuilt and res.state is not None:
+            host = res.state              # survivors: own snapshot back;
+        box["state"] = host               # reborn: peers' averaged state
+        params = tmap(jax.numpy.asarray, host["params"])
+        opt_state = tmap(jax.numpy.asarray, host["opt_state"])
+        joining = False
+        ctx.checkpoint({"epoch": epoch})
+        stats = res.stats
+        rec = {"rank": ctx.rank, "epoch": epoch, "loss": round(mean_loss, 6),
+               "gen": mesh.generation, "rebuilt": bool(res),
+               "contributed": bool(contributing), "digest": digest(host),
+               "from_peers": getattr(stats, "leaves_from_peers", 0),
+               "from_ckpt": getattr(stats, "leaves_from_checkpoint", 0),
+               "bytes_moved": getattr(stats, "bytes_moved", 0)}
+        print("EPOCH " + json.dumps(rec), flush=True)
         print(f"rank {ctx.rank} epoch {epoch} mean_loss {mean_loss:.5f}"
               + (f" [mesh rebuilt -> gen {mesh.generation}]"
-                 if rebuilt else ""), flush=True)
+                 if res.rebuilt else ""), flush=True)
 
     print(f"rank {ctx.rank} DONE gen={mesh.generation}", flush=True)
     mesh.close()

@@ -338,3 +338,107 @@ def test_chaos_soak_serving_and_io(flaky_server, monkeypatch):
                             assert len(s.read(64)) == 64
     assert _counter("faults.serving.server.admit.errors") > 0
     assert _counter("faults.s3.request.errors") > 0
+
+
+# ---------------------------------------------------------------------------
+# (f) elastic cohort: kill one rank mid-epoch, checkpoint-free recovery
+# ---------------------------------------------------------------------------
+
+def test_elastic_kill_one_rank_recovers_from_peers(tmp_path):
+    """``DMLC_FAULT_SPEC`` kills one rank of a 3-rank elastic cohort
+    between its epoch-1 compute and the sync collectives (the
+    ``elastic.epoch`` probe in examples/elastic_train.py).  The respawned
+    rank must rejoin at epoch 2's timeline position — i.e. skip compute
+    on its join epoch, not replay it — with its full state served live
+    from the survivors: zero checkpoint reads, state digest bit-equal on
+    every rank, loss curve continuous (every epoch exactly once,
+    identical loss on all ranks)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from dmlc_core_tpu.parallel import RabitTracker
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    uri, _ = _libsvm(tmp_path)
+    world = 3
+    tracker = RabitTracker(num_workers=world, host_ip="127.0.0.1")
+    tracker.start()
+    tenv = tracker.worker_envs()
+    base = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu",
+            "DMLC_TRACKER_URI": tenv["DMLC_TRACKER_URI"],
+            "DMLC_TRACKER_PORT": str(tenv["DMLC_TRACKER_PORT"]),
+            "DMLC_ELASTIC_BASE_PORT": str(free_port()),
+            # control-plane-only cohort: this jax's CPU backend has no
+            # multi-process collectives, and every collective in the
+            # example rides rabit anyway — the rejoin protocol (barriers,
+            # generation agreement, resharding) is identical
+            "DMLC_ELASTIC_DATA_PLANE": "0",
+            "DMLC_CHECKPOINT_DIR": str(tmp_path / "ckpt"),
+            "DMLC_CONNECT_TIMEOUT": "120", "DMLC_RECOVER_TIMEOUT": "300"}
+    (tmp_path / "ckpt").mkdir()
+    base.pop("DMLC_FAULT_SPEC", None)
+    cmd = [sys.executable,
+           os.path.join(repo, "examples", "elastic_train.py"),
+           f"file://{uri}", "--epochs", "3", "--features", "512",
+           "--batch-rows", "64"]
+
+    def spawn(i, attempt, fault=None):
+        env = dict(base, DMLC_TASK_ID=f"c{i}",
+                   DMLC_NUM_ATTEMPT=str(attempt))
+        if fault:
+            env["DMLC_FAULT_SPEC"] = fault
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env)
+
+    # after=1: the probe passes at epoch 0 and fires at epoch 1, exactly
+    # once — the respawned incarnation runs with the spec removed
+    procs = [spawn(i, 0, "elastic.epoch:error=1.0:times=1:after=1"
+                   if i == 2 else None) for i in range(world)]
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline and procs[2].poll() is None:
+        time.sleep(0.2)
+    crash_out, crash_err = procs[2].communicate()
+    assert procs[2].returncode == 7, \
+        f"victim rc={procs[2].returncode}: {crash_err[-2000:]}"
+    assert "CRASHING at epoch 1" in crash_out
+    reborn = spawn(2, 1)
+
+    outs = [(crash_out, crash_err)]
+    for p in (procs[0], procs[1], reborn):
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-2000:]
+        outs.append((out, err))
+    tracker.join(timeout=30)
+    assert "reborn (attempt 1), resuming at epoch 1" in outs[-1][0]
+
+    recs = [json.loads(ln[6:]) for out, _ in outs
+            for ln in out.splitlines() if ln.startswith("EPOCH ")]
+    by_rank = {}
+    for r in recs:
+        by_rank.setdefault(r["rank"], []).append(r)
+    assert sorted(by_rank) == [0, 1, 2]
+    for rank, rs in by_rank.items():
+        # continuity: every epoch exactly once, in order, across BOTH of
+        # the victim's incarnations — nothing replayed, nothing skipped
+        assert [r["epoch"] for r in rs] == [0, 1, 2], (rank, rs)
+        # zero checkpoint reads anywhere in the run
+        assert all(r["from_ckpt"] == 0 for r in rs)
+    for e in range(3):
+        losses = {r["loss"] for r in recs if r["epoch"] == e}
+        digests = {r["digest"] for r in recs if r["epoch"] == e}
+        assert len(losses) == 1, (e, losses)     # same curve on every rank
+        assert len(digests) == 1, (e, digests)   # state bit-equal
+
+    # the join epoch: the reborn rank computed nothing and received every
+    # leaf from peers (params + adam state of the 512-feature FM)
+    joins = [r for r in recs if not r["contributed"]]
+    assert len(joins) == 1
+    join = joins[0]
+    assert join["epoch"] == 1 and join["rebuilt"] and join["gen"] == 1
+    assert join["from_peers"] >= 3 and join["bytes_moved"] > 0
+    # survivors crossed the same rebuild, serving their state, reading
+    # no checkpoint
+    for rank, rs in by_rank.items():
+        assert rs[1]["gen"] == 1 and rs[1]["rebuilt"]

@@ -1,0 +1,248 @@
+"""Checkpoint-free elastic resharding: the live state-redistribution
+protocol (``parallel/reshard.py``) over real tracker + loopback sockets.
+
+Covers the full decision tree — local pieces → peer fetch → leaf-granular
+checkpoint read → cohort-wide failure — plus the pure planning helpers
+(``row_partition``/``remap_rows``) and the snapshot budget demotion."""
+
+import collections
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dmlc_core_tpu.parallel import (HostSnapshot, RabitContext,  # noqa: E402
+                                    RabitTracker, redistribute,
+                                    remap_rows, row_partition, snapshot_tree)
+from dmlc_core_tpu.utils import DMLCError  # noqa: E402
+from dmlc_core_tpu.utils.checkpoint import (CheckpointManager,  # noqa: E402
+                                            flatten_tree, unflatten_like)
+from dmlc_core_tpu.utils.metrics import metrics  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# pure planning helpers
+# ---------------------------------------------------------------------------
+
+def test_row_partition_contract():
+    assert row_partition(9, 3) == [(0, 3), (3, 6), (6, 9)]
+    # first n % parts ranges carry the extra row
+    assert row_partition(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert row_partition(2, 4) == [(0, 1), (1, 2), (2, 2), (2, 2)]
+    assert row_partition(0, 2) == [(0, 0), (0, 0)]
+    # exhaustive cover property
+    for n in (1, 5, 17, 100):
+        for p in (1, 2, 3, 7):
+            parts = row_partition(n, p)
+            assert parts[0][0] == 0 and parts[-1][1] == n
+            assert all(a[1] == b[0] for a, b in zip(parts, parts[1:]))
+
+
+def test_remap_rows_shrink_and_grow():
+    # 3 -> 2: new rank 0 keeps its rows and pulls the head of old rank 1
+    plan = remap_rows(9, 3, 2)
+    assert plan == [[(0, 0, 3), (1, 3, 5)], [(1, 5, 6), (2, 6, 9)]]
+    # 2 -> 3: feeds cover each new range exactly, in order
+    for feeds, (ns, ne) in zip(remap_rows(10, 2, 3), row_partition(10, 3)):
+        assert feeds[0][1] == ns and feeds[-1][2] == ne
+        assert all(a[2] == b[1] for a, b in zip(feeds, feeds[1:]))
+
+
+# ---------------------------------------------------------------------------
+# snapshot
+# ---------------------------------------------------------------------------
+
+def test_snapshot_tree_roundtrip_and_zero_d():
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(4, 3),
+            "b": np.float64(3.5)}
+    snap = snapshot_tree(tree)
+    assert snap.schema["w"] == ((4, 3), "float32")
+    # 0-d leaves ride as one (1,) row against a () global shape
+    assert snap.schema["b"] == ((), "float64")
+    (s, e, arr) = snap.pieces["b"][0]
+    assert (s, e) == (0, 1) and arr.shape == (1,)
+
+
+def test_snapshot_budget_demotes_to_non_holder():
+    before = metrics.counter("reshard.snapshot_skipped").value
+    big = {"w": np.zeros((1024, 1024), np.float32)}       # 4 MiB
+    assert snapshot_tree(big, max_bytes=1 << 20) is None
+    assert metrics.counter("reshard.snapshot_skipped").value == before + 1
+    assert snapshot_tree(big, max_bytes=1 << 23) is not None
+
+
+def test_flatten_unflatten_preserves_namedtuples():
+    Opt = collections.namedtuple("Opt", ["mu", "nu"])
+    tree = {"params": [np.ones(2), np.zeros(3)],
+            "opt": Opt(mu={"w": np.full(2, 7.0)}, nu=np.int32(4))}
+    flat = flatten_tree(tree)
+    # NamedTuples path by position, like plain tuples — the checkpoint
+    # treedef has no field names to agree on across ranks
+    assert sorted(flat) == ["opt/0/w", "opt/1", "params/0", "params/1"]
+    back = unflatten_like(tree, flat)
+    assert isinstance(back["opt"], Opt)
+    assert isinstance(back["params"], list)
+    np.testing.assert_array_equal(back["opt"].mu["w"], np.full(2, 7.0))
+    assert back["opt"].nu.shape == ()
+
+
+# ---------------------------------------------------------------------------
+# the cohort protocol
+# ---------------------------------------------------------------------------
+
+def _cohort(world, fn, timeout=60):
+    """Tracker + thread workers; fn(ctx, rank) -> result.  Returns
+    (results, errors) so failure tests can assert cohort-wide raises."""
+    tracker = RabitTracker(num_workers=world, host_ip="127.0.0.1")
+    tracker.start()
+    env = tracker.worker_envs()
+    results = [None] * world
+    errors = [None] * world
+
+    def worker(i):
+        ctx = None
+        try:
+            ctx = RabitContext(env["DMLC_TRACKER_URI"],
+                               int(env["DMLC_TRACKER_PORT"]), jobid=f"w{i}")
+            results[ctx.rank] = fn(ctx, ctx.rank)
+        except Exception as e:  # noqa: BLE001
+            errors[i] = e
+        finally:
+            if ctx is not None:
+                try:
+                    ctx.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    tracker.join(timeout=30)
+    return results, [e for e in errors if e is not None]
+
+
+def _digest(flat):
+    import hashlib
+    h = hashlib.sha1()
+    for p in sorted(flat):
+        a = np.ascontiguousarray(flat[p])
+        h.update(p.encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def test_redistribute_rebirth_replicated():
+    """Rank 2 is reborn (holds nothing): it must receive every leaf from
+    the survivors bit-equal, with zero checkpoint reads."""
+    state = {"params": {"w": np.arange(24, dtype=np.float32).reshape(6, 4),
+                        "b": np.float64(1.25)},
+             "step": np.int32(7)}
+
+    def fn(ctx, rank):
+        snap = snapshot_tree(state) if rank != 2 else None
+        restored, stats = redistribute(ctx, snap, template=state,
+                                       generation=1)
+        return restored, stats
+
+    results, errors = _cohort(3, fn)
+    assert not errors, errors
+    digests = set()
+    for rank, (restored, stats) in enumerate(results):
+        flat = flatten_tree(restored)
+        digests.add(_digest(flat))
+        assert stats.leaves_from_checkpoint == 0
+        assert restored["params"]["b"].shape == ()       # 0-d survives
+        assert restored["step"].dtype == np.int32
+        if rank == 2:
+            assert stats.leaves_from_peers == 3
+            assert stats.bytes_moved > 0
+        else:
+            assert stats.leaves_local == 3
+            assert stats.bytes_moved == 0
+    assert len(digests) == 1                             # bit-equal cohort
+
+
+def test_redistribute_shrink_without_checkpoint():
+    """Planned 3 -> 2 resize: survivors re-partition a row-sharded table
+    from each other's shards; the departing rank serves its rows out and
+    keeps nothing.  No checkpoint is configured — zero reads by
+    construction."""
+    table = np.arange(27, dtype=np.float32).reshape(9, 3)
+    old = row_partition(9, 3)
+    new = row_partition(9, 2)
+
+    def fn(ctx, rank):
+        snap = HostSnapshot()
+        s, e = old[rank]
+        snap.add("table", table[s:e], start=s, global_rows=9)
+
+        def plan(path, gshape):
+            return new[rank] if rank < 2 else (0, 0)
+
+        restored, stats = redistribute(ctx, snap, plan=plan, generation=1)
+        return restored, stats
+
+    results, errors = _cohort(3, fn)
+    assert not errors, errors
+    for rank in (0, 1):
+        restored, stats = results[rank]
+        s, e = new[rank]
+        np.testing.assert_array_equal(restored["table"], table[s:e])
+        assert stats.leaves_from_checkpoint == 0
+        assert stats.bytes_moved > 0                     # pulled peer rows
+    restored, stats = results[2]                         # departing rank
+    assert restored is None
+    assert stats.leaves_from_checkpoint == 0
+
+
+def test_redistribute_checkpoint_fallback(tmp_path):
+    """A leaf NO survivor holds comes from the checkpoint — and only that
+    leaf (leaf-granular restore, not a full reload)."""
+    held = {"kept": np.full((4, 2), 3.0, np.float32)}
+    lost = {"kept": held["kept"], "lost": np.arange(5, dtype=np.float64)}
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(3, lost)
+
+    def fn(ctx, rank):
+        # every rank holds only "kept"; "lost" exists only in the
+        # checkpoint schema of rank 0's manifest broadcast
+        snap = snapshot_tree(held)
+        if rank == 0:
+            snap.schema["lost"] = ((5,), "float64")      # advertised, empty
+        restored, stats = redistribute(
+            ctx, snap, checkpoint=CheckpointManager(str(tmp_path)),
+            generation=2)
+        return restored, stats
+
+    results, errors = _cohort(2, fn)
+    assert not errors, errors
+    for restored, stats in results:
+        np.testing.assert_array_equal(restored["lost"],
+                                      np.arange(5, dtype=np.float64))
+        assert stats.leaves_from_checkpoint == 1
+        np.testing.assert_array_equal(restored["kept"], held["kept"])
+
+
+def test_redistribute_unrecoverable_raises_cohort_wide():
+    """A gap with no holder and no checkpoint must raise on EVERY rank —
+    half-restored cohorts don't train."""
+    held = {"w": np.ones((2, 2), np.float32)}
+
+    def fn(ctx, rank):
+        snap = snapshot_tree(held)
+        if rank == 0:
+            snap.schema["ghost"] = ((3,), "float32")     # nobody has it
+        return redistribute(ctx, snap, generation=3)
+
+    before = metrics.counter("reshard.failures").value
+    results, errors = _cohort(2, fn)
+    assert len(errors) == 2
+    assert all(isinstance(e, DMLCError) for e in errors)
+    assert all("unrecoverable" in str(e) for e in errors)
+    assert metrics.counter("reshard.failures").value >= before + 2
