@@ -1,0 +1,105 @@
+"""Lint gate over the committed ``lint_baseline.json``.
+
+The same shape as ``check_regression.py``: a committed artifact is the
+contract, the tool exits non-zero when the tree moves past it.  Here
+the artifact is the dmlclint finding set — the baseline is **empty**
+after the ISSUE 9 sweep, so any new finding fails CI until it is fixed
+or carries an in-source ``# dmlclint: disable=<rule>`` suppression
+with a justification.
+
+Findings are keyed by ``(rule, path, message)`` — line numbers churn
+with unrelated edits and are deliberately not part of the key.  A
+baselined finding that disappears is reported as fixed and the tool
+suggests re-baselining (``--update``) so the shrink is committed.
+
+Usage::
+
+    python benchmarks/check_lint.py [--update] [--baseline PATH] [paths]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from dmlc_core_tpu.analysis.core import lint_paths  # noqa: E402
+
+SCHEMA = "dmlc.lint.baseline/1"
+_DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "lint_baseline.json")
+
+
+def _key(f: Dict[str, Any]) -> Tuple[str, str, str]:
+    return (f["rule"], f["path"], f["message"])
+
+
+def run(paths: List[str]) -> List[Dict[str, Any]]:
+    findings, _stats, _ctx = lint_paths(paths, repo_root=_REPO)
+    return [f.to_dict() for f in findings]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate the tree against the committed lint baseline")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_REPO, "dmlc_core_tpu")],
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
+                    help="baseline artifact (default: "
+                         "benchmarks/lint_baseline.json)")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from this run and exit 0")
+    args = ap.parse_args(argv)
+
+    current = run(args.paths)
+
+    if args.update:
+        payload = {"schema": SCHEMA,
+                   "findings": sorted(
+                       current, key=lambda f: (f["rule"], f["path"],
+                                               f["message"]))}
+        tmp = f"{args.baseline}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.baseline)
+        print(f"check_lint: baseline rewritten with {len(current)} "
+              f"finding(s) → {args.baseline}")
+        return 0
+
+    try:
+        with open(args.baseline, encoding="utf-8") as f:
+            baseline = json.load(f)
+    except OSError as e:
+        print(f"check_lint: baseline unreadable ({e}) — run with --update")
+        return 1
+    known = {_key(f) for f in baseline.get("findings", [])}
+    cur_keys = {_key(f) for f in current}
+
+    new = [f for f in current if _key(f) not in known]
+    fixed = sorted(known - cur_keys)
+    if fixed:
+        print(f"check_lint: {len(fixed)} baselined finding(s) no longer "
+              f"fire — shrink the baseline with --update:")
+        for rule, path, _msg in fixed[:10]:
+            print(f"  fixed: {rule} @ {path}")
+    if new:
+        print(f"check_lint: {len(new)} NEW finding(s) past the baseline:")
+        for f in new:
+            print(f"  {f['path']}:{f['line']}: {f['rule']}: {f['message']}")
+        print("fix them or suppress with a justified "
+              "`# dmlclint: disable=<rule>` (see docs/analysis.md)")
+        return 1
+    print(f"check_lint: ok ({len(current)} finding(s), all baselined; "
+          f"baseline {len(known)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

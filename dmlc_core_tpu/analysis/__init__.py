@@ -1,0 +1,28 @@
+"""Repo-native static analysis — machine-checked project invariants.
+
+The reference dmlc-core leaned on the C++ toolchain to enforce its
+vocabularies (registries resolved at link time, parameters typed at
+compile time, ``DMLC_*`` macros spelled once).  The Python port carries
+the same vocabularies — ``DMLC_*`` env knobs, ``subsystem.name`` metric
+names, lock-guarded registries, tmp-then-rename persistence — with
+nothing enforcing them, and PRs 2–7 each paid for that in satellite
+fixes (torn snapshot reads, tuned-file clobbers, env parses raising in
+worker threads).  This package is the enforcement:
+
+* :mod:`dmlc_core_tpu.analysis.core` — the lint framework: rule
+  registry (a ``utils.registry.Registry``), AST module parsing,
+  per-line/per-file suppression comments, JSON + human output.
+* ``rules_*`` modules — six project-specific rules, each grounded in a
+  real past bug (see ``docs/analysis.md`` for the rule ↔ bug table).
+* :mod:`dmlc_core_tpu.analysis.inventory` — the generated knob/metric
+  inventory that keeps code and ``docs/*.md`` tables from drifting.
+* CLI gate: ``python -m dmlc_core_tpu.analysis.lint dmlc_core_tpu/``.
+
+The runtime companion (lock-order inversion detection under real
+threads) lives in :mod:`dmlc_core_tpu.utils.lockcheck`.
+"""
+
+from .core import Finding, LintContext, LintRule, lint_paths, lint_registry
+
+__all__ = ["Finding", "LintContext", "LintRule", "lint_paths",
+           "lint_registry"]

@@ -1,0 +1,309 @@
+"""dmlclint framework: rule registry, parsed modules, suppressions, runner.
+
+Design mirrors the repo's other pluggable subsystems: rules live in the
+process-global :class:`~dmlc_core_tpu.utils.registry.Registry` under the
+``LintRule`` type, so adding a rule is the same gesture as adding a
+parser or a model::
+
+    @lint_rule("my-rule", description="what it enforces")
+    class MyRule(LintRule):
+        def check_module(self, mod, ctx): ...
+
+A rule sees one :class:`ParsedModule` at a time (``check_module``) and
+may also emit project-level findings once every module has been visited
+(``finalize`` — where cross-file checks like doc-table drift live).
+
+Suppressions are source comments, checked *after* rules run so the
+suppressed count is reportable::
+
+    os.environ["DMLC_X"]            # dmlclint: disable=env-discipline — why
+    # dmlclint: disable-next-line=atomic-write — scratch file, not an artifact
+    open(p, "w")
+    # dmlclint: disable-file=env-discipline — bootstrap module, see docstring
+
+Every suppression should carry a justification after the rule list; the
+linter does not parse it, reviewers do.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..utils.registry import Registry
+
+__all__ = ["Finding", "ParsedModule", "LintContext", "LintRule",
+           "lint_registry", "lint_rule", "lint_paths", "iter_py_files",
+           "render_human", "render_json"]
+
+#: rule-name → rule-class registry (shared Registry machinery)
+lint_registry = Registry.get("LintRule")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dmlclint:\s*(disable|disable-next-line|disable-file)"
+    r"\s*=\s*([a-z0-9_,\-]+)")
+
+
+class Finding:
+    """One violation: where, which rule, and what to do about it."""
+
+    __slots__ = ("rule", "path", "line", "col", "message")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str) -> None:
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def __repr__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+class ParsedModule:
+    """One source file: text, lines, AST, and parsed suppressions."""
+
+    def __init__(self, path: str, rel: str, source: str) -> None:
+        self.path = path          # absolute
+        self.rel = rel            # repo-root-relative (what findings show)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line → set of rule names disabled on that line; "*" = all
+        self.line_disabled: Dict[int, Set[str]] = {}
+        self.file_disabled: Set[str] = set()
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        for i, text in enumerate(self.lines, start=1):
+            if "dmlclint" not in text:
+                continue
+            for m in _SUPPRESS_RE.finditer(text):
+                kind, rules = m.group(1), m.group(2)
+                names = {r.strip() for r in rules.split(",") if r.strip()}
+                if kind == "disable-file":
+                    self.file_disabled |= names
+                elif kind == "disable-next-line":
+                    self.line_disabled.setdefault(i + 1, set()).update(names)
+                else:
+                    self.line_disabled.setdefault(i, set()).update(names)
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_disabled or "all" in self.file_disabled:
+            return True
+        names = self.line_disabled.get(finding.line)
+        return bool(names) and (finding.rule in names or "all" in names)
+
+
+class LintContext:
+    """Shared run state: repo layout + cross-file data rules accumulate.
+
+    ``knob_sites`` / ``metric_sites`` are populated by the env/metric
+    rules during ``check_module`` and consumed both by their
+    ``finalize`` doc cross-checks and by the inventory generator.
+    """
+
+    def __init__(self, repo_root: str, docs_dir: Optional[str] = None,
+                 inventory_path: Optional[str] = None) -> None:
+        self.repo_root = repo_root
+        self.docs_dir = docs_dir or os.path.join(repo_root, "docs")
+        self.inventory_path = inventory_path or os.path.join(
+            self.docs_dir, "inventory.json")
+        #: knob name → sorted set of repo-relative files referencing it
+        self.knob_sites: Dict[str, Set[str]] = {}
+        #: literal metric name → sorted set of repo-relative files
+        self.metric_sites: Dict[str, Set[str]] = {}
+        #: modules visited this run (rel paths) — finalize-time scoping
+        self.modules: List[str] = []
+        #: True when a whole directory was linted — cross-file checks
+        #: (inventory/doc drift) only make sense then, not on one file
+        self.full_run = False
+
+    def note_knob(self, name: str, rel: str) -> None:
+        self.knob_sites.setdefault(name, set()).add(rel)
+
+    def note_metric(self, name: str, rel: str) -> None:
+        self.metric_sites.setdefault(name, set()).add(rel)
+
+
+class LintRule:
+    """Base rule.  Subclasses set ``name`` (injected at registration)."""
+
+    name = "<unregistered>"
+    description = ""
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        return []
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        """Project-level findings after every module was visited."""
+        return []
+
+
+def lint_rule(name: str, description: str = ""):
+    """Register a :class:`LintRule` subclass under ``name``."""
+
+    def deco(cls):
+        cls.name = name
+        if description:
+            cls.description = description
+        lint_registry.register(name, description=description,
+                               allow_override=True)(cls)
+        return cls
+
+    return deco
+
+
+def _load_builtin_rules() -> None:
+    # import for registration side effects; idempotent via the registry
+    from . import (rules_env, rules_io, rules_jit,  # noqa: F401
+                   rules_locks, rules_metrics, rules_threads)
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    """Expand files/dirs into .py files (skips caches and hidden dirs)."""
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def _guess_repo_root(first_path: str) -> str:
+    """Walk up from the linted path to the checkout root (has docs/)."""
+    d = os.path.abspath(first_path)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    for _ in range(8):
+        if os.path.isdir(os.path.join(d, "docs")) or \
+                os.path.isfile(os.path.join(d, "pyproject.toml")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return os.path.abspath(os.path.curdir)
+
+
+def lint_paths(paths: Sequence[str], *,
+               rules: Optional[Sequence[str]] = None,
+               repo_root: Optional[str] = None,
+               inventory_path: Optional[str] = None,
+               ) -> Tuple[List[Finding], Dict[str, Any], LintContext]:
+    """Run the (selected) rules over ``paths``.
+
+    Returns ``(findings, stats, ctx)`` with suppressions already
+    filtered out; ``stats['suppressed']`` counts what they hid.
+    """
+    _load_builtin_rules()
+    root = os.path.abspath(repo_root or _guess_repo_root(paths[0]))
+    ctx = LintContext(root, inventory_path=inventory_path)
+    ctx.full_run = any(os.path.isdir(p) for p in paths)
+    names = list(rules) if rules else lint_registry.list_names()
+    instances = [lint_registry[n].body() for n in names]
+
+    findings: List[Finding] = []
+    stats: Dict[str, Any] = {"files": 0, "suppressed": 0, "parse_errors": 0}
+    for fp in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(fp), root)
+        try:
+            with open(fp, encoding="utf-8") as f:
+                mod = ParsedModule(os.path.abspath(fp), rel, f.read())
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            stats["parse_errors"] += 1
+            findings.append(Finding("parse-error", rel, getattr(
+                e, "lineno", 0) or 0, 0, f"cannot lint: {e}"))
+            continue
+        stats["files"] += 1
+        ctx.modules.append(rel)
+        for rule in instances:
+            for f_ in rule.check_module(mod, ctx):
+                if mod.suppressed(f_):
+                    stats["suppressed"] += 1
+                else:
+                    findings.append(f_)
+    for rule in instances:
+        findings.extend(rule.finalize(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    counts: Dict[str, int] = {}
+    for f_ in findings:
+        counts[f_.rule] = counts.get(f_.rule, 0) + 1
+    stats["by_rule"] = counts
+    stats["total"] = len(findings)
+    return findings, stats, ctx
+
+
+def render_human(findings: List[Finding], stats: Dict[str, Any]) -> str:
+    out = [repr(f) for f in findings]
+    by_rule = " ".join(f"{k}={v}" for k, v in sorted(
+        stats.get("by_rule", {}).items()))
+    out.append(f"dmlclint: {stats.get('total', 0)} finding(s) in "
+               f"{stats.get('files', 0)} file(s)"
+               + (f" [{by_rule}]" if by_rule else "")
+               + (f", {stats['suppressed']} suppressed"
+                  if stats.get("suppressed") else ""))
+    return "\n".join(out)
+
+
+def render_json(findings: List[Finding], stats: Dict[str, Any]) -> str:
+    return json.dumps({"schema": "dmlc.lint.report/1",
+                       "findings": [f.to_dict() for f in findings],
+                       "stats": stats}, indent=2, sort_keys=True)
+
+
+# -- shared AST helpers used by several rules ------------------------------
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: ``os.environ.get`` / ``open`` / ''."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_const(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings (env-key indirection)."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            v = str_const(stmt.value)
+            if v is not None:
+                out[stmt.targets[0].id] = v
+    return out
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents

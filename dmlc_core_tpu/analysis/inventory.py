@@ -1,0 +1,52 @@
+"""Generated knob/metric inventory — the reviewable contract file.
+
+``docs/inventory.json`` is generated from the lint run's collected
+vocabulary (every ``DMLC_*`` env key reaching an env-read call, every
+literal metric name) and committed, so a PR that adds or retires a knob
+shows the change as a reviewable diff — the same shape as the
+``BENCH_*.json`` trajectory that ``check_regression.py`` gates.
+
+``env-discipline``'s finalize pass fails the lint when code and
+inventory disagree, which forces the regeneration (and therefore the
+diff) to ride the PR that caused it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+from .core import LintContext
+
+SCHEMA = "dmlc.lint.inventory/1"
+
+__all__ = ["SCHEMA", "build", "write", "load"]
+
+
+def build(ctx: LintContext) -> Dict[str, Any]:
+    """Inventory payload from a finished lint run (file sets only — no
+    line numbers, so unrelated edits never churn the diff)."""
+    return {
+        "schema": SCHEMA,
+        "knobs": {k: sorted(v) for k, v in sorted(ctx.knob_sites.items())},
+        "metrics": {k: sorted(v)
+                    for k, v in sorted(ctx.metric_sites.items())},
+    }
+
+
+def write(ctx: LintContext, path: str = "") -> str:
+    """Write the inventory atomically (practice what atomic-write
+    preaches); returns the path written."""
+    path = path or ctx.inventory_path
+    payload = json.dumps(build(ctx), indent=1, sort_keys=True) + "\n"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
+def load(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)

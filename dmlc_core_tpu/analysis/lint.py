@@ -1,0 +1,74 @@
+"""dmlclint CLI: ``python -m dmlc_core_tpu.analysis.lint [paths]``.
+
+Exit status 0 when the tree is clean (after suppressions), 1 when any
+finding stands — wire it wherever tests run.  ``--json`` emits the
+machine-readable report ``benchmarks/check_lint.py`` consumes;
+``--write-inventory`` regenerates ``docs/inventory.json`` from the
+current tree (commit the diff with the change that caused it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import inventory as inv
+from .core import lint_paths, lint_registry, render_human, render_json
+
+
+def _default_paths() -> List[str]:
+    """With no args, lint the package this module lives in."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dmlc_core_tpu.analysis.lint",
+        description="AST invariant checker for the dmlc_core_tpu tree")
+    p.add_argument("paths", nargs="*", help="files/dirs to lint "
+                   "(default: the dmlc_core_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--rules", default="",
+                   help="comma-separated subset of rules to run")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print registered rules and exit")
+    p.add_argument("--write-inventory", action="store_true",
+                   help="regenerate the knob/metric inventory from this "
+                        "run and exit (0 even if findings exist)")
+    p.add_argument("--inventory", default="",
+                   help="inventory path (default: <repo>/docs/inventory.json)")
+    p.add_argument("--repo-root", default="",
+                   help="override repo root autodetection")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        from .core import _load_builtin_rules
+        _load_builtin_rules()
+        for name in lint_registry.list_names():
+            entry = lint_registry[name]
+            print(f"{name:18s} {entry.description}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    findings, stats, ctx = lint_paths(
+        paths, rules=rules,
+        repo_root=args.repo_root or None,
+        inventory_path=args.inventory or None)
+
+    if args.write_inventory:
+        path = inv.write(ctx)
+        print(f"wrote {path}: {len(ctx.knob_sites)} knobs, "
+              f"{len(ctx.metric_sites)} metrics")
+        return 0
+
+    print(render_json(findings, stats) if args.as_json
+          else render_human(findings, stats))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+"""env-discipline: ``DMLC_*`` knobs go through ``utils.parameter`` helpers.
+
+Motivating bug (PR 7 satellite): malformed ``DMLC_NUM_THREADS=8x`` /
+``DMLC_PAGE_CACHE_QUEUE=8x`` raised ``ValueError`` inside the first
+worker thread that read them — killing a loader instead of degrading a
+knob.  ``utils.parameter.env_int`` / ``parse_lenient_bool`` exist so a
+typo'd knob warns once and falls back; this rule makes bypassing them
+(raw ``os.environ[...]`` / ``os.getenv`` on a ``DMLC_*`` key) an error
+everywhere outside ``utils/parameter.py`` itself.
+
+The rule also accumulates the **knob inventory**: every ``DMLC_*`` key
+that reaches an env-read call (directly or through a module-level
+constant) is recorded, then cross-checked in ``finalize`` against the
+committed ``docs/inventory.json`` and the doc tables under ``docs/`` —
+a knob referenced in code but absent from the docs is silent drift and
+fails the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Set
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, call_name,
+                   dotted, lint_rule, module_str_constants, str_const)
+
+#: direct env-read call targets that bypass the lenient helpers
+_RAW_READS = {"os.environ.get", "os.getenv", "os.environ.pop",
+              "os.environ.setdefault"}
+#: sanctioned helpers (all live in utils/parameter.py)
+_HELPER_READS = {"get_env", "env_int", "parse_lenient_bool"}
+
+_EXEMPT_SUFFIX = os.path.join("utils", "parameter.py")
+
+
+def _env_key(node: Optional[ast.AST], consts: Dict[str, str]
+             ) -> Optional[str]:
+    """Resolve a call's key argument: literal or module-level constant."""
+    s = str_const(node)
+    if s is not None:
+        return s
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+@lint_rule("env-discipline",
+           description="DMLC_* env reads must use utils.parameter helpers; "
+                       "every knob must be in the inventory and docs")
+class EnvDisciplineRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        consts = module_str_constants(mod.tree)
+        exempt = mod.rel.endswith(_EXEMPT_SUFFIX)
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            # raw subscript read: os.environ["DMLC_X"] (loads only; writes
+            # — launchers assembling worker envs — are legitimate)
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load):
+                if dotted(node.value) == "os.environ":
+                    key = _env_key(node.slice, consts)
+                    if key and key.startswith("DMLC_"):
+                        ctx.note_knob(key, mod.rel)
+                        if not exempt:
+                            out.append(Finding(
+                                self.name, mod.rel, node.lineno,
+                                node.col_offset,
+                                f"raw os.environ[{key!r}] read — use "
+                                f"utils.parameter.get_env/env_int/"
+                                f"parse_lenient_bool so malformed values "
+                                f"warn instead of raise"))
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            key = _env_key(node.args[0], consts) if node.args else None
+            if name in _RAW_READS:
+                if key and key.startswith("DMLC_"):
+                    ctx.note_knob(key, mod.rel)
+                    if not exempt:
+                        out.append(Finding(
+                            self.name, mod.rel, node.lineno, node.col_offset,
+                            f"raw {name}({key!r}) — use utils.parameter."
+                            f"get_env/env_int/parse_lenient_bool so "
+                            f"malformed values warn instead of raise"))
+            elif name.split(".")[-1] in _HELPER_READS:
+                if key and key.startswith("DMLC_"):
+                    ctx.note_knob(key, mod.rel)
+        return out
+
+    # -- project-level: inventory + doc-table cross-check -----------------
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not getattr(ctx, "full_run", False):
+            return []
+        out: List[Finding] = []
+        inv_rel = os.path.relpath(ctx.inventory_path, ctx.repo_root)
+        try:
+            with open(ctx.inventory_path, encoding="utf-8") as f:
+                inv = json.load(f)
+            known = set(inv.get("knobs", {}))
+        except (OSError, ValueError):
+            out.append(Finding(
+                self.name, inv_rel, 0, 0,
+                "knob inventory missing/unreadable — regenerate with "
+                "`python -m dmlc_core_tpu.analysis.lint --write-inventory`"))
+            known = None
+        seen = set(ctx.knob_sites)
+        if known is not None:
+            for k in sorted(seen - known):
+                out.append(Finding(
+                    self.name, inv_rel, 0, 0,
+                    f"knob {k} referenced in code but missing from the "
+                    f"inventory — regenerate with --write-inventory"))
+            for k in sorted(known - seen):
+                out.append(Finding(
+                    self.name, inv_rel, 0, 0,
+                    f"stale inventory entry {k}: no code references it — "
+                    f"regenerate with --write-inventory"))
+        docs = _docs_corpus(ctx)
+        for k in sorted(seen):
+            if k not in docs:
+                out.append(Finding(
+                    self.name, "docs/", 0, 0,
+                    f"knob {k} is undocumented — add a row to a knob table "
+                    f"in docs/*.md (see docs/analysis.md)"))
+        return out
+
+
+_corpus_cache: Dict[str, str] = {}
+
+
+def _docs_corpus(ctx: LintContext) -> str:
+    """Concatenated docs/*.md text (cached per docs dir)."""
+    cached = _corpus_cache.get(ctx.docs_dir)
+    if cached is not None:
+        return cached
+    parts: List[str] = []
+    for p in sorted(glob.glob(os.path.join(ctx.docs_dir, "*.md"))):
+        try:
+            with open(p, encoding="utf-8") as f:
+                parts.append(f.read())
+        except OSError:
+            pass
+    text = "\n".join(parts)
+    _corpus_cache[ctx.docs_dir] = text
+    return text
+
+
+def knob_inventory(ctx: LintContext) -> Dict[str, List[str]]:
+    """Inventory payload: knob → sorted repo-relative referencing files."""
+    return {k: sorted(v) for k, v in sorted(ctx.knob_sites.items())}

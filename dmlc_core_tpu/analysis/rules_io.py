@@ -1,0 +1,94 @@
+"""atomic-write: persistent artifacts land via tmp-file + ``os.replace``.
+
+Motivating bugs: the ``tuned.py`` concurrent-writer clobber (PR 7
+satellite — two probes truncating each other's half-written JSON) and
+the PR 4 page-cache/chunk-log crash-safety work, which retrofitted the
+``.tmp.<pid>`` + atomic-rename idiom after torn files were observed.  A
+reader must only ever see a complete old file or a complete new file;
+``open(path, "w")`` straight onto the artifact gives a window where a
+crash (or a concurrent reader) sees a truncated one.
+
+Heuristic, tuned for this codebase's idiom:
+
+* flagged: builtin ``open(target, "w"/"wb"/"w+")`` where the target
+  expression does not mention ``tmp`` and the enclosing function never
+  calls ``os.replace``/``os.rename``;
+* clean: writing to an explicit temp name (``tmp``, ``_tmp_file``,
+  ``tmp_hash``...), or any function that finishes with a rename —
+  exactly the ``page_cache.py``/``tuned.py`` shape.
+
+Scratch/debug dumps that genuinely don't need durability carry a
+``# dmlclint: disable=atomic-write`` with the justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, call_name,
+                   lint_rule, parent_map, str_const)
+
+_WRITE_MODES = {"w", "wb", "w+", "wb+", "wt", "w+b"}
+_RENAMES = {"os.replace", "os.rename", "os.renames", "shutil.move"}
+
+
+def _enclosing_function(parents: Dict[ast.AST, ast.AST], node: ast.AST
+                        ) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _scope_renames(scope: ast.AST) -> bool:
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call):
+            name = call_name(n)
+            if name in _RENAMES or name.split(".")[-1] in ("replace",
+                                                           "rename"):
+                return True
+    return False
+
+
+@lint_rule("atomic-write",
+           description="persistent artifacts must use tmp + os.replace "
+                       "(crash-safe, clobber-safe)")
+class AtomicWriteRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        out: List[Finding] = []
+        parents = None
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) == "open" and node.args):
+                continue
+            mode = None
+            if len(node.args) >= 2:
+                mode = str_const(node.args[1])
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = str_const(kw.value)
+            if mode not in _WRITE_MODES:
+                continue
+            try:
+                target_src = ast.unparse(node.args[0])
+            except Exception:
+                target_src = ""
+            if "tmp" in target_src.lower():
+                continue
+            if parents is None:
+                parents = parent_map(mod.tree)
+            scope = _enclosing_function(parents, node) or mod.tree
+            if _scope_renames(scope):
+                continue
+            out.append(Finding(
+                self.name, mod.rel, node.lineno, node.col_offset,
+                f"open({target_src}, {mode!r}) writes the artifact in "
+                f"place — write a tmp sibling and os.replace() it (the "
+                f"page_cache.py/tuned.py idiom), or suppress if this is "
+                f"genuinely scratch output"))
+        return out

@@ -1,0 +1,181 @@
+"""retrace-hazard: no Python control flow on traced values in jit code.
+
+Motivating bug (PR 5/PR 6): the serving engine's no-retrace ladder and
+the retrace watchdog exist because an innocuous ``if n > 0:`` or
+``int(x)`` on a traced value inside a jitted function either fails at
+trace time (``TracerBoolConversionError``) or — worse — silently bakes
+the value into the compiled program and recompiles on every new value.
+The watchdog catches the recompiles at runtime; this rule is its static
+companion: it catches them in review.
+
+Detection: a function is *jit-reachable* when it is decorated with
+``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` or passed by name
+to ``jax.jit(...)`` anywhere in the module.  Within such a function,
+parameters not named in ``static_argnames``/``static_argnums`` are
+assumed traced, and the rule flags:
+
+* ``int(p)`` / ``float(p)`` / ``bool(p)`` on a traced parameter,
+* ``p.item()`` on a traced parameter,
+* ``if``/``while`` tests referencing a traced parameter directly
+  (``p.shape``/``p.ndim``/``p.dtype``/``p.size``/``len(p)`` are static
+  at trace time and stay allowed).
+
+This is a heuristic: values derived from traced params through local
+bindings are not tracked (too noisy).  The runtime watchdog remains the
+backstop; this rule exists to stop the obvious cases before they ship.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, call_name,
+                   dotted, lint_rule, str_const)
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+_CASTS = {"int", "float", "bool"}
+
+
+def _static_names_from_call(call: ast.Call) -> Tuple[Set[str], Set[int]]:
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                s = str_const(n)
+                if s:
+                    names.add(s)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+    return names, nums
+
+
+def _jit_info(deco_or_call: ast.AST) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) when the node means jax.jit."""
+    if isinstance(deco_or_call, (ast.Name, ast.Attribute)):
+        if dotted(deco_or_call) in ("jit", "jax.jit"):
+            return set(), set()
+        return None
+    if isinstance(deco_or_call, ast.Call):
+        name = call_name(deco_or_call)
+        if name in ("jit", "jax.jit"):
+            return _static_names_from_call(deco_or_call)
+        if name.split(".")[-1] == "partial" and deco_or_call.args:
+            first = deco_or_call.args[0]
+            if isinstance(first, (ast.Name, ast.Attribute)) and \
+                    _jit_info(first) is not None:
+                return _static_names_from_call(deco_or_call)
+    return None
+
+
+class _HazardScan(ast.NodeVisitor):
+    def __init__(self, rule: str, rel: str, traced: Set[str]) -> None:
+        self.rule = rule
+        self.rel = rel
+        self.traced = traced
+        self.out: List[Finding] = []
+
+    def _names_in_test(self, test: ast.AST) -> List[ast.Name]:
+        """Traced param Names in a test, minus static-at-trace contexts."""
+        parents: Dict[ast.AST, ast.AST] = {}
+        for n in ast.walk(test):
+            for c in ast.iter_child_nodes(n):
+                parents[c] = n
+        hits = []
+        for n in ast.walk(test):
+            if not (isinstance(n, ast.Name) and n.id in self.traced):
+                continue
+            p = parents.get(n)
+            if isinstance(p, ast.Attribute) and p.attr in _STATIC_ATTRS:
+                continue
+            if isinstance(p, ast.Call) and p.func is not n \
+                    and call_name(p) == "len":
+                continue
+            # `x is None` / `x is not None`: an Optional default check,
+            # resolved at trace time — not a value branch
+            if isinstance(p, ast.Compare) and len(p.ops) == 1 \
+                    and isinstance(p.ops[0], (ast.Is, ast.IsNot)):
+                continue
+            hits.append(n)
+        return hits
+
+    def visit_If(self, node: ast.If) -> None:
+        for n in self._names_in_test(node.test):
+            self.out.append(Finding(
+                self.rule, self.rel, node.lineno, node.col_offset,
+                f"`if` on traced value {n.id!r} inside a jitted function — "
+                f"use jnp.where/lax.cond, or mark the arg static"))
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        for n in self._names_in_test(node.test):
+            self.out.append(Finding(
+                self.rule, self.rel, node.lineno, node.col_offset,
+                f"`while` on traced value {n.id!r} inside a jitted "
+                f"function — use lax.while_loop, or mark the arg static"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name in _CASTS and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in self.traced:
+            self.out.append(Finding(
+                self.rule, self.rel, node.lineno, node.col_offset,
+                f"{name}() on traced value {node.args[0].id!r} inside a "
+                f"jitted function — concretizes the tracer (error or "
+                f"silent retrace per value)"))
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in self.traced:
+            self.out.append(Finding(
+                self.rule, self.rel, node.lineno, node.col_offset,
+                f".item() on traced value {node.func.value.id!r} inside a "
+                f"jitted function — device sync + concretization"))
+        self.generic_visit(node)
+
+
+@lint_rule("retrace-hazard",
+           description="Python if/int()/.item() on traced values inside "
+                       "jit-reachable functions")
+class RetraceHazardRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        # pass 1: functions passed to jax.jit by name, with static info
+        jitted_by_name: Dict[str, Tuple[Set[str], Set[int]]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) in ("jit", "jax.jit") \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                jitted_by_name[node.args[0].id] = \
+                    _static_names_from_call(node)
+        out: List[Finding] = []
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            info = None
+            for deco in fn.decorator_list:
+                info = _jit_info(deco)
+                if info is not None:
+                    break
+            if info is None:
+                info = jitted_by_name.get(fn.name)
+            if info is None:
+                continue
+            static_names, static_nums = info
+            params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)]
+            traced = {p for i, p in enumerate(params)
+                      if p not in static_names and i not in static_nums
+                      and p not in ("self", "cls")}
+            if not traced:
+                continue
+            scan = _HazardScan(self.name, mod.rel, traced)
+            for stmt in fn.body:
+                scan.visit(stmt)
+            out.extend(scan.out)
+        return out

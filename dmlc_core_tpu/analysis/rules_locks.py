@@ -1,0 +1,177 @@
+"""lock-discipline: no attribute mutated both under and outside its lock.
+
+Motivating bug (PR 3 satellite): ``Histogram.snapshot()`` originally
+read count/sum/samples in separate lock acquisitions — a concurrent
+``observe()`` between them produced snapshots whose count was ahead of
+their sum (the torn read).  The same shape recurred in ``tuned.py``
+(concurrent writers clobbering the file because the read-modify-write
+wasn't serialized).  The static signal for this class of bug: a class
+guards some mutations of attribute ``X`` with ``with self._lock:`` but
+also mutates ``X`` on a path without the lock — either the guarded
+sites are pointless or the unguarded one is a race.
+
+Scope rules keeping the signal clean:
+
+* ``__init__``/``__new__`` are exempt (construction is single-threaded
+  by convention);
+* methods named ``_*_locked`` are treated as lock-held context (the
+  repo's convention for must-hold-lock helpers, e.g.
+  ``ThroughputMeter._rate_locked``);
+* mutation = assignment / augmented assignment to ``self.X`` (or
+  ``cls.X``) or calling a known mutating method on it
+  (``append``/``pop``/``update``/``clear``/...).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, call_name,
+                   lint_rule)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Spinlock"}
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "remove", "discard", "clear", "update",
+             "add", "setdefault", "push", "sort", "reverse"}
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes bound to Lock()/RLock()/Condition()/Spinlock() anywhere
+    in the class (instance attrs in any method, or class attrs)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call)
+                and call_name(v).split(".")[-1] in _LOCK_FACTORIES):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and _is_self_or_cls(t.value):
+                out.add(t.attr)
+            elif isinstance(t, ast.Name):   # class-level attribute
+                out.add(t.id)
+    return out
+
+
+def _is_self_or_cls(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id in ("self", "cls")
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Walk one method, tracking with-lock depth; collect mutations."""
+
+    def __init__(self, lock_attrs: Set[str], assume_locked: bool) -> None:
+        self.lock_attrs = lock_attrs
+        self.depth = 1 if assume_locked else 0
+        # attr → list of (lineno, col, guarded)
+        self.mutations: List[Tuple[str, int, int, bool]] = []
+
+    def _is_lock_ctx(self, expr: ast.AST) -> bool:
+        # with self._lock: / with self._cv: / with cls._global_lock:
+        if isinstance(expr, ast.Attribute) and _is_self_or_cls(expr.value):
+            return expr.attr in self.lock_attrs
+        # with self._lock.acquire_timeout(...) style helpers
+        if isinstance(expr, ast.Call):
+            return self._is_lock_ctx(expr.func) or any(
+                self._is_lock_ctx(a) for a in expr.args)
+        return False
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(self._is_lock_ctx(item.context_expr)
+                     for item in node.items)
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    def _mutate(self, attr: str, node: ast.AST) -> None:
+        if attr in self.lock_attrs:
+            return                      # rebinding the lock itself
+        self.mutations.append((attr, node.lineno, node.col_offset,
+                               self.depth > 0))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._target(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._target(node.target, node)
+        self.generic_visit(node)
+
+    def _target(self, t: ast.AST, node: ast.AST) -> None:
+        if isinstance(t, ast.Tuple):
+            for el in t.elts:
+                self._target(el, node)
+        elif isinstance(t, ast.Attribute) and _is_self_or_cls(t.value):
+            self._mutate(t.attr, node)
+        elif isinstance(t, ast.Subscript):
+            # self.X[k] = v mutates container X
+            inner = t.value
+            if isinstance(inner, ast.Attribute) \
+                    and _is_self_or_cls(inner.value):
+                self._mutate(inner.attr, node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            obj = f.value
+            if isinstance(obj, ast.Attribute) and _is_self_or_cls(obj.value):
+                self._mutate(obj.attr, node)
+        self.generic_visit(node)
+
+    # nested defs get their own scan via the class walker — do not
+    # descend (their lock context is the call site's, unknowable here)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+
+@lint_rule("lock-discipline",
+           description="attribute mutated both under and outside its "
+                       "class lock (torn-write/torn-read risk)")
+class LockDisciplineRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_attrs(cls)
+            if not locks:
+                continue
+            guarded: Dict[str, List[Tuple[int, int]]] = {}
+            unguarded: Dict[str, List[Tuple[int, int]]] = {}
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name in _EXEMPT_METHODS:
+                    continue
+                assume = meth.name.endswith("_locked")
+                scan = _MethodScan(locks, assume)
+                for stmt in meth.body:
+                    scan.visit(stmt)
+                for attr, line, col, is_guarded in scan.mutations:
+                    (guarded if is_guarded else unguarded).setdefault(
+                        attr, []).append((line, col))
+            for attr in sorted(set(guarded) & set(unguarded)):
+                for line, col in unguarded[attr]:
+                    out.append(Finding(
+                        self.name, mod.rel, line, col,
+                        f"{cls.name}.{attr} is mutated here without the "
+                        f"lock but under it at line"
+                        f"{'s' if len(guarded[attr]) > 1 else ''} "
+                        f"{', '.join(str(ln) for ln, _ in guarded[attr])}"
+                        f" — move this mutation under the lock"))
+        return out

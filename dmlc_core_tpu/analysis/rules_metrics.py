@@ -1,0 +1,151 @@
+"""metric-vocabulary: metric names follow the grammar and match the docs.
+
+Motivating bug class: the metric tables in ``docs/observability.md``
+are the operator's contract — dashboards, ``DMLC_SLO_SPEC`` rules and
+``check_regression.py`` keys are written against them — yet nothing
+stopped a PR from adding ``serving.engine.padding_ratio`` (PR 6) or
+``pipeline.pack.truncated_rows`` without a doc row, or from deleting a
+metric a documented SLO still referenced.  This rule checks both
+directions:
+
+* every **literal** name passed to ``counter()``/``gauge()``/
+  ``histogram()``/``throughput()``/``stage()`` must match the
+  ``subsystem.name`` grammar (lowercase dotted, ≥ 2 segments);
+* every such name must be covered by a row in the metric tables of
+  ``docs/observability.md`` (rows may group with ``{a,b}`` braces and
+  use ``<wildcard>`` segments);
+* every non-wildcard documented name must still exist in code (stale
+  doc rows fail too).
+
+Dynamically-built names (f-strings: ``retry.<name>.retries``,
+``anomaly.stalls.<stage>``) are skipped per-site; their families are
+documented with wildcard rows which the reverse check exempts.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import os
+import re
+from typing import Dict, List, Pattern, Set, Tuple
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, lint_rule,
+                   str_const)
+
+_METRIC_METHODS = {"counter", "gauge", "histogram", "throughput", "stage"}
+_GRAMMAR = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+#: doc-table token: looks like a (possibly braced/wildcarded) metric name
+_DOC_TOKEN = re.compile(r"`([a-z][a-z0-9_{}<>,./]*)`")
+_BRACE = re.compile(r"\{([^{}]*)\}")
+
+
+@lint_rule("metric-vocabulary",
+           description="metric names follow subsystem.name grammar and are "
+                       "documented in docs/observability.md (both ways)")
+class MetricVocabularyRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS):
+                continue
+            name = str_const(node.args[0]) if node.args else None
+            if name is None:        # dynamic name — wildcard family
+                continue
+            ctx.note_metric(name, mod.rel)
+            if not _GRAMMAR.match(name):
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno, node.col_offset,
+                    f"metric name {name!r} violates the subsystem.name "
+                    f"grammar (lowercase dotted, >= 2 segments)"))
+        return out
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not getattr(ctx, "full_run", False):
+            return []
+        doc_path = os.path.join(ctx.docs_dir, "observability.md")
+        rel = os.path.relpath(doc_path, ctx.repo_root)
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc = f.read()
+        except OSError:
+            return [Finding(self.name, rel, 0, 0,
+                            "docs/observability.md unreadable — the metric "
+                            "vocabulary has no contract to check against")]
+        literals, patterns = _doc_metric_vocabulary(doc)
+        code_names = set(ctx.metric_sites)
+        out: List[Finding] = []
+        for name in sorted(code_names):
+            if name in literals or any(p.match(name) for p in patterns):
+                continue
+            sites = ", ".join(sorted(ctx.metric_sites[name])[:3])
+            out.append(Finding(
+                self.name, rel, 0, 0,
+                f"metric {name!r} ({sites}) has no row in the "
+                f"docs/observability.md metric tables — document it"))
+        for name in sorted(literals):
+            if name not in code_names:
+                out.append(Finding(
+                    self.name, rel, 0, 0,
+                    f"documented metric {name!r} no longer exists in code — "
+                    f"delete the stale doc row (or restore the metric)"))
+        return out
+
+
+def _expand_braces(token: str) -> List[str]:
+    """``a.{b,c}.d`` → [a.b.d, a.c.d] (multiple groups multiply out)."""
+    groups: List[List[str]] = []
+    template = _BRACE.sub(lambda m: "\0", token)
+    for m in _BRACE.finditer(token):
+        groups.append([alt.strip() for alt in m.group(1).split(",")])
+    if not groups:
+        return [token]
+    out = []
+    for combo in itertools.product(*groups):
+        s, it = template, iter(combo)
+        while "\0" in s:
+            s = s.replace("\0", next(it), 1)
+        out.append(s)
+    return out
+
+
+def _doc_metric_vocabulary(doc: str) -> Tuple[Set[str], List[Pattern[str]]]:
+    """Parse metric-table rows into (literal names, wildcard patterns).
+
+    A row counts when it sits in a markdown table whose header has a
+    ``Type`` column (the metric tables' signature — other tables, like
+    the flight-recorder file list, must not leak into the vocabulary)
+    and its first cell carries backticked tokens that look like metric
+    names (lowercase, at least one dot after brace expansion).
+    """
+    literals: Set[str] = set()
+    patterns: List[Pattern[str]] = []
+    in_metric_table = False
+    for line in doc.splitlines():
+        if not line.lstrip().startswith("|"):
+            in_metric_table = False
+            continue
+        cells = line.split("|")
+        if any(c.strip() == "Type" for c in cells):
+            in_metric_table = True
+            continue
+        if not in_metric_table or len(cells) < 3:
+            continue
+        first = cells[1]
+        for m in _DOC_TOKEN.finditer(first):
+            for name in _expand_braces(m.group(1)):
+                if "." not in name:
+                    continue
+                if "<" in name:
+                    # re.escape leaves <> alone; swap each <wildcard> for a
+                    # permissive segment matcher
+                    rx = "^" + re.sub(r"<[^<>]*>", r"[a-z0-9_.]+",
+                                      re.escape(name)) + "$"
+                    patterns.append(re.compile(rx))
+                elif _GRAMMAR.match(name):
+                    literals.add(name)
+    return literals, patterns

@@ -1,0 +1,111 @@
+"""thread-hygiene: threads must be daemons or have a join path; no bare
+``except:`` swallowing.
+
+Motivating bugs: the PR 2 elastic-teardown work (zombie threads keeping
+dead meshes alive because nothing joined them) and the rabit
+pre-registration race, where a worker thread died silently inside a
+broad handler and the tracker waited forever.  Two checks:
+
+* **non-daemon thread without a join**: ``threading.Thread(...)``
+  without ``daemon=True`` is only acceptable when the module visibly
+  joins it — the created object (or the name it is stored under) must
+  have a ``.join(`` call somewhere in the same module, or have
+  ``.daemon = True`` assigned before ``start()``.  A fire-and-forget
+  non-daemon thread blocks interpreter shutdown forever.
+* **bare except**: ``except:`` catches ``SystemExit``/
+  ``KeyboardInterrupt`` too; inside a thread target that turns an
+  intended shutdown into a silent hang.  Use ``except Exception:`` (or
+  narrower) — everywhere, not just in thread targets, since helpers
+  get called from threads.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, call_name,
+                   lint_rule, parent_map)
+
+
+def _bool_kw(call: ast.Call, name: str) -> Optional[bool]:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return None
+
+
+def _joined_names(tree: ast.Module) -> Set[str]:
+    """Identifiers X with an ``X.join(`` call or ``X.daemon = True``
+    assignment anywhere in the module (attr or bare name, last segment)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            v = node.func.value
+            if isinstance(v, ast.Attribute):
+                out.add(v.attr)
+            elif isinstance(v, ast.Name):
+                out.add(v.id)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and isinstance(node.value, ast.Constant) \
+                        and node.value.value is True:
+                    v = t.value
+                    if isinstance(v, ast.Attribute):
+                        out.add(v.attr)
+                    elif isinstance(v, ast.Name):
+                        out.add(v.id)
+    return out
+
+
+@lint_rule("thread-hygiene",
+           description="non-daemon threads need a join path; no bare "
+                       "`except:` handlers")
+class ThreadHygieneRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        out: List[Finding] = []
+        parents = None
+        joined: Optional[Set[str]] = None
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno, node.col_offset,
+                    "bare `except:` also swallows SystemExit/"
+                    "KeyboardInterrupt — catch Exception (or narrower)"))
+                continue
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in ("threading.Thread", "Thread")):
+                continue
+            if _bool_kw(node, "daemon") is True:
+                continue
+            if joined is None:
+                joined = _joined_names(mod.tree)
+            if parents is None:
+                parents = parent_map(mod.tree)
+            # where does the thread object land?
+            target: Optional[str] = None
+            cur = parents.get(node)
+            while cur is not None and target is None:
+                if isinstance(cur, ast.Assign):
+                    for t in cur.targets:
+                        if isinstance(t, ast.Attribute):
+                            target = t.attr
+                        elif isinstance(t, ast.Name):
+                            target = t.id
+                elif isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Module)):
+                    break
+                cur = parents.get(cur)
+            if target is not None and target in joined:
+                continue
+            out.append(Finding(
+                self.name, mod.rel, node.lineno, node.col_offset,
+                "non-daemon Thread with no visible join path in this "
+                "module — pass daemon=True, or join it on the shutdown "
+                "path (and keep the join in this module)"))
+        return out

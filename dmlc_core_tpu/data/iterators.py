@@ -114,7 +114,8 @@ class DiskRowIter(RowBlockIter):
         max_field = 0
         nrows = 0
         npages = 0
-        with Timer() as t, open(self.cache_file, "wb") as f:
+        tmp_cache = self.cache_file + ".tmp"
+        with Timer() as t, open(tmp_cache, "wb") as f:
             page = RowBlockContainer()
             page_bytes = 0
 
@@ -151,8 +152,13 @@ class DiskRowIter(RowBlockIter):
             flush()
         self._meta = {"num_col": num_col, "max_field": max_field,
                       "nrows": nrows, "npages": npages}
-        with open(self.cache_file + ".meta", "wb") as f:
+        # commit order matters: a crash mid-build must leave no .meta (its
+        # existence is what marks the cache reusable on the next run)
+        os.replace(tmp_cache, self.cache_file)
+        tmp_meta = self.cache_file + ".meta.tmp"
+        with open(tmp_meta, "wb") as f:
             ser.save(f, self._meta)
+        os.replace(tmp_meta, self.cache_file + ".meta")
         log_info("disk cache built: %d rows, %d pages → %s",
                  nrows, npages, self.cache_file)
 

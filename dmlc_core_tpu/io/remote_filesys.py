@@ -43,6 +43,7 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import BinaryIO, Dict, List, Optional, Tuple
 
+from ..utils.parameter import env_int, get_env
 from ..utils import (Deadline, DeadlineExpired, DMLCError, RetriesExhausted,
                      RetryPolicy, check, fault_point, get_env)
 from .filesys import FS_REGISTRY, FileInfo, FileSystem
@@ -672,7 +673,7 @@ class GCSFileSystem(S3FileSystem):
         c = _S3Config("GCS", "s3")
         # a custom *S3* endpoint (minio etc.) must not reroute gs:// traffic;
         # only the GCS-specific override applies here
-        c.endpoint = (os.environ.get("DMLC_GCS_ENDPOINT")
+        c.endpoint = (get_env("DMLC_GCS_ENDPOINT", None)
                       or "https://storage.googleapis.com")
         return c
 
@@ -746,14 +747,14 @@ class WebHDFSFileSystem(FileSystem):
     """
 
     def _base(self, uri: URI) -> Tuple[str, str, str]:
-        scheme = os.environ.get("DMLC_WEBHDFS_SCHEME", "http")
+        scheme = get_env("DMLC_WEBHDFS_SCHEME", "http")
         path = urllib.parse.quote(uri.name, safe="/")
         return scheme, uri.host, f"/webhdfs/v1{path}"
 
     @staticmethod
     def _auth_params() -> Dict[str, str]:
         """delegation token > user.name > nothing (simple-auth clusters)."""
-        token = os.environ.get("DMLC_WEBHDFS_TOKEN")
+        token = get_env("DMLC_WEBHDFS_TOKEN", None)
         if token:
             return {"delegation": token}
         user = os.environ.get("HADOOP_USER_NAME")
@@ -811,7 +812,7 @@ class WebHDFSFileSystem(FileSystem):
             return _WebHDFSReadStream(scheme, netloc, path, info.size,
                                       self._auth_params())
         check(mode == "w", "webhdfs supports modes 'r' and 'w' only")
-        part = int(os.environ.get("DMLC_WEBHDFS_PART_SIZE", str(8 << 20)))
+        part = env_int("DMLC_WEBHDFS_PART_SIZE", 8 << 20, minimum=1)
         return _WebHDFSWriteStream(self, uri, max(1, part))
 
 

@@ -80,8 +80,8 @@ def _pallas_profitable(B: int, K: int, D: int, fused: bool) -> bool:
     ``DMLC_EMBED_ENGINE=pallas`` (pin) or ``DMLC_EMBED_AUTOTUNE=1``
     (wall-clock probe — single-host bench use only, nondeterministic
     across hosts)."""
-    import os
-    if os.environ.get("DMLC_EMBED_AUTOTUNE", "0") == "1":
+    from ..utils.parameter import parse_lenient_bool
+    if parse_lenient_bool("DMLC_EMBED_AUTOTUNE"):
         return _pallas_faster_timed(B, K, D, fused)
     return False
 
@@ -119,7 +119,8 @@ def _pallas_faster_timed(B: int, K: int, D: int, fused: bool) -> bool:
                 jnp.einsum("bk,bkd->bd", v * v, t[i] * t[i]))))
         else:
             t_pal = timed(embed_bag_pallas)
-            t_xla = timed(jax.jit(embed_bag_reference))
+            t_xla = timed(jax.jit(embed_bag_reference,
+                                  static_argnames=("square",)))
         faster = t_pal < t_xla
     except Exception:  # noqa: BLE001 — timing must never break dispatch
         faster = False
@@ -129,8 +130,8 @@ def _pallas_faster_timed(B: int, K: int, D: int, fused: bool) -> bool:
 
 def _resolve_engine(engine: str, D: int, fused: bool = False,
                     B: int = 1024, K: int = 32) -> str:
-    import os
-    pinned = os.environ.get("DMLC_EMBED_ENGINE")
+    from ..utils.parameter import get_env
+    pinned = get_env("DMLC_EMBED_ENGINE", None)
     if pinned:                       # multi-host escape hatch: pin globally
         engine = pinned
     if engine == "auto":
@@ -280,9 +281,8 @@ def _chunk_rows(K: int) -> int:
     on shapes, so changing the env after a shape has been traced does not
     re-chunk that shape for the rest of the process — set it before the
     first call."""
-    import os
-    cap = int(os.environ.get("DMLC_PALLAS_SMEM_SCALARS",
-                             str(_SMEM_SCALARS_CAP)))
+    from ..utils.parameter import env_int
+    cap = env_int("DMLC_PALLAS_SMEM_SCALARS", _SMEM_SCALARS_CAP)
     rows = max(cap // max(K, 1), _ROWS)
     return max((rows // _ROWS) * _ROWS, _ROWS)
 

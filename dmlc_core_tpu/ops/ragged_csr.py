@@ -308,8 +308,8 @@ def _pallas_supported(D: int, fm: bool) -> bool:
 
 
 def _resolve_engine(engine: str, D: int, fm: bool = False) -> str:
-    import os
-    pinned = os.environ.get("DMLC_RAGGED_ENGINE")
+    from ..utils.parameter import get_env
+    pinned = get_env("DMLC_RAGGED_ENGINE", None)
     if pinned:
         engine = pinned
     if engine == "auto":

@@ -158,7 +158,7 @@ class ElasticJaxMesh:
             check(base_port > 0, "ElasticJaxMesh needs base_port (or the "
                                  "launcher's DMLC_ELASTIC_BASE_PORT env)")
         self.base_port = int(base_port)
-        self.host = host or os.environ.get("DMLC_ELASTIC_HOST", "127.0.0.1")
+        self.host = host or get_env("DMLC_ELASTIC_HOST", "127.0.0.1")
         self.num_processes = num_processes or ctx.world_size
         self.process_id = ctx.rank if process_id is None else process_id
         self.generation = -1            # not initialized yet

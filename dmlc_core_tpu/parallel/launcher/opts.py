@@ -6,6 +6,7 @@ from __future__ import annotations
 import argparse
 import os
 from typing import List, Optional
+from ...utils.parameter import env_int, get_env, parse_lenient_bool
 
 __all__ = ["build_parser", "get_opts"]
 
@@ -18,7 +19,7 @@ def build_parser() -> argparse.ArgumentParser:
         description="Submit a distributed job (TPU-native dmlc-submit): "
                     "boots a rendezvous tracker and launches workers on the "
                     "chosen cluster backend.")
-    p.add_argument("--cluster", default=os.environ.get(
+    p.add_argument("--cluster", default=get_env(
         "DMLC_SUBMIT_CLUSTER", "local"), choices=CLUSTERS,
         help="cluster backend (env DMLC_SUBMIT_CLUSTER overrides the default)")
     p.add_argument("--num-workers", "-n", type=int, required=True,
@@ -91,10 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dry-run", action="store_true",
                    help="print the scheduler submission without running it")
     p.add_argument("--max-attempts", type=int,
-                   default=int(os.environ.get("DMLC_MAX_ATTEMPT", "3")),
+                   default=env_int("DMLC_MAX_ATTEMPT", 3, minimum=1),
                    help="per-worker restart attempts before giving up")
     p.add_argument("--elastic", action="store_true",
-                   default=os.environ.get("DMLC_ELASTIC") == "1",
+                   default=bool(parse_lenient_bool("DMLC_ELASTIC")),
                    help="tpu cluster: respawn crashed workers with a "
                         "bumped DMLC_NUM_ATTEMPT (pair worker code with "
                         "ElasticJaxMesh — plain jax.distributed cannot "

@@ -23,6 +23,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ...utils import DMLCError, log_info, log_warning
+from ...utils.parameter import env_int
 
 __all__ = ["submit", "parse_host_file", "HostPool"]
 
@@ -35,8 +36,8 @@ class HostPool:
 
     def __init__(self, hosts: List[Tuple[str, int]], fail_limit: int = 0):
         self._hosts = list(hosts)
-        self._fail_limit = fail_limit or int(
-            os.environ.get("DMLC_HOST_FAIL_LIMIT", "2"))
+        self._fail_limit = fail_limit or env_int(
+            "DMLC_HOST_FAIL_LIMIT", 2, minimum=1)
         self._failures: Dict[Tuple[str, int], int] = {}
         self._black: set = set()
         self._next = 0

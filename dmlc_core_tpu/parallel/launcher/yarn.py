@@ -40,6 +40,7 @@ import subprocess
 from typing import Dict, List
 
 from ...utils import DMLCError, log_info
+from ...utils.parameter import env_int, get_env
 from .wrapper import write_wrapper_script
 
 __all__ = ["submit_yarn", "build_yarn_command", "rm_app_report"]
@@ -63,7 +64,7 @@ def build_yarn_command(args, tracker_envs: Dict[str, str]) -> List[str]:
     nproc = args.num_workers + args.num_servers
     hadoop = os.environ.get("HADOOP_HOME", "")
     hadoop_bin = os.path.join(hadoop, "bin", "hadoop") if hadoop else "hadoop"
-    jar = os.environ.get(
+    jar = get_env(
         "DMLC_YARN_DSHELL_JAR",
         "hadoop-yarn-applications-distributedshell.jar")
     cmd = [
@@ -101,7 +102,7 @@ def rm_app_report(app_id: str, rm_http: str = "",
     endpoint is unset/unreachable — diagnostics must never turn a launch
     failure into a launcher crash."""
     import urllib.request
-    rm = rm_http or os.environ.get("DMLC_YARN_RM_HTTP", "")
+    rm = rm_http or get_env("DMLC_YARN_RM_HTTP", "")
     if not rm or not app_id:
         return {}
     url = f"{rm.rstrip('/')}/ws/v1/cluster/apps/{app_id}"
@@ -120,13 +121,13 @@ def submit_yarn(args, tracker_envs: Dict[str, str]) -> int:
     # retry/blacklist/abort policy — a container death restarts only that
     # task's app.  Opt in with DMLC_YARN_MODE=rest (+ DMLC_YARN_RM_HTTP);
     # the stock-DistributedShell path below stays the zero-config default.
-    if os.environ.get("DMLC_YARN_MODE", "dshell") == "rest":
+    if get_env("DMLC_YARN_MODE", "dshell") == "rest":
         from .yarn_am import supervise_from_args
         if args.dry_run:
             nproc = args.num_workers + args.num_servers
             log_info("yarn (dry run, rest mode): would submit %d single-"
                      "container apps to %s (max_attempts=%d)", nproc,
-                     os.environ.get("DMLC_YARN_RM_HTTP", "<unset>"),
+                     get_env("DMLC_YARN_RM_HTTP", "<unset>"),
                      max(1, getattr(args, "max_attempts", 1)))
             return 0
         return supervise_from_args(args, tracker_envs)
@@ -134,8 +135,9 @@ def submit_yarn(args, tracker_envs: Dict[str, str]) -> int:
     script = cmd[cmd.index("-shell_script") + 1]
     log_info("yarn%s: %s", " (dry run)" if args.dry_run else "",
              " ".join(cmd))
-    app_attempts = max(1, int(os.environ.get(
-        "DMLC_YARN_APP_ATTEMPTS", str(getattr(args, "max_attempts", 1)))))
+    app_attempts = env_int("DMLC_YARN_APP_ATTEMPTS",
+                           max(1, getattr(args, "max_attempts", 1)),
+                           minimum=1)
     try:
         if args.dry_run:
             with open(script) as f:

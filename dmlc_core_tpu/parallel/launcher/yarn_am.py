@@ -41,6 +41,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ...utils import DMLCError, log_info, log_warning
+from ...utils.parameter import env_int, get_env
 
 __all__ = ["YarnRestClient", "TaskSpec", "TaskSupervisor"]
 
@@ -314,10 +315,10 @@ def supervise_from_args(args, tracker_envs: Dict[str, str]) -> int:
                 else args.worker_cores),
         queue=getattr(args, "yarn_queue", "") or "",
         name=f"{args.jobname or 'dmlc'}-task{i}") for i in range(nproc)]
-    client = YarnRestClient(os.environ.get("DMLC_YARN_RM_HTTP", ""))
+    client = YarnRestClient(get_env("DMLC_YARN_RM_HTTP", ""))
     sup = TaskSupervisor(
         client, tasks,
         max_attempts=max(1, getattr(args, "max_attempts", 1)),
-        node_fail_limit=int(os.environ.get("DMLC_YARN_NODE_FAIL_LIMIT",
-                                           "3")))
+        node_fail_limit=env_int("DMLC_YARN_NODE_FAIL_LIMIT", 3,
+                                minimum=1))
     return sup.run()

@@ -128,7 +128,7 @@ class RabitContext:
         except (TypeError, ValueError):
             log_warning("rabit: bad DMLC_PEER_RECV_TIMEOUT=%r; using "
                         "default %.0fs",
-                        os.environ.get("DMLC_PEER_RECV_TIMEOUT"),
+                        get_env("DMLC_PEER_RECV_TIMEOUT", None),
                         2.0 * recover_timeout)
             t = 2.0 * recover_timeout
         self.peer_recv_timeout: Optional[float] = None if t <= 0 else t
@@ -189,7 +189,7 @@ class RabitContext:
         link/recovery deadlines without code changes."""
         uri = get_env("DMLC_TRACKER_URI", "127.0.0.1")
         port = get_env("DMLC_TRACKER_PORT", 9091)
-        jobid = os.environ.get("DMLC_TASK_ID")
+        jobid = get_env("DMLC_TASK_ID", None)
         attempt = get_env("DMLC_NUM_ATTEMPT", 0)
         kw.setdefault("connect_timeout",
                       get_env("DMLC_CONNECT_TIMEOUT", 60.0))
@@ -219,11 +219,30 @@ class RabitContext:
         self.ring_prev: int = reply["ring_prev"]
         self.ring_next: int = reply["ring_next"]
         self.generation: int = reply.get("generation", 0)
-        self._target_gen = self.generation
-        self._addresses = {int(k): tuple(v)
-                           for k, v in reply["addresses"].items()}
+        self._apply_topology(self.generation,
+                             {int(k): tuple(v)
+                              for k, v in reply["addresses"].items()})
         # every log record from this process now carries its rank
         set_log_context(rank=self.rank)
+
+    def _apply_topology(self, gen: int,
+                        addresses: Dict[int, Tuple[str, int]]) -> None:
+        """Apply a rendezvous reply's topology under the peer lock.
+
+        The accept thread is live before registration finishes, so a
+        tracker ``reset_links`` push can interleave with the reply;
+        ``_handle_ctrl`` mutates ``_target_gen``/``_addresses`` under
+        ``_peer_lock`` and this must too — and must never roll a newer
+        pushed topology back to the reply's older one."""
+        with self._peer_lock:
+            if gen >= self._target_gen:
+                self._target_gen = gen
+                self._addresses = dict(addresses)
+            else:
+                # a reset_links push raced ahead of this reply: keep the
+                # newer pushed addresses, only fill ranks it left unset
+                for r, a in addresses.items():
+                    self._addresses.setdefault(r, a)
 
     # -- link management --
     def _accept_loop(self) -> None:
@@ -483,7 +502,7 @@ class RabitContext:
     # -- checkpoint API (rabit CheckPoint/LoadCheckPoint/VersionNumber) --
     def _ckpt_path(self) -> str:
         import tempfile
-        d = os.environ.get("DMLC_CHECKPOINT_DIR", tempfile.gettempdir())
+        d = get_env("DMLC_CHECKPOINT_DIR", tempfile.gettempdir())
         # key by tracker address as well as jobid: tracker ports are
         # ephemeral per job, so a later job with the same task ids cannot
         # resurrect a stale checkpoint from a previous run

@@ -63,7 +63,7 @@ from ..utils.checkpoint import (CheckpointManager, flatten_tree,
                                 unflatten_like)
 from ..utils.faults import fault_point
 from ..utils.metrics import metrics
-from ..utils.parameter import env_int
+from ..utils.parameter import env_int, get_env
 
 __all__ = ["StateHandle", "ReshardStats", "HostSnapshot", "snapshot_tree",
            "redistribute"]
@@ -246,7 +246,7 @@ def _my_host(ctx) -> str:
     """The address peers can dial for shard fetches: explicit override,
     else the interface that routes to the tracker (the UDP-connect trick
     — nothing is sent), else loopback."""
-    override = os.environ.get("DMLC_RESHARD_HOST", "").strip()
+    override = get_env("DMLC_RESHARD_HOST", "").strip()
     if override:
         return override
     try:

@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ..telemetry import trace as teltrace
 from ..utils.logging import check, log_info
 from ..utils.metrics import metrics
+from ..utils.parameter import get_env
 from . import fingerprint as fingerprint_mod
 from . import tuned
 
@@ -63,7 +64,7 @@ def enabled() -> bool:
     ``DMLC_AUTOTUNE`` set to anything but ``0``.  Unset means off — the
     controller changes pipeline behavior over time, so it must never be
     a silent default; ``DMLC_AUTOTUNE=0`` is the hard kill switch."""
-    v = os.environ.get("DMLC_AUTOTUNE", "").strip()
+    v = get_env("DMLC_AUTOTUNE", "").strip()
     return bool(v) and v != "0"
 
 
@@ -452,7 +453,7 @@ def maybe_autotuner(knobs_factory: Callable[[], Sequence[Knob]],
     stands; False is always off."""
     if gate is False:
         return None
-    if os.environ.get("DMLC_AUTOTUNE", "").strip() == "0":
+    if get_env("DMLC_AUTOTUNE", "").strip() == "0":
         return None
     if gate == "auto" and not enabled():
         return None

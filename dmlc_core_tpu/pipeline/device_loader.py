@@ -43,6 +43,7 @@ import numpy as np
 from ..data.parser import ParserBase
 from ..telemetry import trace as teltrace
 from ..utils import ThreadedIter, check
+from ..utils.parameter import parse_lenient_bool
 from . import fingerprint as fingerprint_mod
 from . import page_cache
 from .packing import (PackStats, batch_slices, pack_flat, pack_ragged,
@@ -546,7 +547,7 @@ class DeviceLoader:
 
         from .. import native
         from ..data.parser import TextParser
-        return (os.environ.get("DMLC_STREAMPACK", "1") != "0"
+        return (parse_lenient_bool("DMLC_STREAMPACK") is not False
                 and self._use_native_pack() and native.has_sppack()
                 and type(self.source) is TextParser
                 and getattr(self.source, "nthreads", 0) == 1

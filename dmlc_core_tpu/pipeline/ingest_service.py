@@ -150,7 +150,7 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                     nthreads, threaded = (1, False) if pt == 1 \
                         else (pt, True)
                 else:
-                    pinned = (os.environ.get("DMLC_NUM_THREADS")
+                    pinned = (get_env("DMLC_NUM_THREADS", None)
                               or os.environ.get("OMP_NUM_THREADS"))
                     nthreads, threaded = ((1, False)
                                           if cores == 1 and not pinned
@@ -409,7 +409,10 @@ class RemoteIngestLoader:
                         return None
                     cv.wait(timeout=1.0)
 
-        self._frame_holder = holder
+        # _restart_readers swaps holder["state"] under _gen_lock from
+        # other threads; publish the holder itself under the same lock
+        with self._gen_lock:
+            self._frame_holder = holder
         return next_fn
 
     def _restart_readers(self) -> None:

@@ -46,7 +46,7 @@ import time
 from typing import Iterator, Optional
 
 from ..utils.logging import log_warning
-from ..utils.parameter import env_int, parse_lenient_bool
+from ..utils.parameter import env_int, get_env, parse_lenient_bool
 
 __all__ = ["tuned_path", "save_tuned", "load_tuned", "resolve",
            "save_autotuned", "load_autotuned", "update_tuned"]
@@ -60,8 +60,8 @@ AUTOTUNE_SECTION = "autotune"
 
 
 def tuned_path() -> str:
-    return os.environ.get("DMLC_TUNED_CONFIG",
-                          os.path.join(_REPO_ROOT, ".dmlc_tuned.json"))
+    return get_env("DMLC_TUNED_CONFIG",
+                   os.path.join(_REPO_ROOT, ".dmlc_tuned.json"))
 
 
 @contextlib.contextmanager

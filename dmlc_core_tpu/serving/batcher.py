@@ -115,7 +115,8 @@ class MicroBatcher:
         self._m_gen = m.generation
         self._m_depth = m.gauge("serving.batcher.queue_depth")
         self._m_occ = m.gauge("serving.batcher.occupancy")
-        self._m_overload = m.counter("serving.batcher.overloads")
+        self._m_overload = m.counter(  # dmlclint: disable=lock-discipline -- atomic ref swap; counters are internally thread-safe
+            "serving.batcher.overloads")
         self._m_expired = m.counter("serving.batcher.deadline_drops")
         self._m_batches = m.counter("serving.batcher.batches")
         self._m_reqs = m.throughput("serving.batcher.requests")

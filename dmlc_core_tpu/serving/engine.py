@@ -262,7 +262,8 @@ class InferenceEngine:
     def _bind_metrics(self) -> None:
         m = metrics
         self._m_gen = m.generation
-        self._m_compiles = m.counter("serving.engine.compiles")
+        self._m_compiles = m.counter(  # dmlclint: disable=lock-discipline -- atomic ref swap; counters are internally thread-safe
+            "serving.engine.compiles")
         self._m_batches = m.counter("serving.engine.batches")
         self._m_rows = m.throughput("serving.engine.rows")
         self._m_fwd = m.stage("serving.engine.forward")

@@ -425,7 +425,7 @@ def serve_main(argv=None) -> int:
     # ragged capacity engine: CLI key wins, env var is the fleet-wide
     # default (flip a deployment without touching every launch line)
     ragged = args.get("ragged",
-                      os.environ.get("DMLC_SERVE_RAGGED", "0"))
+                      get_env("DMLC_SERVE_RAGGED", "0"))
     engine = InferenceEngine(
         model, params,
         postprocess="sigmoid" if p.task == "binary" else "none",

@@ -26,6 +26,7 @@ guarded no-op without JAX).
 from __future__ import annotations
 
 import json
+import os
 from typing import Optional
 
 from .aggregate import merge_states, render_fleet, state_to_snapshot
@@ -68,8 +69,10 @@ def dump_artifacts(prefix: str, registry=None) -> dict:
     if registry is None:
         from ..utils.metrics import metrics as registry   # type: ignore
     metrics_path = f"{prefix}.metrics.json"
-    with open(metrics_path, "w", encoding="utf-8") as f:
+    tmp = f"{metrics_path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
         json.dump({"snapshot": registry.snapshot()}, f, indent=2,
                   sort_keys=True, default=str)
+    os.replace(tmp, metrics_path)
     trace_path = write_chrome_trace(f"{prefix}.trace.json")
     return {"metrics": metrics_path, "trace": trace_path}

@@ -504,7 +504,7 @@ def maybe_monitor_from_env(registry: Optional[MetricsRegistry] = None,
     monitor is live returns it instead of stacking threads."""
     global _env_monitor
     import os
-    spec = os.environ.get(SLO_ENV_VAR) or None
+    spec = get_env(SLO_ENV_VAR, None) or None
     if not spec:
         return None
     if (_env_monitor is not None and _env_monitor.spec == spec

@@ -22,6 +22,7 @@ Point events (retries, breaker trips) become instant events
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 from . import trace as _trace
@@ -82,6 +83,8 @@ def write_chrome_trace(path: str,
                        ) -> str:
     """Dump :func:`to_chrome_trace` to ``path``; returns the path."""
     doc = to_chrome_trace(records)
-    with open(path, "w", encoding="utf-8") as f:
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
         json.dump(doc, f)
+    os.replace(tmp, path)
     return path

@@ -99,7 +99,7 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._snaps: deque = deque(maxlen=max(2, int(snapshot_capacity)))
         self._notes: deque = deque(maxlen=max(1, int(note_capacity)))
-        self._dir: Optional[str] = os.environ.get("DMLC_FLIGHT_DIR") or None
+        self._dir: Optional[str] = get_env("DMLC_FLIGHT_DIR", None) or None
         self._min_interval = get_env("DMLC_FLIGHT_MIN_INTERVAL", 30.0)
         self._last_dump = -float("inf")
         self._dump_seq = 0
@@ -158,7 +158,7 @@ class FlightRecorder:
                      "deltas": _counter_deltas(oldest[1], now_snap)}
         anomaly_mod = sys.modules.get("dmlc_core_tpu.telemetry.anomaly")
         faults_mod = sys.modules.get("dmlc_core_tpu.utils.faults")
-        rank = os.environ.get("DMLC_RANK")
+        rank = get_env("DMLC_RANK", None)
         return {
             "schema": INCIDENT_SCHEMA,
             "reason": reason,
@@ -208,15 +208,21 @@ class FlightRecorder:
             doc["files"] = {"incident": "incident.json",
                             "trace": "trace.json",
                             "log_tail": "log_tail.txt"}
-            with open(os.path.join(path, "incident.json"), "w",
-                      encoding="utf-8") as f:
-                json.dump(doc, f, indent=2, sort_keys=True, default=str)
-            with open(os.path.join(path, "trace.json"), "w",
-                      encoding="utf-8") as f:
-                json.dump(to_chrome_trace(), f)
-            with open(os.path.join(path, "log_tail.txt"), "w",
-                      encoding="utf-8") as f:
-                f.write("\n".join(tail) + ("\n" if tail else ""))
+            # tmp + rename per file: a crash mid-dump (likely — this IS
+            # the crash path) must not leave a half-written bundle that
+            # post-mortem tooling then chokes on
+            def _put(name: str, write) -> None:
+                tmp = os.path.join(path, f".{name}.tmp")
+                with open(tmp, "w", encoding="utf-8") as f:
+                    write(f)
+                os.replace(tmp, os.path.join(path, name))
+
+            _put("incident.json",
+                 lambda f: json.dump(doc, f, indent=2, sort_keys=True,
+                                     default=str))
+            _put("trace.json", lambda f: json.dump(to_chrome_trace(), f))
+            _put("log_tail.txt",
+                 lambda f: f.write("\n".join(tail) + ("\n" if tail else "")))
         except OSError as e:
             # the black box must never become the crash: report and move on
             log_warning("flight recorder dump to %s failed: %s", path, e)
@@ -308,7 +314,7 @@ def maybe_arm_from_env(install: bool = True) -> Optional[FlightRecorder]:
     """Arm the global recorder when ``DMLC_FLIGHT_DIR`` is set; also
     install the fatal-path hooks (``DMLC_FLIGHT_HOOKS=0`` opts out).
     Unset → None, exact no-op — the faults/SLO env convention."""
-    directory = os.environ.get("DMLC_FLIGHT_DIR") or None
+    directory = get_env("DMLC_FLIGHT_DIR", None) or None
     if directory is None:
         return None
     flight_recorder.arm(directory)

@@ -51,6 +51,7 @@ from typing import Dict, Iterator, List, Optional
 
 from .logging import DMLCError
 from .metrics import metrics
+from .parameter import get_env
 
 __all__ = ["FaultInjected", "FaultSpecError", "fault_point",
            "install_faults", "clear_faults", "inject_faults",
@@ -242,7 +243,7 @@ def _refresh_from_env() -> None:
     global _plan, _env_seen
     if _explicit:
         return
-    raw = os.environ.get(ENV_VAR) or None
+    raw = get_env(ENV_VAR, None) or None
     if raw == _env_seen:
         return
     with _lifecycle_lock:

@@ -1,0 +1,458 @@
+"""Opt-in runtime lock-order checker for the threaded data/serving plane.
+
+``dmlclint``'s *lock-discipline* rule catches single-class mistakes
+statically; what it cannot see is cross-object ordering — the batcher
+thread taking ``A`` then ``B`` while a reload thread takes ``B`` then
+``A`` deadlocks only under load, and only sometimes.  This module is
+the dynamic half of the contract: with ``DMLC_LOCKCHECK=1`` every
+``threading.Lock``/``RLock`` *created from package code* is wrapped in
+an :class:`InstrumentedLock` that
+
+* maintains a per-thread stack of held locks,
+* records a global acquired-before edge graph between lock instances,
+* reports a **lock-order inversion** the moment an acquisition creates
+  a cycle (``A→B`` recorded while a ``B→…→A`` path exists) — i.e. the
+  deadlock is flagged on the orderings alone, without needing the
+  unlucky interleaving that would actually hang,
+* flags **anomalous hold times** (``DMLC_LOCKCHECK_HOLD_S``, default
+  1.0s) — a lock held across a blocking call is the usual prelude to
+  an inversion being load-bearing.
+
+Findings feed ``lockcheck.{inversions,long_holds}`` counters plus the
+``lockcheck.hold_s`` histogram, and each inversion drops a note into
+the flight recorder so a later incident bundle carries the ordering
+evidence — all via a daemon flusher thread, never synchronously from
+the bookkeeping path (a GC-run ``__del__`` can release an
+instrumented lock while this thread holds the metrics registry lock;
+emitting right there would re-enter the registry and hang).  Everything is process-local and off unless installed:
+importing this module costs nothing at runtime.
+
+Usage::
+
+    from dmlc_core_tpu.utils import lockcheck
+    if lockcheck.enabled():        # DMLC_LOCKCHECK=1
+        lockcheck.install()
+    ...
+    print(lockcheck.report())      # {"inversions": [...], ...}
+
+Instance (id-based) edges are deliberate: aggregating by creation site
+would merge every ``ConcurrentBlockingQueue``'s lock into one node and
+manufacture cycles between unrelated queue instances.  The cost is
+that orderings are only learned per-instance — run representative
+traffic (the tier-1 suite does) for coverage.
+
+The reporting plane itself (``utils/metrics.py``,
+``telemetry/flight.py``, ``telemetry/trace.py``) is exempt from the
+shim: its locks are where findings get emitted, and instrumenting
+them lets the observer deadlock the observed (releasing a per-metric
+lock inside ``MetricsRegistry.snapshot`` — registry lock held — would
+observe ``lockcheck.hold_s`` and re-enter the registry).
+
+Caveat: ``threading.Condition()`` *without* a lock argument creates
+its ``RLock`` inside ``threading.py``; the factory attributes that
+allocation to the ``Condition()`` caller so package conditions are
+instrumented while CPython's own internals (``Event``, ``Thread``
+bookkeeping) stay raw.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .metrics import metrics
+from .parameter import get_env, parse_lenient_bool
+
+__all__ = ["InstrumentedLock", "enabled", "install", "uninstall",
+           "installed", "report", "reset", "flush", "make_lock",
+           "make_rlock"]
+
+# real factories, captured before any monkeypatching
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_DIR)
+
+#: the planes findings are emitted into stay raw: releasing an
+#: instrumented per-metric lock inside ``MetricsRegistry.snapshot``
+#: (registry lock held) would observe ``lockcheck.hold_s`` → re-enter
+#: the registry lock → self-deadlock.  The observer cannot also be
+#: the observed.
+_SELF_PLANE = (os.path.join("utils", "metrics.py"),
+               os.path.join("telemetry", "flight.py"),
+               os.path.join("telemetry", "trace.py"))
+
+# -- global checker state (guarded by _meta) --------------------------------
+# _meta is reentrant on purpose: bookkeeping allocates, allocation can
+# trigger GC, GC can run a package __del__ that releases an instrumented
+# lock — re-entering the bookkeeping while _meta is already held by this
+# very thread.  A plain lock would self-deadlock there.
+_meta = _REAL_RLOCK()
+_graph: Dict[int, Set[int]] = {}        # lock id → ids acquired after it
+_names: Dict[int, str] = {}             # lock id → creation site / name
+_inversions: List[Dict[str, Any]] = []
+_long_holds: List[Dict[str, Any]] = []
+_reported_pairs: Set[Tuple[int, int]] = set()
+_installed = False
+_tls = threading.local()
+
+#: findings queued for metrics/flight emission.  Bookkeeping must NEVER
+#: call into the reporting plane synchronously: a GC-run __del__ can
+#: release an instrumented lock while *this thread* already holds the
+#: (raw, non-reentrant) metrics registry lock mid-``_get`` — observing
+#: ``lockcheck.hold_s`` right there re-enters the registry and hangs.
+#: deque append/popleft are GIL-atomic; the flusher thread drains.
+_pending: "collections.deque[Tuple[str, Dict[str, Any]]]" = \
+    collections.deque(maxlen=65536)
+_flusher: Optional[threading.Thread] = None
+
+
+def enabled() -> bool:
+    """True when ``DMLC_LOCKCHECK`` opts the process in."""
+    return parse_lenient_bool("DMLC_LOCKCHECK") is True
+
+
+def _held() -> List["_HeldEntry"]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+class _HeldEntry:
+    __slots__ = ("lock_id", "t0")
+
+    def __init__(self, lock_id: int, t0: float) -> None:
+        self.lock_id = lock_id
+        self.t0 = t0
+
+
+def _path_exists(src: int, dst: int) -> bool:
+    """BFS over the edge graph; caller holds ``_meta``."""
+    if src == dst:
+        return True
+    seen = {src}
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for n in frontier:
+            # copy: re-entrant bookkeeping (GC __del__) may grow the set
+            for m in tuple(_graph.get(n, ())):
+                if m == dst:
+                    return True
+                if m not in seen:
+                    seen.add(m)
+                    nxt.append(m)
+        frontier = nxt
+    return False
+
+
+def _call_site() -> str:
+    """First stack frame outside this module — where acquire() happened."""
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    fn = f.f_code.co_filename
+    try:
+        fn = os.path.relpath(fn, _REPO_ROOT)
+    except ValueError:
+        pass
+    return f"{fn}:{f.f_lineno}"
+
+
+class InstrumentedLock:
+    """Lock/RLock wrapper that feeds the order graph and hold timer.
+
+    Implements the full ``threading`` lock protocol **plus** the
+    private ``_release_save``/``_acquire_restore``/``_is_owned`` hooks
+    so a wrapped lock can back a ``threading.Condition``.
+    """
+
+    __slots__ = ("_raw", "name", "reentrant", "_owner", "_depth", "_hold_s")
+
+    def __init__(self, raw: Any, name: str, reentrant: bool) -> None:
+        self._raw = raw
+        self.name = name
+        self.reentrant = reentrant
+        self._owner: Optional[int] = None   # ident, reentrant only
+        self._depth = 0
+        self._hold_s = float(get_env("DMLC_LOCKCHECK_HOLD_S", 1.0))
+
+    # -- acquisition bookkeeping ----------------------------------------
+    #
+    # Bookkeeping records findings into checker state and enqueues the
+    # metrics/flight emission for the flusher thread — never calling
+    # the reporting plane from here (see ``_pending``).  ``_tls.busy``
+    # makes the flusher's own lock use invisible to the checker, and
+    # the tuple() copies keep a GC-run __del__'s re-entrant bookkeeping
+    # from mutating a set/list this frame is iterating.
+
+    def _note_acquired(self) -> None:
+        if getattr(_tls, "busy", False):
+            return
+        held = _held()
+        me = id(self)
+        if held:
+            with _meta:
+                for h in tuple(held):
+                    if h.lock_id == me:
+                        continue
+                    edges = _graph.setdefault(h.lock_id, set())
+                    if me in edges:
+                        continue
+                    # new ordering h → me; a me→…→h path means a cycle
+                    if _path_exists(me, h.lock_id):
+                        pair = (min(h.lock_id, me), max(h.lock_id, me))
+                        if pair not in _reported_pairs:
+                            _reported_pairs.add(pair)
+                            inversion = {
+                                "held": _names.get(h.lock_id, "?"),
+                                "acquiring": _names.get(me, "?"),
+                                "thread": threading.current_thread().name,
+                                "site": _call_site(),
+                            }
+                            _inversions.append(inversion)
+                            _pending.append(("inversion", inversion))
+                    edges.add(me)
+        held.append(_HeldEntry(me, time.monotonic()))
+
+    def _note_released(self) -> None:
+        if getattr(_tls, "busy", False):
+            return
+        held = _held()
+        me = id(self)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock_id == me:
+                dt = time.monotonic() - held[i].t0
+                del held[i]
+                _pending.append(("hold", {"hold_s": dt}))
+                if dt > self._hold_s:
+                    info = {"lock": self.name, "hold_s": round(dt, 4),
+                            "thread": threading.current_thread().name}
+                    with _meta:
+                        _long_holds.append(info)
+                    _pending.append(("long_hold", info))
+                return
+
+    # -- lock protocol ---------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ident = threading.get_ident()
+        if self.reentrant and self._owner == ident:
+            self._raw.acquire(blocking, timeout)
+            self._depth += 1
+            return True
+        got = self._raw.acquire(blocking, timeout)
+        if got:
+            if self.reentrant:
+                self._owner = ident
+                self._depth = 1
+            self._note_acquired()
+        return got
+
+    def release(self) -> None:
+        if self.reentrant and self._owner == threading.get_ident() \
+                and self._depth > 1:
+            self._depth -= 1
+            self._raw.release()
+            return
+        if self.reentrant:
+            self._owner = None
+            self._depth = 0
+        self._note_released()
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        kind = "RLock" if self.reentrant else "Lock"
+        return f"<InstrumentedLock {kind} {self.name}>"
+
+    # -- Condition support ----------------------------------------------
+
+    def _release_save(self) -> Any:
+        """Full release for ``Condition.wait`` (drops reentrant depth)."""
+        self._note_released()
+        if self.reentrant:
+            self._owner = None
+            depth, self._depth = self._depth, 0
+            if hasattr(self._raw, "_release_save"):
+                return ("raw", self._raw._release_save())
+            for _ in range(depth):
+                self._raw.release()
+            return ("depth", depth)
+        self._raw.release()
+        return ("plain", None)
+
+    def _acquire_restore(self, state: Any) -> None:
+        kind, payload = state
+        if kind == "raw":
+            self._raw._acquire_restore(payload)
+            self._owner = threading.get_ident()
+            self._depth = 1
+        elif kind == "depth":
+            for _ in range(payload):
+                self._raw.acquire()
+            self._owner = threading.get_ident()
+            self._depth = payload
+        else:
+            self._raw.acquire()
+        # a post-wait reacquire re-enters the held stack but records no
+        # ordering edges: the wait already proved other threads take this
+        # lock between our hold windows, and counting the reacquire
+        # against locks still held across the wait() would be noise
+        if not getattr(_tls, "busy", False):
+            _held().append(_HeldEntry(id(self), time.monotonic()))
+
+    def _is_owned(self) -> bool:
+        if self.reentrant:
+            return self._owner == threading.get_ident()
+        if hasattr(self._raw, "_is_owned"):
+            return self._raw._is_owned()
+        if self._raw.acquire(False):
+            self._raw.release()
+            return False
+        return True
+
+
+def _register(lock: InstrumentedLock) -> InstrumentedLock:
+    with _meta:
+        _names[id(lock)] = lock.name
+    return lock
+
+
+def make_lock(name: str) -> InstrumentedLock:
+    """Explicitly-named instrumented lock (tests / ad-hoc probes)."""
+    return _register(InstrumentedLock(_REAL_LOCK(), name, reentrant=False))
+
+
+def make_rlock(name: str) -> InstrumentedLock:
+    return _register(InstrumentedLock(_REAL_RLOCK(), name, reentrant=True))
+
+
+def _factory(reentrant: bool):
+    def make():
+        raw = (_REAL_RLOCK if reentrant else _REAL_LOCK)()
+        frame = sys._getframe(1)
+        fname = frame.f_code.co_filename
+        if os.path.basename(fname) == "threading.py":
+            if frame.f_code.co_name != "__init__" or frame.f_back is None:
+                return raw          # Event/Thread internals stay raw
+            # Condition() with no lock: attribute to Condition()'s caller
+            frame = frame.f_back
+            fname = frame.f_code.co_filename
+        apath = os.path.abspath(fname)
+        if not apath.startswith(_PKG_DIR + os.sep) \
+                or apath.endswith(_SELF_PLANE):
+            return raw              # only package-owned locks are shimmed,
+            #                         and never the reporting plane's own
+        try:
+            rel = os.path.relpath(fname, _REPO_ROOT)
+        except ValueError:
+            rel = fname
+        return _register(InstrumentedLock(
+            raw, f"{rel}:{frame.f_lineno}", reentrant))
+    return make
+
+
+def flush() -> None:
+    """Drain queued findings into metrics + the flight recorder.
+
+    Runs on the flusher thread (and in tests); safe to call from any
+    thread that is not inside the metrics registry.
+    """
+    drained: List[Tuple[str, Dict[str, Any]]] = []
+    while True:
+        try:
+            drained.append(_pending.popleft())
+        except IndexError:
+            break
+    if not drained:
+        return
+    _tls.busy = True
+    try:
+        for kind, info in drained:
+            if kind == "hold":
+                metrics.histogram("lockcheck.hold_s").observe(
+                    info["hold_s"])
+            elif kind == "long_hold":
+                metrics.counter("lockcheck.long_holds").add(1)
+            elif kind == "inversion":
+                metrics.counter("lockcheck.inversions").add(1)
+                try:
+                    from ..telemetry.flight import note
+                    note("lockcheck.inversion", **info)
+                except Exception:  # noqa: BLE001 — diagnostics only
+                    pass
+    finally:
+        _tls.busy = False
+
+
+def _flusher_loop() -> None:
+    while _installed:
+        flush()
+        time.sleep(0.5)
+
+
+def install() -> None:
+    """Shim ``threading.Lock``/``RLock`` creation for package modules."""
+    global _installed, _flusher
+    if _installed:
+        return
+    threading.Lock = _factory(reentrant=False)    # type: ignore[misc]
+    threading.RLock = _factory(reentrant=True)    # type: ignore[misc]
+    _installed = True
+    if _flusher is None or not _flusher.is_alive():
+        _flusher = threading.Thread(target=_flusher_loop, daemon=True,
+                                    name="lockcheck-flusher")
+        _flusher.start()
+
+
+def uninstall() -> None:
+    """Restore the real factories (existing wrapped locks keep working)."""
+    global _installed
+    threading.Lock = _REAL_LOCK                   # type: ignore[misc]
+    threading.RLock = _REAL_RLOCK                 # type: ignore[misc]
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    """Drop accumulated graph/findings (tests)."""
+    with _meta:
+        _graph.clear()
+        _names.clear()
+        _inversions.clear()
+        _long_holds.clear()
+        _reported_pairs.clear()
+        _pending.clear()
+
+
+def report() -> Dict[str, Any]:
+    with _meta:
+        return {
+            "installed": _installed,
+            "locks": len(_names),
+            "edges": sum(len(v) for v in _graph.values()),
+            "inversions": list(_inversions),
+            "long_holds": list(_long_holds),
+        }

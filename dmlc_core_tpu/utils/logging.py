@@ -14,6 +14,11 @@ a customizable sink (``DMLC_LOG_CUSTOMIZE`` `logging.h:142`), and a date logger
 
 from __future__ import annotations
 
+# dmlclint: disable-file=env-discipline -- this module bootstraps before
+# utils.parameter (which imports it for log_warning); routing its DMLC_*
+# reads through the helpers would be a circular import.  The knobs are
+# still inventoried/documented via the helper-based readers elsewhere.
+
 import json
 import logging as _pylogging
 import os

@@ -20,6 +20,28 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Opt-in runtime lock-order checking (the dmlclint lock-discipline rule's
+# dynamic companion): DMLC_LOCKCHECK=1 shims package lock creation so the
+# whole suite doubles as ordering coverage.  Installed before any package
+# import so every lock the modules create at import time is wrapped too.
+if os.environ.get("DMLC_LOCKCHECK") == "1":
+    from dmlc_core_tpu.utils import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
+    def pytest_terminal_summary(terminalreporter, exitstatus, config):
+        _lockcheck.flush()          # land queued metric/flight emission
+        rep = _lockcheck.report()
+        terminalreporter.write_line(
+            "lockcheck: %d lock(s), %d edge(s), %d inversion(s), "
+            "%d long hold(s)" % (rep["locks"], rep["edges"],
+                                 len(rep["inversions"]),
+                                 len(rep["long_holds"])))
+        for inv in rep["inversions"]:
+            terminalreporter.write_line(
+                "lockcheck INVERSION: held %(held)s while acquiring "
+                "%(acquiring)s at %(site)s [%(thread)s]" % inv)
+
 
 def _force_cpu_jax() -> None:
     """The axon register() hook may override jax_platforms via config (which

@@ -1,0 +1,365 @@
+"""dmlclint: golden good/bad snippets per rule, suppressions, CLI.
+
+Each bad snippet is shaped like the historical bug that motivated its
+rule (see docs/analysis.md) — the test suite is the rule's spec.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from dmlc_core_tpu.analysis.core import lint_paths
+from dmlc_core_tpu.analysis.lint import main as lint_main
+from dmlc_core_tpu.analysis import inventory as inv
+
+
+def _lint_snippet(tmp_path, source, rules=None, rel="mod.py"):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    findings, stats, ctx = lint_paths([str(p)], rules=rules,
+                                      repo_root=str(tmp_path))
+    return findings, stats, ctx
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- env-discipline ---------------------------------------------------------
+
+def test_env_raw_reads_flagged(tmp_path):
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import os
+        a = os.environ["DMLC_FOO"]
+        b = os.environ.get("DMLC_BAR")
+        c = os.getenv("DMLC_BAZ", "1")
+        d = os.environ.get("PATH")          # non-DMLC: fine
+    """, rules=["env-discipline"])
+    assert len(findings) == 3
+    assert _rules(findings) == ["env-discipline"]
+    assert sorted(f.line for f in findings) == [2, 3, 4]
+
+
+def test_env_module_constant_indirection(tmp_path):
+    # anomaly.py idiom: ENV_VAR = "DMLC_SLO_SPEC"; os.environ.get(ENV_VAR)
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import os
+        KEY = "DMLC_INDIRECT"
+        v = os.environ.get(KEY)
+    """, rules=["env-discipline"])
+    assert len(findings) == 1
+
+
+def test_env_helpers_are_clean_and_noted(tmp_path):
+    findings, _, ctx = _lint_snippet(tmp_path, """\
+        from dmlc_core_tpu.utils.parameter import env_int, get_env
+        a = get_env("DMLC_GOOD", "x")
+        b = env_int("DMLC_ALSO_GOOD", 3)
+    """, rules=["env-discipline"])
+    assert findings == []
+    assert set(ctx.knob_sites) == {"DMLC_GOOD", "DMLC_ALSO_GOOD"}
+
+
+def test_env_parameter_module_exempt(tmp_path):
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import os
+        raw = os.environ.get("DMLC_INSIDE_HELPER")
+    """, rules=["env-discipline"], rel="utils/parameter.py")
+    assert findings == []
+
+
+# -- metric-vocabulary ------------------------------------------------------
+
+def test_metric_grammar(tmp_path):
+    findings, _, ctx = _lint_snippet(tmp_path, """\
+        from dmlc_core_tpu.utils.metrics import metrics
+        metrics.counter("serving.good_name")
+        metrics.counter("BadName")
+        metrics.gauge("nodots")
+        name = "dynamic." + "x"
+        metrics.counter(name)               # dynamic: skipped
+    """, rules=["metric-vocabulary"])
+    assert len(findings) == 2
+    assert sorted(f.line for f in findings) == [3, 4]
+    assert "serving.good_name" in ctx.metric_sites
+
+
+def _fake_repo(tmp_path, doc, code):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(doc)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(textwrap.dedent(code))
+    return pkg
+
+
+DOC = """\
+## Metric catalog
+
+| Name | Type | Meaning |
+|---|---|---|
+| `app.{hits,misses}` | counter | cache traffic |
+| `app.latency_s` | histogram | request wall time |
+| `anomaly.stall.<stage>` | gauge | per-stage stalls |
+
+| File | Contents |
+|---|---|
+| `incident.json` | not a metric — must not be parsed as one |
+"""
+
+
+def test_metric_doc_two_way_check(tmp_path):
+    pkg = _fake_repo(tmp_path, DOC, """\
+        from dmlc_core_tpu.utils.metrics import metrics
+        metrics.counter("app.hits")
+        metrics.counter("app.misses")
+        metrics.gauge("anomaly.stall.parse")
+        metrics.counter("app.undocumented")
+    """)
+    findings, _, _ = lint_paths([str(pkg)], rules=["metric-vocabulary"],
+                                repo_root=str(tmp_path))
+    msgs = [f.message for f in findings]
+    # app.undocumented missing a row; app.latency_s documented but gone
+    assert any("app.undocumented" in m for m in msgs)
+    assert any("app.latency_s" in m for m in msgs)
+    # braces and wildcards cover; the File table never leaks stale rows
+    assert not any("app.hits" in m for m in msgs)
+    assert not any("anomaly.stall" in m for m in msgs)
+    assert not any("incident.json" in m for m in msgs)
+    assert len(findings) == 2
+
+
+# -- lock-discipline --------------------------------------------------------
+
+def test_lock_mixed_guard_flagged(tmp_path):
+    # the rabit-shaped bug: mutated under the lock in one method, bare in
+    # another (init is exempt — construction has no concurrency yet)
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+                self._gen = 0
+
+            def safe(self):
+                with self._lock:
+                    self._items.append(1)
+                    self._gen = 1
+
+            def racy(self):
+                self._items.append(2)
+                self._gen = 2
+    """, rules=["lock-discipline"])
+    assert len(findings) == 2
+    assert all("without the lock" in f.message for f in findings)
+    assert sorted(f.line for f in findings) == [15, 16]
+
+
+def test_lock_clean_and_locked_convention(tmp_path):
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def _bump_locked(self):
+                # *_locked: caller holds the lock by convention
+                self._n += 1
+    """, rules=["lock-discipline"])
+    assert findings == []
+
+
+# -- atomic-write -----------------------------------------------------------
+
+def test_atomic_write_flagged_and_fixed(tmp_path):
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import json, os
+
+        def bad(path, doc):
+            with open(path, "w") as f:
+                json.dump(doc, f)
+
+        def good(path, doc):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+
+        def read_only(path):
+            with open(path) as f:
+                return f.read()
+    """, rules=["atomic-write"])
+    assert len(findings) == 1
+    assert findings[0].line == 4
+
+
+# -- retrace-hazard ---------------------------------------------------------
+
+def test_retrace_hazards(tmp_path):
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import jax
+
+        @jax.jit
+        def bad(x, n):
+            if n > 0:            # traced branch
+                return x + int(n)    # and a concretizing cast
+            return x
+
+        @jax.jit
+        def shape_ok(x):
+            if x.shape[0] > 8:   # static at trace time
+                return x[:8]
+            return x
+
+        def by_name(x, flag):
+            if flag:
+                return x * 2
+            return x
+
+        fast = jax.jit(by_name, static_argnames=("flag",))
+    """, rules=["retrace-hazard"])
+    assert len(findings) == 2
+    assert sorted(f.line for f in findings) == [5, 6]
+
+
+def test_retrace_partial_static(tmp_path):
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def topk(x, k):
+            if k > 16:           # static: fine
+                k = 16
+            return x[:k]
+    """, rules=["retrace-hazard"])
+    assert findings == []
+
+
+# -- thread-hygiene ---------------------------------------------------------
+
+def test_thread_hygiene(tmp_path):
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import threading
+
+        def fire_and_forget(fn):
+            threading.Thread(target=fn).start()      # bad: no join path
+
+        def daemonized(fn):
+            threading.Thread(target=fn, daemon=True).start()
+
+        class Worker:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+
+            def _run(self):
+                try:
+                    pass
+                except:          # bad: bare except
+                    pass
+    """, rules=["thread-hygiene"])
+    assert len(findings) == 2
+    kinds = sorted(f.message.split(" ")[0] for f in findings)
+    assert any("bare" in f.message for f in findings)
+    assert any("non-daemon" in f.message for f in findings)
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_suppression_same_line_and_next_line(tmp_path):
+    findings, stats, _ = _lint_snippet(tmp_path, """\
+        import os
+        a = os.environ["DMLC_A"]  # dmlclint: disable=env-discipline -- why
+        # dmlclint: disable-next-line=env-discipline -- bootstrap
+        b = os.environ["DMLC_B"]
+        c = os.environ["DMLC_C"]
+    """, rules=["env-discipline"])
+    assert len(findings) == 1 and findings[0].line == 5
+    assert stats["suppressed"] == 2
+
+
+def test_suppression_file_level_and_all(tmp_path):
+    findings, stats, _ = _lint_snippet(tmp_path, """\
+        # dmlclint: disable-file=env-discipline -- legacy module
+        import os
+        a = os.environ["DMLC_A"]
+        b = os.environ["DMLC_B"]
+    """, rules=["env-discipline"])
+    assert findings == []
+    assert stats["suppressed"] == 2
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import os
+        a = os.environ["DMLC_A"]  # dmlclint: disable=all
+    """, rules=["env-discipline"], rel="other.py")
+    assert findings == []
+
+
+def test_suppression_wrong_rule_does_not_hide(tmp_path):
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        import os
+        a = os.environ["DMLC_A"]  # dmlclint: disable=atomic-write
+    """, rules=["env-discipline"])
+    assert len(findings) == 1
+
+
+# -- inventory + CLI --------------------------------------------------------
+
+def test_inventory_round_trip(tmp_path):
+    _, _, ctx = _lint_snippet(tmp_path, """\
+        from dmlc_core_tpu.utils.parameter import get_env
+        from dmlc_core_tpu.utils.metrics import metrics
+        a = get_env("DMLC_KNOB", "x")
+        metrics.counter("sub.metric")
+    """)
+    path = str(tmp_path / "inventory.json")
+    inv.write(ctx, path)
+    doc = inv.load(path)
+    assert doc["schema"] == inv.SCHEMA
+    assert doc["knobs"]["DMLC_KNOB"] == ["mod.py"]
+    assert doc["metrics"]["sub.metric"] == ["mod.py"]
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text('import os\nx = os.environ["DMLC_X"]\n')
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert lint_main([str(good), "--repo-root", str(tmp_path)]) == 0
+    capsys.readouterr()
+    assert lint_main([str(bad), "--json",
+                      "--repo-root", str(tmp_path)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "dmlc.lint.report/1"
+    assert doc["findings"][0]["rule"] == "env-discipline"
+
+
+def test_cli_lists_all_six_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("env-discipline", "metric-vocabulary", "lock-discipline",
+                 "atomic-write", "retrace-hazard", "thread-hygiene"):
+        assert rule in out
+
+
+def test_repo_tree_is_clean():
+    """The acceptance bar: the swept package lints clean."""
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dmlc_core_tpu")
+    findings, stats, _ = lint_paths(
+        [pkg], repo_root=os.path.dirname(pkg))
+    assert findings == [], [repr(f) for f in findings[:10]]

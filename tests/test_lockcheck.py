@@ -1,0 +1,333 @@
+"""lockcheck: runtime lock-order checker + regression tests for the
+real ordering bugs the ISSUE 9 sweep fixed (rabit topology races,
+ingest frame-holder publication).
+"""
+
+import ast
+import os
+import threading
+import time
+
+import pytest
+
+from dmlc_core_tpu.utils import lockcheck
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    # snapshot/restore rather than plain reset: under a DMLC_LOCKCHECK=1
+    # tier-1 run the checker state belongs to the whole process, and this
+    # module's synthetic inversions must not leak into (or wipe) it
+    with lockcheck._meta:
+        saved = (dict(lockcheck._graph), dict(lockcheck._names),
+                 list(lockcheck._inversions), list(lockcheck._long_holds),
+                 set(lockcheck._reported_pairs))
+    lockcheck.reset()
+    yield
+    with lockcheck._meta:
+        lockcheck._graph.clear()
+        lockcheck._graph.update(saved[0])
+        lockcheck._names.clear()
+        lockcheck._names.update(saved[1])
+        lockcheck._inversions[:] = saved[2]
+        lockcheck._long_holds[:] = saved[3]
+        lockcheck._reported_pairs.clear()
+        lockcheck._reported_pairs.update(saved[4])
+    assert lockcheck._held() == [], "test leaked a held-lock entry"
+
+
+def _run(*fns):
+    threads = [threading.Thread(target=fn, daemon=True) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+# -- inversion detection ----------------------------------------------------
+
+def test_two_thread_inversion_detected():
+    a = lockcheck.make_lock("lock-a")
+    b = lockcheck.make_lock("lock-b")
+    first_done = threading.Event()
+
+    def t1():                      # establishes the a → b ordering
+        with a:
+            with b:
+                pass
+        first_done.set()
+
+    def t2():                      # then inverts it: b → a
+        first_done.wait(10)
+        with b:
+            with a:
+                pass
+
+    _run(t1, t2)
+    rep = lockcheck.report()
+    assert len(rep["inversions"]) == 1
+    inv = rep["inversions"][0]
+    assert inv["held"] == "lock-b"
+    assert inv["acquiring"] == "lock-a"
+    assert "test_lockcheck.py" in inv["site"]
+
+
+def test_consistent_ordering_is_clean():
+    a = lockcheck.make_lock("ordered-a")
+    b = lockcheck.make_lock("ordered-b")
+
+    def worker():
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+
+    _run(worker, worker)
+    rep = lockcheck.report()
+    assert rep["inversions"] == []
+    assert rep["edges"] >= 1       # a → b was learned
+
+
+def test_inversion_reported_once_per_pair():
+    a = lockcheck.make_lock("dedup-a")
+    b = lockcheck.make_lock("dedup-b")
+    with a:
+        with b:
+            pass
+    for _ in range(3):
+        with b:
+            with a:
+                pass
+    assert len(lockcheck.report()["inversions"]) == 1
+
+
+def test_three_lock_transitive_cycle():
+    # a→b and b→c recorded; acquiring a while holding c closes the cycle
+    a = lockcheck.make_lock("tri-a")
+    b = lockcheck.make_lock("tri-b")
+    c = lockcheck.make_lock("tri-c")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:
+            pass
+    inv = lockcheck.report()["inversions"]
+    assert len(inv) == 1
+    assert inv[0]["held"] == "tri-c" and inv[0]["acquiring"] == "tri-a"
+
+
+def test_long_hold_flagged(monkeypatch):
+    monkeypatch.setenv("DMLC_LOCKCHECK_HOLD_S", "0.01")
+    slow = lockcheck.make_lock("slow-lock")
+    with slow:
+        time.sleep(0.05)
+    holds = lockcheck.report()["long_holds"]
+    assert any(h["lock"] == "slow-lock" and h["hold_s"] >= 0.01
+               for h in holds)
+
+
+# -- lock protocol compatibility -------------------------------------------
+
+def test_rlock_reentrancy():
+    rl = lockcheck.make_rlock("re-lock")
+    with rl:
+        with rl:
+            assert rl._is_owned()
+    assert not rl._is_owned()
+    assert lockcheck.report()["inversions"] == []
+
+
+def test_condition_on_instrumented_lock():
+    lk = lockcheck.make_lock("cond-lock")
+    cv = threading.Condition(lk)
+    box = []
+
+    def consumer():
+        with cv:
+            while not box:
+                cv.wait(timeout=10)
+            box.append("seen")
+
+    def producer():
+        time.sleep(0.02)
+        with cv:
+            box.append("item")
+            cv.notify()
+
+    _run(consumer, producer)
+    assert box == ["item", "seen"]
+    assert lockcheck.report()["inversions"] == []
+
+
+def test_nonblocking_acquire_failure_records_nothing():
+    lk = lockcheck.make_lock("try-lock")
+    grabbed = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            grabbed.set()
+            release.wait(10)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    grabbed.wait(10)
+    assert lk.acquire(blocking=False) is False
+    release.set()
+    t.join(10)
+    assert lockcheck._held() == []
+
+
+# -- factory scoping --------------------------------------------------------
+
+@pytest.fixture
+def shim():
+    was = lockcheck.installed()
+    lockcheck.install()
+    yield
+    if not was:
+        lockcheck.uninstall()
+
+
+def test_factory_shims_package_code_only(shim):
+    # this test file lives outside the package: stays raw
+    assert not isinstance(threading.Lock(), lockcheck.InstrumentedLock)
+    # same call compiled under a package filename: instrumented + named
+    fake = os.path.join(lockcheck._PKG_DIR, "pipeline",
+                        "_lockcheck_probe.py")
+    ns = {}
+    exec(compile("import threading\nmade = threading.Lock()\n",
+                 fake, "exec"), ns)
+    assert isinstance(ns["made"], lockcheck.InstrumentedLock)
+    assert "pipeline/_lockcheck_probe.py:2" in ns["made"].name
+    # Event() allocates its lock inside threading.py: stays raw
+    ev = threading.Event()
+    assert not isinstance(ev._cond._lock, lockcheck.InstrumentedLock)
+    # the reporting plane stays raw too — instrumenting metrics' own
+    # locks would self-deadlock snapshot() when hold_s is observed
+    fake_metrics = os.path.join(lockcheck._PKG_DIR, "utils", "metrics.py")
+    ns2 = {}
+    exec(compile("import threading\nmade = threading.Lock()\n",
+                 fake_metrics, "exec"), ns2)
+    assert not isinstance(ns2["made"], lockcheck.InstrumentedLock)
+
+
+def test_package_queue_works_under_shim(shim):
+    from dmlc_core_tpu.utils.concurrency import ConcurrentBlockingQueue
+    q = ConcurrentBlockingQueue(max_size=8)
+    got = []
+
+    def pusher():
+        for i in range(32):
+            q.push(i)
+
+    def popper():
+        for _ in range(32):
+            got.append(q.pop(timeout=10))
+
+    _run(pusher, popper)
+    assert sorted(got) == list(range(32))
+    assert lockcheck.report()["inversions"] == []
+
+
+def test_install_uninstall_idempotent():
+    was = lockcheck.installed()
+    lockcheck.install()
+    lockcheck.install()
+    assert lockcheck.installed()
+    if not was:
+        lockcheck.uninstall()
+        assert not lockcheck.installed()
+        assert threading.Lock is lockcheck._REAL_LOCK
+
+
+def test_enabled_parses_env(monkeypatch):
+    monkeypatch.setenv("DMLC_LOCKCHECK", "1")
+    assert lockcheck.enabled()
+    monkeypatch.setenv("DMLC_LOCKCHECK", "0")
+    assert not lockcheck.enabled()
+    monkeypatch.delenv("DMLC_LOCKCHECK")
+    assert not lockcheck.enabled()
+
+
+# -- regressions for the real ordering bugs the sweep fixed -----------------
+
+def _bare_rabit_ctx():
+    from dmlc_core_tpu.parallel.rabit import RabitContext
+    ctx = RabitContext.__new__(RabitContext)
+    ctx._peer_lock = threading.Lock()
+    ctx._target_gen = 0
+    ctx._addresses = {}
+    return ctx
+
+
+def test_rabit_topology_never_rolls_back():
+    # the bug: _register wrote _target_gen/_addresses bare, so a
+    # reset_links push racing ahead of the registration reply was
+    # clobbered with the stale pre-reset topology
+    ctx = _bare_rabit_ctx()
+    ctx._target_gen = 5                         # pushed by reset_links
+    ctx._addresses = {0: ("pushed-host", 9000)}
+    ctx._apply_topology(3, {0: ("stale-host", 1), 1: ("filler", 2)})
+    assert ctx._target_gen == 5
+    assert ctx._addresses[0] == ("pushed-host", 9000)   # kept
+    assert ctx._addresses[1] == ("filler", 2)           # gap filled
+    ctx._apply_topology(7, {0: ("new-host", 3)})
+    assert ctx._target_gen == 7
+    assert ctx._addresses == {0: ("new-host", 3)}
+
+
+def test_rabit_topology_applied_under_peer_lock():
+    ctx = _bare_rabit_ctx()
+
+    class Probe:
+        entered = 0
+
+        def __enter__(self):
+            Probe.entered += 1
+
+        def __exit__(self, *exc):
+            pass
+
+    ctx._peer_lock = Probe()
+    ctx._apply_topology(1, {0: ("h", 1)})
+    assert Probe.entered == 1
+    assert ctx._addresses == {0: ("h", 1)}
+
+
+def test_ingest_frame_holder_published_under_gen_lock():
+    # structural regression: every _frame_holder write outside __init__
+    # must sit inside `with self._gen_lock:` (readers swap the holder's
+    # state from the restart path under that lock)
+    import dmlc_core_tpu.pipeline.ingest_service as mod
+    src = os.path.abspath(mod.__file__)
+    with open(src, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+
+    def is_self_attr(node, attr):
+        return (isinstance(node, ast.Attribute) and node.attr == attr
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    def walk(node, fn_name, under_lock, bad):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_name, under_lock = node.name, False
+        if isinstance(node, ast.With):
+            if any(is_self_attr(item.context_expr, "_gen_lock")
+                   for item in node.items):
+                under_lock = True
+        if isinstance(node, ast.Assign) and fn_name != "__init__":
+            for tgt in node.targets:
+                if is_self_attr(tgt, "_frame_holder") and not under_lock:
+                    bad.append((fn_name, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            walk(child, fn_name, under_lock, bad)
+
+    bad = []
+    walk(tree, "<module>", False, bad)
+    assert bad == [], f"_frame_holder written without _gen_lock: {bad}"
