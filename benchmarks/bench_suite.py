@@ -1209,6 +1209,91 @@ def bench_ingest_scale() -> dict:
     return r
 
 
+def _fleet_ingest_rate(nworkers: int, num_parts: int = 6,
+                       attempts: int = 2) -> float:
+    """One dispatcher + ``nworkers`` data-service worker subprocesses
+    pulling shard leases for a shared dataset; measure aggregate MB/s of
+    fused host frames arriving at a single ``DataServiceLoader``
+    consumer.  Differs from ``_remote_ingest_rate`` in the control
+    plane: parts are leased dynamically (any worker can serve any
+    shard), not statically assigned one-per-worker."""
+    import subprocess
+    import sys as _sys
+    from dmlc_core_tpu.pipeline.data_service import (DataServiceLoader,
+                                                     Dispatcher)
+
+    path = "/tmp/bench_suite.libsvm"
+    _gen_libsvm(path)
+    size_mb = os.path.getsize(path) / MB
+    # generous TTL/heartbeat: a loaded 1-core host must not trip the
+    # chaos machinery (a re-grant mid-bench would double-serve bytes and
+    # corrupt the MB/s number via dup-frame discards)
+    disp = Dispatcher(lease_ttl_s=600.0, heartbeat_timeout_s=120.0)
+    disp.start()
+    workers = [subprocess.Popen(
+        [_sys.executable, "-m", "dmlc_core_tpu.pipeline.data_service.worker",
+         f"127.0.0.1:{disp.port}"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for _ in range(nworkers)]
+    try:
+        deadline = time.monotonic() + 120
+        while len(disp.workers_alive()) < nworkers:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"only {len(disp.workers_alive())}/{nworkers} "
+                    f"data-service workers registered")
+            time.sleep(0.25)
+        spec = {"uri": f"file://{path}", "fmt": "libsvm",
+                "num_parts": num_parts, "batch_rows": 4096,
+                "nnz_cap": 131072}
+        best = 0.0
+        for _ in range(attempts):
+            loader = DataServiceLoader((disp.host, disp.port), spec,
+                                       connect_timeout=120.0, emit="host")
+            frames = 0
+            t0 = time.perf_counter()
+            for _kind, buf, _meta, _rows in loader:
+                frames += 1
+                loader.recycle(buf)
+            dt = time.perf_counter() - t0
+            loader.close()
+            if frames == 0:
+                raise RuntimeError("fleet epoch delivered no frames")
+            best = max(best, size_mb / dt)
+        return best
+    finally:
+        for w in workers:
+            w.kill()
+        disp.stop()
+
+
+def bench_ingest_fleet() -> dict:
+    """Data-service fleet scaling: dispatcher + N leased workers feeding
+    one consumer, N = 1/2/3.  On a multi-core host 3 workers should
+    deliver ≥ 1.6× the 1-worker aggregate MB/s; on a 1-core host every
+    process time-slices the same core, so the curve records the
+    lease/control-plane overhead against the static-assignment baseline,
+    not fleet scaling — stamped via host_cores (same discipline as
+    ingest_worker_scaling)."""
+    import bench
+    cores = bench.host_cores()
+    curve = {}
+    for n in (1, 2, 3):
+        curve[f"workers_{n}"] = round(_fleet_ingest_rate(n), 1)
+    r = {"metric": "ingest_fleet_mb_s", "value": curve["workers_3"],
+         "unit": "MB/s", "curve": curve,
+         "speedup_3v1": round(curve["workers_3"]
+                              / max(1e-9, curve["workers_1"]), 2),
+         "host_cores": cores}
+    if cores < 3:
+        r["note"] = (f"{cores}-core host: dispatcher, consumer and all "
+                     "workers share the core(s); curve measures "
+                     "data-service overhead, not fleet scaling")
+    return r
+
+
 def bench_stream() -> dict:
     """Raw SeekStream read throughput at several buffer sizes (reference
     `test/stream_read_test.cc:16-43` instrumentation) — isolates the L3
@@ -1558,6 +1643,7 @@ ALL = {
     "allreduce": (bench_allreduce, "allreduce_singleton_d2d_bw"),
     "remote_ingest": (bench_remote_ingest, "remote_ingest_2workers"),
     "ingest_scale": (bench_ingest_scale, "ingest_worker_scaling"),
+    "ingest_fleet": (bench_ingest_fleet, "ingest_fleet_mb_s"),
     "csv": (bench_csv, "csv_parse_rowblocks"),
     "cache": (bench_cache_build, "cache_build_replay"),
     "recordio": (bench_recordio, "recordio_partitioned_read"),
@@ -1586,8 +1672,12 @@ CPU_MESH = {"allreduce_mesh8", "sp_mesh8"}
 #  experiment compares host parse/pack rates against themselves.
 #  elastic_reshard is host-path by construction: it measures the control
 #  plane (tracker + loopback sockets + disk), not the device.
+#  ingest_fleet is host-path by construction too: dispatcher, workers and
+#  consumer all live on loopback and the consumer drains host frames —
+#  the number is wire+lease throughput, no device in the loop.
 HOST_ONLY = {"stream", "csv", "recordio", "cache", "higgs", "ingest_cached",
-             "ingest_ragged", "ingest_autotune", "elastic_reshard"}
+             "ingest_ragged", "ingest_autotune", "elastic_reshard",
+             "ingest_fleet"}
 # superseded in the default order (ingest_scale measures workers_2 too);
 # still runnable by explicit name
 DEFAULT_SKIP = {"remote_ingest"}

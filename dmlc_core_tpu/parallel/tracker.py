@@ -43,8 +43,8 @@ from ..telemetry.exposition import TelemetryServer
 from ..utils import DMLCError, check, get_env, get_logger, log_info
 from ..utils.metrics import metrics
 
-__all__ = ["RabitTracker", "PSTracker", "compute_tree", "compute_ring",
-           "recv_json", "send_json"]
+__all__ = ["RabitTracker", "PSTracker", "LivenessBoard", "compute_tree",
+           "compute_ring", "recv_json", "send_json"]
 
 logger = get_logger()
 
@@ -97,6 +97,72 @@ def recv_json(sock_file) -> Optional[dict]:
     return json.loads(line)
 
 
+# ---------------- liveness ----------------
+
+class LivenessBoard:
+    """Heartbeat table + death sweep — the liveness half of the tracker,
+    factored out so every control-plane server speaking the JSON-line
+    protocol (this tracker, the data-service dispatcher in
+    :mod:`dmlc_core_tpu.pipeline.data_service.dispatcher`) runs the same
+    rules: a member is registered by its first beat, declared dead
+    exactly once when silent past the timeout, and revived by any later
+    beat.  Metric emission stays at the caller (each server counts its
+    own dead under its own literal name).
+
+    Owns its own lock; callers holding a coarser server lock may nest
+    board calls inside it (server lock → board lock, one direction only).
+    """
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._last: Dict[str, float] = {}
+        self._dead: set = set()
+
+    def beat(self, member: str) -> bool:
+        """Record a heartbeat (first beat registers the member); True when
+        this beat revived a member previously declared dead — the caller
+        decides what a misdiagnosed slow-but-alive member means."""
+        with self._lock:
+            self._last[member] = time.monotonic()
+            if member in self._dead:
+                self._dead.discard(member)
+                return True
+            return False
+
+    def forget(self, member: str) -> None:
+        """Stop tracking a cleanly-departing member: it stops beating by
+        design and must never be declared dead afterwards."""
+        with self._lock:
+            self._last.pop(member, None)
+            self._dead.discard(member)
+
+    def is_dead(self, member: str) -> bool:
+        with self._lock:
+            return member in self._dead
+
+    def dead_members(self) -> set:
+        with self._lock:
+            return set(self._dead)
+
+    def sweep(self, eligible=None) -> List[Tuple[str, float]]:
+        """Declare members silent past the timeout dead, once each, and
+        return them as ``[(member, silence_seconds)]``.  ``eligible``
+        optionally filters who may be declared (the tracker excludes
+        pre-assignment registrants and completed cohorts)."""
+        now = time.monotonic()
+        newly: List[Tuple[str, float]] = []
+        with self._lock:
+            for member, t in self._last.items():
+                if member in self._dead or now - t <= self.timeout_s:
+                    continue
+                if eligible is not None and not eligible(member):
+                    continue
+                self._dead.add(member)
+                newly.append((member, now - t))
+        return newly
+
+
 # ---------------- tracker ----------------
 
 class _WorkerRecord:
@@ -129,8 +195,7 @@ class RabitTracker:
         if heartbeat_timeout_s is None:
             heartbeat_timeout_s = get_env("DMLC_HEARTBEAT_TIMEOUT", 0.0)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
-        self._last_beat: Dict[str, float] = {}
-        self._dead: set = set()
+        self.liveness = LivenessBoard(self.heartbeat_timeout_s)
         self._monitor_stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -266,9 +331,7 @@ class RabitTracker:
             elif cmd == "shutdown":
                 with self._lock:
                     self._shutdown_count += 1
-                    # a cleanly-exited worker stops beating by design —
-                    # it must not be declared dead afterwards
-                    self._last_beat.pop(str(msg.get("jobid", "")), None)
+                    self.liveness.forget(str(msg.get("jobid", "")))
                     self._lock.notify_all()
             elif cmd == "telemetry":
                 # rank-tagged registry state push; last write per rank wins
@@ -281,14 +344,11 @@ class RabitTracker:
                     self.straggler_board.update(msg.get("rank"), state)
             elif cmd == "heartbeat":
                 jobid = str(msg.get("jobid", ""))
-                with self._lock:
-                    self._last_beat[jobid] = time.monotonic()
-                    if jobid in self._dead:
-                        # slow-but-alive: the monitor misdiagnosed it; the
-                        # next reset/recover round re-links it
-                        self._dead.discard(jobid)
-                        logger.warning("tracker: worker %r revived by "
-                                       "heartbeat", jobid)
+                if self.liveness.beat(jobid):
+                    # slow-but-alive: the monitor misdiagnosed it; the
+                    # next reset/recover round re-links it
+                    logger.warning("tracker: worker %r revived by "
+                                   "heartbeat", jobid)
             elif cmd in ("start", "recover"):
                 self._register_and_reply(conn, msg, recovering=(cmd == "recover"))
             else:
@@ -314,8 +374,7 @@ class RabitTracker:
         with self._lock:
             if self._start_time is None:
                 self._start_time = time.monotonic()
-            self._last_beat[jobid] = time.monotonic()
-            self._dead.discard(jobid)
+            self.liveness.beat(jobid)
             rec = self._workers.get(jobid)
             if rec is None:
                 rec = _WorkerRecord(jobid, host, port)
@@ -371,28 +430,25 @@ class RabitTracker:
         while not self._monitor_stop.wait(interval):
             notify: List[Tuple[str, int]] = []
             reset: Optional[dict] = None
-            now = time.monotonic()
             with self._lock:
                 if not self._assigned:
                     continue
-                newly_dead = [
-                    j for j, t in self._last_beat.items()
-                    if j not in self._dead
-                    and now - t > self.heartbeat_timeout_s
-                    and j in self._workers and self._workers[j].rank >= 0
-                    and self._shutdown_count < self.num_workers]
+                newly_dead = self.liveness.sweep(
+                    eligible=lambda j: (
+                        j in self._workers and self._workers[j].rank >= 0
+                        and self._shutdown_count < self.num_workers))
                 if not newly_dead:
                     continue
-                for j in newly_dead:
-                    self._dead.add(j)
+                for j, silence in newly_dead:
                     metrics.counter("tracker.dead_workers").add(1)
                     logger.warning(
                         "tracker: worker %r (rank %d) missed heartbeats "
                         "for %.1fs — declaring dead", j,
-                        self._workers[j].rank, now - self._last_beat[j])
+                        self._workers[j].rank, silence)
                 self._generation += 1
+                dead = self.liveness.dead_members()
                 notify = [(w.host, w.port) for w in self._workers.values()
-                          if w.jobid not in self._dead and w.rank >= 0]
+                          if w.jobid not in dead and w.rank >= 0]
                 reset = {"cmd": "reset_links",
                          "generation": self._generation,
                          "addresses": {str(w.rank): [w.host, w.port]

@@ -9,10 +9,13 @@ from .page_cache import (PageCacheReader, PageCacheWriter,  # noqa: F401
 from .autotune import (Autotuner, Knob, ingest_knob_space,  # noqa: F401
                        maybe_autotuner, serving_knob_space)
 from .fingerprint import autotune_key, host_shape  # noqa: F401
+from .data_service import (Dispatcher, DataServiceWorker,  # noqa: F401
+                           DataServiceLoader)
 
 __all__ = ["pack_flat", "pack_rowmajor", "batch_slices", "PackStats",
            "serve_ingest", "RemoteIngestLoader", "ingest_worker_main",
            "DeviceLoader", "PageCacheReader", "PageCacheWriter",
            "open_page_reader", "page_path",
            "Autotuner", "Knob", "ingest_knob_space", "serving_knob_space",
-           "maybe_autotuner", "autotune_key", "host_shape"]
+           "maybe_autotuner", "autotune_key", "host_shape",
+           "Dispatcher", "DataServiceWorker", "DataServiceLoader"]

@@ -1,0 +1,36 @@
+"""Disaggregated ingest data service: dispatcher, worker fleet, leases.
+
+The point-to-point remote ingest of :mod:`..ingest_service` scales the
+parse/pack work across hosts but pins partitions to addresses: the
+trainer must know every worker up front and a dead worker takes its
+shard down for the epoch.  This package adds the tf.data-service shape
+on top of the same wire bytes (PAPERS.md: arxiv 2210.14826 /
+2101.12127): a **dispatcher** owns dataset registration and hands out
+dynamic **shard leases** to an elastic **worker** pool, and the
+**client** discovers workers through the dispatcher, streams from all
+of them concurrently, and replays a lost lease through a survivor —
+an epoch completes with every row exactly once despite worker churn.
+
+Roles:
+
+* :class:`~.dispatcher.Dispatcher` — control plane (JSON-line protocol,
+  the `parallel/tracker.py` vocabulary): dataset registry keyed by the
+  relaxed :func:`..fingerprint.autotune_key`, the lease state machine
+  (PENDING → GRANTED → COMPLETED, TTL expiry and worker death both
+  re-grant with a bumped ``lease_epoch``), worker liveness via
+  :class:`~dmlc_core_tpu.parallel.tracker.LivenessBoard`.
+* :class:`~.worker.DataServiceWorker` — auto-registers, heartbeats,
+  pulls leases, serves each shard over the existing ``serve_ingest``
+  frame format (bytes stay in the fused v2/v3 layout; a ``cache`` spec
+  makes every shard replay an mmap of the PR-4 packed-page build).
+* :class:`~.client.DataServiceLoader` — consumer: concurrent per-worker
+  streams, frame-level dedup for replayed leases, mid-epoch failover
+  wired through :mod:`dmlc_core_tpu.utils.retry` breakers.
+"""
+
+from .client import DataServiceLoader  # noqa: F401
+from .dispatcher import Dispatcher, dispatcher_rpc  # noqa: F401
+from .worker import DataServiceWorker  # noqa: F401
+
+__all__ = ["Dispatcher", "DataServiceWorker", "DataServiceLoader",
+           "dispatcher_rpc"]

@@ -1,0 +1,357 @@
+"""Data-service consumer: discover workers, stream shards, fail over.
+
+:class:`DataServiceLoader` is the trainer-facing end of the fleet: it
+registers the dataset spec with the dispatcher (idempotent — the key is
+the relaxed fingerprint, so many consumers share one entry), discovers
+the live workers, and opens one streaming connection per worker.  Every
+worker serves whatever leases it pulls, so the consumer sees the epoch
+as an arrival-ordered interleave of shards — the same relaxed-ordering
+contract as :class:`..ingest_service.RemoteIngestLoader`.
+
+**Exactly-once under churn.**  Shard frame sequences are deterministic
+(single-threaded parse per shard on the worker; the page-cache tests
+pin byte-identical replays), so delivery is idempotent at frame
+granularity: the client counts delivered frames per part, and a
+replayed lease — TTL expiry, worker death, send failure — simply has
+its already-delivered prefix discarded (``data_service.client.
+dup_frames``).  A reader that dies mid-shard reports the in-flight
+lease back (``fail_lease``) so a survivor replays it without waiting
+out the TTL; the epoch ends when every part's shard-end accounting
+closes, every row exactly once.
+
+Failure wiring is the standard resilience vocabulary
+(:mod:`dmlc_core_tpu.utils.retry`, env prefix ``DMLC_DATA_CLIENT``): a
+per-worker :class:`CircuitBreaker` stops redialing a corpse while the
+:class:`RetryPolicy` rides over transient drops; the epoch only fails
+when **all** workers are lost with parts still owed.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+from ...telemetry import trace as teltrace
+from ...utils import check
+from ...utils.faults import fault_point
+from ...utils.logging import DMLCError, get_logger, log_info
+from ...utils.metrics import metrics
+from ...utils.retry import CircuitBreaker, CircuitOpen, RetryPolicy
+from ..device_loader import _BufPool, _fused_words_meta, _put_fused_buf
+from ..ingest_service import _FRAME, _NO_ROWS, _recv_exact
+from .dispatcher import dispatcher_rpc
+from .worker import CTRL_SHARD_BEGIN, CTRL_SHARD_END
+
+__all__ = ["DataServiceLoader"]
+
+logger = get_logger()
+
+
+class DataServiceLoader:
+    """Iterate a data-service dataset; each ``__iter__`` is one epoch.
+
+    ``emit="host"`` (default) yields ``("fused", buf, meta, rows)``
+    items — the FusedTrainer contract; return consumed buffers via
+    :meth:`recycle`.  ``emit="device"`` adds the same fused-buffer
+    ``device_put`` + jitted decode stage the local loaders use and
+    yields device batches.
+
+    ``spec`` is the dataset registration dict: ``uri``, ``fmt``,
+    ``num_parts``, ``batch_rows``, ``nnz_cap`` (required), ``id_mod``,
+    ``wire_compact``, ``cache`` (optional, forwarded to the workers'
+    loaders).
+    """
+
+    def __init__(self, dispatcher: Tuple[str, int], spec: dict, *,
+                 prefetch: int = 4, connect_timeout: float = 30.0,
+                 emit: str = "host"):
+        check(emit in ("host", "device"), f"bad emit {emit!r}")
+        self.dispatcher = (str(dispatcher[0]), int(dispatcher[1]))
+        self.spec = dict(spec)
+        self.batch_rows = int(spec["batch_rows"])
+        self.connect_timeout = float(connect_timeout)
+        self.emit = emit
+        self._depth = max(2, int(prefetch))
+        self._pool = _BufPool(cap=2 * self._depth + 2)
+        self._closed = False
+        self._state_lock = threading.Lock()
+        self._epoch_state: Optional[dict] = None
+        reg = dispatcher_rpc(self.dispatcher,
+                             {"cmd": "register_dataset", "spec": self.spec})
+        self.key: str = reg["key"]
+        self.num_parts: int = int(reg["num_parts"])
+        # a broken stream surfaces as DMLCError (protocol break) as often
+        # as OSError (transport break) — both earn redials; a breaker
+        # fast-fail does not (the cooldown exists to STOP the dialing)
+        self._retry = RetryPolicy.from_env(
+            "DMLC_DATA_CLIENT", name="data_service.client",
+            retryable=lambda e: (isinstance(e, (OSError, DMLCError))
+                                 and not isinstance(e, CircuitOpen)))
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    # -- epoch machinery -------------------------------------------------
+    def _start_epoch(self) -> dict:
+        ep = dispatcher_rpc(self.dispatcher,
+                            {"cmd": "start_epoch", "key": self.key})
+        workers = dispatcher_rpc(self.dispatcher,
+                                 {"cmd": "list_workers"})["workers"]
+        if not workers:
+            raise DMLCError("data service: no live workers registered "
+                            "with the dispatcher")
+        cv = threading.Condition()
+        state = {
+            "cv": cv, "out": [], "stop": False, "socks": [],
+            "epoch": int(ep["epoch"]),
+            "live": len(workers), "errs": [],
+            # exactly-once ledger: frames delivered per part, and the
+            # parts whose shard-end accounting has closed
+            "got": {}, "done": set(),
+        }
+        cap = max(self._depth, len(workers))
+        state["threads"] = [
+            threading.Thread(target=self._read_worker,
+                             args=(state, jobid, (addr[0], int(addr[1])),
+                                   cap),
+                             name=f"ds-read-{jobid}", daemon=True)
+            for jobid, addr in workers.items()]
+        log_info("data service: epoch %d of %s across %d workers",
+                 state["epoch"], self.key, len(workers))
+        for t in state["threads"]:
+            t.start()
+        return state
+
+    def _breaker(self, jobid: str) -> CircuitBreaker:
+        b = self._breakers.get(jobid)
+        if b is None:
+            b = CircuitBreaker.from_env("DMLC_DATA_CLIENT",
+                                        name=f"data_service.{jobid}")
+            self._breakers[jobid] = b
+        return b
+
+    def _read_worker(self, state: dict, jobid: str,
+                     addr: Tuple[str, int], cap: int) -> None:
+        """One reader: stream shards from ``addr`` until the worker's
+        stream-end, retrying transient drops; a lost worker decrements
+        ``live`` and leaves the epoch to the survivors."""
+        cv = state["cv"]
+        breaker = self._breaker(jobid)
+
+        def one_attempt():
+            with cv:
+                if state["stop"]:
+                    return
+            with teltrace.span("data_service.client.stream", worker=jobid,
+                               epoch=state["epoch"]):
+                breaker.call(self._stream_once, state, addr, cap)
+
+        try:
+            self._retry.call(
+                one_attempt,
+                on_retry=lambda attempt, exc: metrics.counter(
+                    "data_service.client.failovers").add(1))
+        except (OSError, DMLCError, CircuitOpen) as e:
+            with cv:
+                if not state["stop"]:
+                    state["errs"].append((jobid, e))
+                    logger.warning("data service: worker %s lost for the "
+                                   "epoch: %r", jobid, e)
+        finally:
+            with cv:
+                state["live"] -= 1
+                cv.notify_all()
+
+    def _stream_once(self, state: dict, addr: Tuple[str, int],
+                     cap: int) -> None:
+        """One connection to one worker: request the stream, then frames
+        until stream-end.  Raises on a broken stream (after reporting the
+        in-flight lease so a survivor replays it promptly)."""
+        cv = state["cv"]
+        sock = socket.create_connection(addr, timeout=self.connect_timeout)
+        sock.settimeout(self.connect_timeout)
+        with cv:
+            if state["stop"]:
+                sock.close()
+                return
+            state["socks"].append(sock)
+        cur: Optional[dict] = None      # in-flight shard on THIS stream
+        try:
+            with sock:
+                from ...parallel.tracker import send_json
+                send_json(sock, {"key": self.key, "epoch": state["epoch"]})
+                while True:
+                    fault_point("data_service.recv")
+                    hdr = _recv_exact(sock, _FRAME.size)
+                    if hdr is None:
+                        raise DMLCError(
+                            f"data-service worker {addr} closed mid-stream")
+                    meta, words, rows = _FRAME.unpack(hdr)
+                    if words == 0:
+                        return                       # worker's stream end
+                    if words == CTRL_SHARD_BEGIN:
+                        cur = {"part": int(meta), "lease_epoch": int(rows),
+                               "idx": 0}
+                        continue
+                    if words == CTRL_SHARD_END:
+                        self._close_shard(state, int(meta), int(rows))
+                        cur = None
+                        continue
+                    if cur is None:
+                        raise DMLCError(
+                            f"data-service worker {addr} sent a data "
+                            f"frame outside a shard")
+                    self._accept_frame(state, cur, sock, meta, words,
+                                       rows, cap)
+        except BaseException:
+            if cur is not None:
+                # a survivor should replay this lease NOW, not after the
+                # TTL: report what we saw break (best-effort; the TTL
+                # sweep remains the backstop)
+                try:
+                    dispatcher_rpc(
+                        self.dispatcher,
+                        {"cmd": "fail_lease", "key": self.key,
+                         "part": cur["part"],
+                         "lease_epoch": cur["lease_epoch"],
+                         "why": "consumer stream broke mid-shard"},
+                        timeout=5.0)
+                except OSError:
+                    pass
+            raise
+
+    def _accept_frame(self, state: dict, cur: dict, sock, meta: int,
+                      words: int, rows: int, cap: int) -> None:
+        """Receive one data frame; deliver it exactly once.  Frames of a
+        replayed shard that were already delivered under an earlier lease
+        are received and dropped — determinism makes the drop safe."""
+        cv = state["cv"]
+        part = cur["part"]
+        expected = _fused_words_meta(self.batch_rows, int(meta))
+        if expected != words:
+            raise DMLCError(
+                f"data-service frame size mismatch: worker sent {words} "
+                f"words but batch_rows={self.batch_rows} implies "
+                f"{expected} — consumer and spec batch_rows differ")
+        buf = self._pool.get(words)
+        view = memoryview(buf)[:words].cast("B")
+        got = 0
+        while got < len(view):
+            r = sock.recv_into(view[got:], len(view) - got)
+            if not r:
+                raise DMLCError("data-service worker died mid-frame")
+            got += r
+        idx = cur["idx"]
+        cur["idx"] += 1
+        with cv:
+            if part in state["done"] or idx < state["got"].get(part, 0):
+                # replayed prefix of a re-granted lease: already delivered
+                self._pool.put(buf)
+                metrics.counter("data_service.client.dup_frames").add(1)
+                return
+            state["got"][part] = idx + 1
+            while len(state["out"]) >= cap and not state["stop"]:
+                cv.wait(timeout=1.0)
+            if state["stop"]:
+                self._pool.put(buf)
+                return
+            state["out"].append(
+                (buf[:words] if len(buf) != words else buf, meta,
+                 None if rows == _NO_ROWS else rows, buf))
+            metrics.counter("data_service.client.frames").add(1)
+            cv.notify_all()
+
+    def _close_shard(self, state: dict, part: int, total: int) -> None:
+        cv = state["cv"]
+        with cv:
+            if part in state["done"]:
+                return
+            if state["got"].get(part, 0) >= total:
+                state["done"].add(part)
+                cv.notify_all()
+            # else: a replaying stream ended a shard whose frames partly
+            # arrived on a stream that died — the lease it replays was
+            # re-granted from frame 0, so a later replay closes it
+
+    # -- consumer surface ------------------------------------------------
+    def __iter__(self):
+        while True:
+            item = self.next_batch()
+            if item is None:
+                return
+            yield item
+
+    def next_batch(self):
+        with self._state_lock:
+            if self._closed:
+                return None
+            if self._epoch_state is None:
+                self._epoch_state = self._start_epoch()
+            state = self._epoch_state
+        cv = state["cv"]
+        while True:
+            with cv:
+                if state["out"]:
+                    frame = state["out"].pop(0)
+                    cv.notify_all()        # free a backpressure slot
+                    break
+                if len(state["done"]) >= self.num_parts:
+                    frame = None           # epoch complete
+                    break
+                if state["live"] == 0 or state["stop"]:
+                    errs = list(state["errs"])
+                    raise DMLCError(
+                        f"data service: epoch incomplete — all workers "
+                        f"lost with {self.num_parts - len(state['done'])} "
+                        f"parts owed (errors: {errs})")
+                cv.wait(timeout=1.0)
+        if frame is None:
+            self._finish_epoch()
+            return None
+        view, meta, rows, buf = frame
+        if self.emit == "host":
+            return ("fused", buf, int(meta), rows)
+        with teltrace.span("data_service.client.h2d",
+                           rows=(None if rows is None else int(rows))):
+            out = _put_fused_buf(view, self.batch_rows, meta)
+            import jax
+            jax.block_until_ready(out)
+        self._pool.put(buf)
+        return out
+
+    def _cancel_readers(self, state: Optional[dict]) -> None:
+        if state is None:
+            return
+        cv = state["cv"]
+        with cv:
+            state["stop"] = True
+            socks = list(state["socks"])
+            cv.notify_all()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in state.get("threads", []):
+            t.join(timeout=5.0)
+
+    def _finish_epoch(self) -> None:
+        with self._state_lock:
+            state, self._epoch_state = self._epoch_state, None
+        self._cancel_readers(state)
+
+    def recycle(self, buf) -> None:
+        """Return a consumed host frame buffer (emit='host' mode)."""
+        self._pool.put(buf)
+
+    def close(self) -> None:
+        with self._state_lock:
+            self._closed = True
+            state, self._epoch_state = self._epoch_state, None
+        self._cancel_readers(state)
+        self._pool.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

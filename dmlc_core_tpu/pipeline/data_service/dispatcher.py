@@ -1,0 +1,409 @@
+"""Data-service dispatcher: dataset registry + shard-lease state machine.
+
+One dispatcher process owns the metadata for a fleet of ingest workers
+(tf.data service's split-provider role, PAPERS.md arxiv 2210.14826): a
+dataset registers once (keyed by the relaxed
+:func:`..fingerprint.autotune_key`, so two consumers naming the same
+source share one entry) and is split into ``num_parts`` shard leases.
+Workers pull leases, serve them, and report completion; the dispatcher
+re-grants a lease whose TTL expired or whose worker died, bumping the
+shard's ``lease_epoch`` so a completion from the old grant — a
+resurrected worker finishing a shard that was already handed to a
+survivor — is recognizably stale and rejected.
+
+Lease state machine (per shard)::
+
+    PENDING ──grant──▶ GRANTED ──complete──▶ COMPLETED
+       ▲                  │ TTL expiry / worker death /
+       └──────regrant─────┘ consumer fail report   (lease_epoch += 1)
+
+The wire protocol is the tracker's JSON-line vocabulary
+(:func:`~dmlc_core_tpu.parallel.tracker.send_json` /
+:func:`~dmlc_core_tpu.parallel.tracker.recv_json`), one request per
+connection; worker liveness rides the same
+:class:`~dmlc_core_tpu.parallel.tracker.LivenessBoard` the rendezvous
+tracker uses.  The dispatcher serves ``/metrics`` via
+``DMLC_DISPATCHER_METRICS_PORT``.
+
+The service assumes one consumer per dataset epoch (the trainer); a new
+pass calls ``start_epoch``, which re-arms every shard with a fresh
+lease epoch.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ...parallel.tracker import LivenessBoard, recv_json, send_json
+from ...telemetry.exposition import TelemetryServer
+from ...utils.logging import DMLCError, get_logger, log_info
+from ...utils.metrics import metrics
+from ...utils.parameter import get_env
+from .. import fingerprint as fingerprint_mod
+
+__all__ = ["Dispatcher", "dispatcher_rpc"]
+
+logger = get_logger()
+
+#: dataset spec keys forwarded to workers verbatim (the DeviceLoader
+#: construction surface); everything else in a register_dataset spec is
+#: ignored so clients can attach annotations without breaking workers
+_SPEC_KEYS = ("uri", "fmt", "num_parts", "batch_rows", "nnz_cap",
+              "id_mod", "wire_compact", "cache")
+
+_PENDING, _GRANTED, _COMPLETED = "pending", "granted", "completed"
+
+
+def dispatcher_rpc(addr: Tuple[str, int], obj: dict,
+                   timeout: float = 30.0) -> dict:
+    """One JSON-line request/response round trip to the dispatcher (or
+    to a worker's control listener — same framing)."""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.settimeout(timeout)
+        send_json(s, obj)
+        reply = recv_json(s.makefile("r"))
+    if reply is None:
+        raise DMLCError(f"dispatcher {addr} closed without replying "
+                        f"to {obj.get('cmd')!r}")
+    if "error" in reply:
+        raise DMLCError(f"dispatcher: {reply['error']}")
+    return reply
+
+
+class _Lease:
+    """One shard's grant bookkeeping (guarded by the dispatcher lock)."""
+
+    __slots__ = ("part", "state", "lease_epoch", "worker", "deadline",
+                 "regrants")
+
+    def __init__(self, part: int):
+        self.part = part
+        self.state = _PENDING
+        self.lease_epoch = 1
+        self.worker: Optional[str] = None
+        self.deadline: Optional[float] = None
+        self.regrants = 0
+
+
+class _Dataset:
+    __slots__ = ("key", "spec", "leases", "epoch")
+
+    def __init__(self, key: str, spec: dict):
+        self.key = key
+        self.spec = spec
+        self.epoch = 1
+        self.leases = [_Lease(p) for p in range(int(spec["num_parts"]))]
+
+
+class Dispatcher:
+    """TCP control-plane server for the ingest data service.
+
+    >>> d = Dispatcher(); d.start()
+    >>> # workers: DataServiceWorker((d.host, d.port)).start()
+    >>> # consumer: DataServiceLoader((d.host, d.port), spec)
+    >>> d.stop()
+
+    ``lease_ttl_s`` (default ``DMLC_LEASE_TTL``, 30 s) bounds how long a
+    granted shard may stay unreported before it is re-granted;
+    ``heartbeat_timeout_s`` (default ``DMLC_DATA_HEARTBEAT_TIMEOUT``,
+    10 s) declares a silent worker dead, which re-grants everything it
+    held immediately instead of waiting out the TTL.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 lease_ttl_s: Optional[float] = None,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 telemetry_port: Optional[int] = None):
+        if lease_ttl_s is None:
+            lease_ttl_s = get_env("DMLC_LEASE_TTL", 30.0)
+        if heartbeat_timeout_s is None:
+            heartbeat_timeout_s = get_env("DMLC_DATA_HEARTBEAT_TIMEOUT",
+                                          10.0)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.liveness = LivenessBoard(self.heartbeat_timeout_s)
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, _Dataset] = {}
+        self._workers: Dict[str, Tuple[str, int]] = {}  # jobid → data addr
+        self._stop_ev = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.host, self.port = self._srv.getsockname()[:2]
+        if telemetry_port is None:
+            p = get_env("DMLC_DISPATCHER_METRICS_PORT", -1)
+            telemetry_port = p if p >= 0 else None
+        self.telemetry: Optional[TelemetryServer] = None
+        if telemetry_port is not None:
+            self.telemetry = TelemetryServer(port=int(telemetry_port))
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Dispatcher":
+        for target, name in ((self._accept_loop, "dispatcher-accept"),
+                             (self._sweep_loop, "dispatcher-sweep")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.telemetry is not None:
+            self.telemetry.start()
+        log_info("data-service dispatcher on %s:%d (lease ttl %.1fs, "
+                 "heartbeat timeout %.1fs)", self.host, self.port,
+                 self.lease_ttl_s, self.heartbeat_timeout_s)
+        return self
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        if self.telemetry is not None:
+            self.telemetry.stop()
+        # shutdown() before close(): close() alone does not wake a thread
+        # blocked inside accept() (see PredictionServer.stop)
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- introspection (tests/ops) --------------------------------------
+    def dataset_status(self, key: str) -> Dict[str, int]:
+        with self._lock:
+            ds = self._datasets[key]
+            out = {"epoch": ds.epoch, "pending": 0, "granted": 0,
+                   "completed": 0,
+                   "regrants": sum(ls.regrants for ls in ds.leases)}
+            for ls in ds.leases:
+                out[ls.state] += 1
+            return out
+
+    def workers_alive(self) -> Dict[str, Tuple[str, int]]:
+        with self._lock:
+            dead = self.liveness.dead_members()
+            return {j: a for j, a in self._workers.items() if j not in dead}
+
+    # -- lease machinery (call under self._lock) ------------------------
+    def _regrant(self, ls: _Lease, why: str) -> None:
+        ls.state = _PENDING
+        ls.lease_epoch += 1
+        ls.worker = None
+        ls.deadline = None
+        ls.regrants += 1
+        metrics.counter("data_service.lease_regrants").add(1)
+        logger.warning("dispatcher: re-granting part %d (%s) — lease "
+                       "epoch now %d", ls.part, why, ls.lease_epoch)
+
+    def _sweep_loop(self) -> None:
+        interval = max(0.05, min(self.lease_ttl_s,
+                                 self.heartbeat_timeout_s) / 4.0)
+        while not self._stop_ev.wait(interval):
+            newly_dead = self.liveness.sweep()
+            now = time.monotonic()
+            with self._lock:
+                for jobid, silence in newly_dead:
+                    metrics.counter("data_service.dead_workers").add(1)
+                    logger.warning("dispatcher: worker %r silent for "
+                                   "%.1fs — declaring dead", jobid, silence)
+                for ds in self._datasets.values():
+                    for ls in ds.leases:
+                        if ls.state != _GRANTED:
+                            continue
+                        if any(ls.worker == j for j, _ in newly_dead):
+                            self._regrant(ls, f"worker {ls.worker} died")
+                        elif ls.deadline is not None and now > ls.deadline:
+                            metrics.counter(
+                                "data_service.leases_expired").add(1)
+                            self._regrant(ls, "ttl expired")
+
+    # -- request handling -----------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop_ev.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(30.0)
+            msg = recv_json(conn.makefile("r"))
+            if msg is None:
+                return
+            reply = self._dispatch(msg)
+            send_json(conn, reply)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            logger.warning("dispatcher connection error: %s", e)
+            try:
+                send_json(conn, {"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, msg: dict) -> dict:
+        cmd = msg.get("cmd")
+        if cmd == "register_worker":
+            return self._cmd_register_worker(msg)
+        if cmd == "deregister_worker":
+            return self._cmd_deregister_worker(msg)
+        if cmd == "heartbeat":
+            self.liveness.beat(str(msg["jobid"]))
+            return {"ok": True}
+        if cmd == "list_workers":
+            return {"workers": {j: list(a) for j, a
+                                in self.workers_alive().items()}}
+        if cmd == "register_dataset":
+            return self._cmd_register_dataset(msg)
+        if cmd == "start_epoch":
+            return self._cmd_start_epoch(msg)
+        if cmd == "next_lease":
+            return self._cmd_next_lease(msg)
+        if cmd == "complete_lease":
+            return self._cmd_complete_lease(msg)
+        if cmd == "fail_lease":
+            return self._cmd_fail_lease(msg)
+        if cmd == "status":
+            return self.dataset_status(str(msg["key"]))
+        return {"error": f"unknown cmd {cmd!r}"}
+
+    def _cmd_register_worker(self, msg: dict) -> dict:
+        jobid = str(msg["jobid"])
+        addr = (str(msg["host"]), int(msg["port"]))
+        with self._lock:
+            self._workers[jobid] = addr
+        self.liveness.beat(jobid)
+        log_info("dispatcher: worker %r registered at %s:%d", jobid, *addr)
+        return {"ok": True}
+
+    def _cmd_deregister_worker(self, msg: dict) -> dict:
+        jobid = str(msg["jobid"])
+        with self._lock:
+            self._workers.pop(jobid, None)
+            # a clean departure re-queues whatever it still held — no need
+            # to wait out the TTL for a worker that said goodbye
+            for ds in self._datasets.values():
+                for ls in ds.leases:
+                    if ls.state == _GRANTED and ls.worker == jobid:
+                        self._regrant(ls, f"worker {jobid} deregistered")
+        self.liveness.forget(jobid)
+        return {"ok": True}
+
+    def _cmd_register_dataset(self, msg: dict) -> dict:
+        spec = {k: msg["spec"][k] for k in _SPEC_KEYS if k in msg["spec"]}
+        for req in ("uri", "fmt", "num_parts", "batch_rows", "nnz_cap"):
+            if req not in spec:
+                return {"error": f"dataset spec missing {req!r}"}
+        key = fingerprint_mod.autotune_key(
+            {k: spec[k] for k in ("uri", "fmt", "num_parts", "batch_rows",
+                                  "nnz_cap") if k in spec},
+            platform="data_service")
+        with self._lock:
+            ds = self._datasets.get(key)
+            if ds is None:
+                ds = _Dataset(key, spec)
+                self._datasets[key] = ds
+                log_info("dispatcher: dataset %s registered (%d parts, "
+                         "uri=%s)", key, len(ds.leases), spec["uri"])
+            return {"key": key, "num_parts": len(ds.leases),
+                    "epoch": ds.epoch}
+
+    def _cmd_start_epoch(self, msg: dict) -> dict:
+        with self._lock:
+            ds = self._datasets[str(msg["key"])]
+            touched = any(ls.state != _PENDING or ls.regrants
+                          for ls in ds.leases)
+            if touched:
+                # re-arm every shard under a fresh lease epoch; grants
+                # still in flight from the previous pass become stale
+                ds.epoch += 1
+                for ls in ds.leases:
+                    ls.state = _PENDING
+                    ls.lease_epoch += 1
+                    ls.worker = None
+                    ls.deadline = None
+            return {"epoch": ds.epoch, "num_parts": len(ds.leases)}
+
+    def _cmd_next_lease(self, msg: dict) -> dict:
+        jobid = str(msg["jobid"])
+        self.liveness.beat(jobid)
+        with self._lock:
+            ds = self._datasets[str(msg["key"])]
+            grant: Optional[_Lease] = None
+            outstanding = False
+            for ls in ds.leases:
+                if ls.state == _PENDING and grant is None:
+                    grant = ls
+                elif ls.state == _GRANTED:
+                    outstanding = True
+            if grant is None:
+                # nothing to hand out: either the epoch is finished, or
+                # grants are in flight elsewhere and may yet be re-granted
+                # — the worker must keep polling so a failed lease finds
+                # a living server
+                return {"status": "wait" if outstanding else "done"}
+            grant.state = _GRANTED
+            grant.worker = jobid
+            grant.deadline = time.monotonic() + self.lease_ttl_s
+            metrics.counter("data_service.leases_granted").add(1)
+            return {"lease": {"part": grant.part,
+                              "lease_epoch": grant.lease_epoch,
+                              "spec": ds.spec}}
+
+    def _cmd_complete_lease(self, msg: dict) -> dict:
+        jobid = str(msg["jobid"])
+        with self._lock:
+            ds = self._datasets[str(msg["key"])]
+            ls = ds.leases[int(msg["part"])]
+            if (ls.state != _GRANTED or ls.worker != jobid
+                    or ls.lease_epoch != int(msg["lease_epoch"])):
+                # a resurrected worker finishing a shard that has since
+                # been re-granted: its delivery raced the replay and must
+                # not mark the shard done under the NEW grant
+                metrics.counter("data_service.stale_completions").add(1)
+                logger.warning(
+                    "dispatcher: stale completion of part %d by %r "
+                    "(lease epoch %s, current %d, state %s) — rejected",
+                    ls.part, jobid, msg["lease_epoch"], ls.lease_epoch,
+                    ls.state)
+                return {"ok": False, "stale": True}
+            ls.state = _COMPLETED
+            ls.worker = None
+            ls.deadline = None
+            metrics.counter("data_service.leases_completed").add(1)
+            return {"ok": True}
+
+    def _cmd_fail_lease(self, msg: dict) -> dict:
+        with self._lock:
+            ds = self._datasets[str(msg["key"])]
+            ls = ds.leases[int(msg["part"])]
+            if ls.lease_epoch != int(msg["lease_epoch"]):
+                return {"ok": False, "stale": True}
+            if ls.state == _PENDING:
+                return {"ok": True}    # already re-queued by the sweep
+            # GRANTED (worker send failed) or COMPLETED (the consumer saw
+            # an incomplete delivery the worker believed it finished —
+            # the consumer's view of arrival is ground truth)
+            self._regrant(ls, str(msg.get("why", "reported failed")))
+            return {"ok": True}

@@ -1,0 +1,324 @@
+"""Data-service ingest worker: pull shard leases, serve fused frames.
+
+A worker is the elastic unit of the fleet: it binds an ephemeral data
+port, registers ``(jobid, host, port)`` with the dispatcher over the
+tracker control plane, heartbeats, and then — per consumer connection —
+pulls shard leases and serves each one with the exact
+``serve_ingest`` framing (:func:`..ingest_service.stream_epoch_frames`),
+so the payload bytes stay in the fused v2/v3 device layout end to end.
+A dataset spec carrying a ``cache`` path (or a ``#cachefile`` URI
+fragment) rides the PR-4 packed-page cache: one packed build on the
+worker feeds every consumer epoch as an mmap replay.
+
+Shards are bracketed by control frames so the consumer can attribute
+frames to leases (and deduplicate a replayed shard)::
+
+    [part u64][0xFFFFFFFE u32][lease_epoch u32]      shard begin
+    ... data frames (serve_ingest wire format) ...
+    [part u64][0xFFFFFFFD u32][frame_count u32]      shard end
+    [0 u64][0 u32][0 u32]                            stream end (epoch done)
+
+A send failure fails the lease back to the dispatcher (re-queued for a
+survivor); a ``FaultInjected`` from the ``data_service.lease`` chaos
+probe hard-kills the whole worker — no goodbye, no lease cleanup —
+which is exactly the process-death schedule the chaos tests replay.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Optional, Tuple
+
+from ...parallel.tracker import recv_json, send_json
+from ...telemetry import trace as teltrace
+from ...utils.faults import FaultInjected, fault_point
+from ...utils.logging import DMLCError, get_logger, log_info
+from ...utils.metrics import metrics
+from ...utils.parameter import get_env
+from ...utils.retry import RetryPolicy
+from ..ingest_service import _FRAME, _send_all, stream_epoch_frames
+from .dispatcher import dispatcher_rpc
+
+__all__ = ["DataServiceWorker", "CTRL_SHARD_BEGIN", "CTRL_SHARD_END",
+           "data_service_worker_main"]
+
+logger = get_logger()
+
+#: sentinel ``words`` values bracketing a shard on the wire.  Real frames
+#: carry their fused size in u32 words here (a value this large would be
+#: a 16 GiB frame); ``words == 0`` stays the stream-end marker.
+CTRL_SHARD_BEGIN = 0xFFFFFFFE
+CTRL_SHARD_END = 0xFFFFFFFD
+
+_jobid_seq = [0]
+_jobid_lock = threading.Lock()
+
+
+def _default_jobid() -> str:
+    with _jobid_lock:
+        _jobid_seq[0] += 1
+        return f"dsw-{socket.gethostname()}-{os.getpid()}-{_jobid_seq[0]}"
+
+
+class DataServiceWorker:
+    """One fleet member: control-plane registration + shard serving.
+
+    >>> w = DataServiceWorker((disp.host, disp.port)).start()
+    >>> ...
+    >>> w.stop()        # clean departure (deregisters, re-queues leases)
+
+    ``kill()`` is the chaos-path teardown: everything closes, nothing is
+    deregistered — the dispatcher finds out via missed heartbeats, the
+    consumer via the broken stream.
+    """
+
+    def __init__(self, dispatcher: Tuple[str, int], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 jobid: Optional[str] = None,
+                 heartbeat_interval_s: Optional[float] = None,
+                 lease_poll_s: float = 0.1):
+        self.dispatcher = (str(dispatcher[0]), int(dispatcher[1]))
+        self.jobid = jobid or _default_jobid()
+        if heartbeat_interval_s is None:
+            # beat ~3x per dispatcher timeout window (same env knob both
+            # sides, so deployments tune one number)
+            heartbeat_interval_s = max(
+                0.05, float(get_env("DMLC_DATA_HEARTBEAT_TIMEOUT",
+                                    10.0)) / 3.0)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.lease_poll_s = float(lease_poll_s)
+        self._stop_ev = threading.Event()
+        self._threads: list = []
+        self._conn_lock = threading.Lock()
+        self._conns: list = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.host, self.port = self._srv.getsockname()[:2]
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "DataServiceWorker":
+        # registration retries ride the standard policy: a worker racing
+        # the dispatcher's bind must dial again, not die
+        RetryPolicy(max_attempts=10, base_delay_s=0.1, max_delay_s=2.0,
+                    retryable=lambda e: isinstance(e, OSError),
+                    name="data_service.register").call(
+            dispatcher_rpc, self.dispatcher,
+            {"cmd": "register_worker", "jobid": self.jobid,
+             "host": self.host, "port": self.port})
+        for target, name in ((self._accept_loop, "dsw-accept"),
+                             (self._heartbeat_loop, "dsw-heartbeat")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        log_info("data-service worker %s serving on %s:%d", self.jobid,
+                 self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        """Clean departure: deregister so held leases re-queue NOW."""
+        if not self._stop_ev.is_set():
+            try:
+                dispatcher_rpc(self.dispatcher,
+                               {"cmd": "deregister_worker",
+                                "jobid": self.jobid}, timeout=5.0)
+            except OSError:
+                pass        # dispatcher gone; nothing to tell
+        self.kill()
+
+    def kill(self) -> None:
+        """Hard death (chaos path): close everything, tell no one."""
+        self._stop_ev.set()
+        # shutdown() wakes the accept loop; close() alone leaves it blocked
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns, self._conns = list(self._conns), []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- control plane ---------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._stop_ev.wait(self.heartbeat_interval_s):
+            try:
+                dispatcher_rpc(self.dispatcher,
+                               {"cmd": "heartbeat", "jobid": self.jobid},
+                               timeout=5.0)
+            except OSError as e:
+                logger.warning("worker %s: heartbeat failed: %s",
+                               self.jobid, e)
+
+    # -- data plane ------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop_ev.is_set():
+            try:
+                conn, addr = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn, addr),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket, addr) -> None:
+        try:
+            conn.settimeout(30.0)
+            req = recv_json(conn.makefile("r"))
+            if req is None:
+                return
+            key = str(req["key"])
+            with teltrace.span("data_service.serve_stream", key=key,
+                               worker=self.jobid, peer=str(addr)) as sp:
+                sp.attrs["shards"] = self._serve_stream(conn, key)
+        except FaultInjected as e:
+            # chaos schedule says this worker dies NOW: no lease cleanup,
+            # no deregistration — the fleet must absorb a real crash
+            logger.warning("worker %s: injected death: %s", self.jobid, e)
+            self.kill()
+        except (OSError, ValueError, KeyError, DMLCError) as e:
+            log_info("worker %s: consumer stream ended early: %r",
+                     self.jobid, e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conn_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def _serve_stream(self, conn: socket.socket, key: str) -> int:
+        """Pull leases for ``key`` until the dispatcher says the epoch is
+        done; serve each over ``conn``.  Returns shards served."""
+        shards = 0
+        while not self._stop_ev.is_set():
+            reply = dispatcher_rpc(
+                self.dispatcher,
+                {"cmd": "next_lease", "key": key, "jobid": self.jobid})
+            if reply.get("status") == "done":
+                _send_all(conn, _FRAME.pack(0, 0, 0))   # stream end
+                return shards
+            lease = reply.get("lease")
+            if lease is None:
+                # grants outstanding elsewhere: hold the stream open so a
+                # re-granted lease can land here, poll again shortly
+                time.sleep(self.lease_poll_s)
+                continue
+            self._serve_shard(conn, key, lease)
+            shards += 1
+        return shards
+
+    def _serve_shard(self, conn: socket.socket, key: str,
+                     lease: dict) -> None:
+        from ...data import create_parser
+        from ..device_loader import DeviceLoader
+        part = int(lease["part"])
+        lease_epoch = int(lease["lease_epoch"])
+        spec = lease["spec"]
+        batch_rows = int(spec["batch_rows"])
+        # chaos probe: an injected error here is a worker death scheduled
+        # between lease grant and first frame — the FaultInjected escalates
+        # to kill() in the connection handler
+        fault_point("data_service.lease")
+        loader = None
+        try:
+            with teltrace.span("data_service.serve_shard", part=part,
+                               lease_epoch=lease_epoch,
+                               worker=self.jobid) as sp:
+                # single-threaded parse per shard: frame sequences must be
+                # deterministic so a survivor's replay is byte-identical
+                # (the consumer dedups by frame index)
+                loader = DeviceLoader(
+                    create_parser(str(spec["uri"]), part,
+                                  int(spec["num_parts"]), str(spec["fmt"]),
+                                  nthreads=1, threaded=False),
+                    batch_rows=batch_rows, nnz_cap=int(spec["nnz_cap"]),
+                    id_mod=int(spec.get("id_mod", 0)),
+                    wire_compact=spec.get("wire_compact", "auto"),
+                    emit="host", cache=spec.get("cache", "auto"))
+                _send_all(conn, _FRAME.pack(part, CTRL_SHARD_BEGIN,
+                                            lease_epoch))
+                frames, sent = stream_epoch_frames(conn, loader,
+                                                   batch_rows, eos=False)
+                _send_all(conn, _FRAME.pack(part, CTRL_SHARD_END, frames))
+                sp.attrs.update(frames=frames, bytes=sent)
+            metrics.counter("data_service.worker.shards").add(1)
+        except (OSError, ValueError, DMLCError) as e:
+            # the consumer did not get this shard: re-queue it for any
+            # living worker (possibly this one, on the next connection).
+            # An injected ingest.send fault lands here too — a mid-shard
+            # send failure is a lease failure, not a process death (only
+            # the data_service.lease probe above models a crash), so the
+            # re-raise is converted off the FaultInjected type
+            logger.warning("worker %s: shard %d send failed (%r) — "
+                           "failing lease", self.jobid, part, e)
+            try:
+                dispatcher_rpc(self.dispatcher,
+                               {"cmd": "fail_lease", "key": key,
+                                "part": part, "lease_epoch": lease_epoch,
+                                "why": f"send failed: {type(e).__name__}"},
+                               timeout=5.0)
+            except OSError:
+                pass            # TTL expiry remains the backstop
+            raise DMLCError(f"shard {part} send failed: {e!r}") from e
+        finally:
+            if loader is not None:
+                loader.close()
+        dispatcher_rpc(self.dispatcher,
+                       {"cmd": "complete_lease", "key": key, "part": part,
+                        "lease_epoch": lease_epoch, "jobid": self.jobid})
+
+
+def data_service_worker_main(argv=None) -> int:
+    """CLI: ``python -m dmlc_core_tpu.pipeline.data_service.worker
+    <dispatcher_host:port> [host=H] [port=N]`` — serve until killed."""
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        print("usage: data_service.worker <dispatcher_host:port> "
+              "[host=H] [port=N]", file=sys.stderr)
+        return 2
+    dhost, dport = args[0].rsplit(":", 1)
+    kw = dict(a.split("=", 1) for a in args[1:])
+    w = DataServiceWorker((dhost, int(dport)),
+                          host=kw.get("host", "127.0.0.1"),
+                          port=int(kw.get("port", 0)))
+    w.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        w.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(data_service_worker_main())

@@ -44,11 +44,12 @@ from ..utils import ThreadedIter, check
 from ..utils.faults import fault_point
 from ..utils.logging import DMLCError, log_info, log_warning
 from ..utils.metrics import metrics
-from ..utils.parameter import get_env
+from ..utils.parameter import env_int, get_env
 from ..utils.retry import RetryPolicy
 from .device_loader import _BufPool, _fused_words_meta, _put_fused_buf
 
-__all__ = ["serve_ingest", "RemoteIngestLoader", "ingest_worker_main"]
+__all__ = ["serve_ingest", "stream_epoch_frames", "RemoteIngestLoader",
+           "ingest_worker_main"]
 
 _FRAME = struct.Struct("<QII")          # meta u64, words u32, rows u32
 _NO_ROWS = 0xFFFFFFFF                   # rows unknown (native packer path)
@@ -68,6 +69,63 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
             return None
         got += r
     return bytes(buf)
+
+
+def stream_epoch_frames(conn: socket.socket, loader, batch_rows: int, *,
+                        stall=None, eos: bool = True) -> Tuple[int, int]:
+    """Send every fused frame ``loader`` yields over ``conn``; the framing
+    half of :func:`serve_ingest`, shared with the data-service worker
+    (:mod:`.data_service.worker`) so both roles put byte-identical frames
+    on the wire.
+
+    Applies the ``DMLC_INGEST_SEND_TIMEOUT`` send timeout (seconds,
+    default 300, 0 disables): a peer that stops draining — a trainer that
+    died mid-epoch — previously left the server blocked in ``sendall``
+    until TCP gave up, stranding the worker for every later consumer.
+    Now the send times out, ``ingest.client_drops`` counts the drop, and
+    the raised timeout returns the caller's listener to serving.
+
+    ``eos=True`` appends the ``words=0`` end-of-stream frame after the
+    loader exhausts; the data-service worker passes ``eos=False`` and
+    brackets each shard with its own control frames instead.  Returns
+    ``(frames_sent, bytes_sent)``.
+    """
+    timeout = env_int("DMLC_INGEST_SEND_TIMEOUT", 300, minimum=0)
+    conn.settimeout(timeout if timeout > 0 else None)
+    frames = 0
+    sent_bytes = 0
+    t_frame = time.monotonic()
+    try:
+        for item in loader:
+            kind, buf, meta, rows = item
+            check(kind == "fused", "host emit must be fused")
+            # chaos probe: an injected error here kills THIS connection
+            # mid-epoch (the consumer-side reader sees a truncated stream
+            # and fails over / restarts), the listener lives on
+            fault_point("ingest.send")
+            # exact fused size, NOT len(buf): recycled pool buffers are
+            # over-sized and their dead tail must not ride the very link
+            # this feature exists to relieve
+            words = _fused_words_meta(batch_rows, int(meta))
+            _send_all(conn, _FRAME.pack(
+                int(meta), words,
+                _NO_ROWS if rows is None else int(rows)))
+            _send_all(conn, memoryview(buf[:words]).cast("B"))
+            loader.recycle(buf)
+            sent_bytes += words * 4
+            frames += 1
+            if stall is not None:
+                now = time.monotonic()
+                stall.observe(now - t_frame)
+                t_frame = now
+        if eos:
+            _send_all(conn, _FRAME.pack(0, 0, 0))  # end of stream
+    except TimeoutError as e:
+        metrics.counter("ingest.client_drops").add(1)
+        log_warning("ingest: peer stopped draining (send timed out after "
+                    "%ss) — dropping connection: %r", timeout, e)
+        raise
+    return frames, sent_bytes
 
 
 def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
@@ -169,32 +227,8 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                         prefetch=int(cfg.get("prefetch", 2)),
                         cache_queue_pages=int(cfg.get("cache_queue", 0)),
                         cache_readahead=cfg.get("cache_readahead"))
-                    frames = 0
-                    t_frame = time.monotonic()
-                    for item in loader:
-                        kind, buf, meta, rows = item
-                        check(kind == "fused", "host emit must be fused")
-                        # chaos probe: an injected error here kills THIS
-                        # connection mid-epoch (the trainer-side reader
-                        # sees a truncated stream and restarts), the
-                        # listener lives on
-                        fault_point("ingest.send")
-                        # exact fused size, NOT len(buf): recycled pool
-                        # buffers are over-sized and their dead tail must
-                        # not ride the very link this feature exists to
-                        # relieve
-                        words = _fused_words_meta(batch_rows, int(meta))
-                        _send_all(conn, _FRAME.pack(
-                            int(meta), words,
-                            _NO_ROWS if rows is None else int(rows)))
-                        _send_all(conn, memoryview(buf[:words]).cast("B"))
-                        loader.recycle(buf)
-                        sent_bytes += words * 4
-                        frames += 1
-                        now = time.monotonic()
-                        stall.observe(now - t_frame)
-                        t_frame = now
-                    _send_all(conn, _FRAME.pack(0, 0, 0))  # end of stream
+                    frames, sent_bytes = stream_epoch_frames(
+                        conn, loader, batch_rows, stall=stall)
                     sp.attrs["frames"] = frames
                     epoch_ok = frames > 0
             except Exception as e:  # noqa: BLE001 — a server: one bad

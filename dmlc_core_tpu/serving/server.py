@@ -212,6 +212,59 @@ class PredictionServer:
     def __exit__(self, *exc):
         self.stop()
 
+    def serve_forever(self, window_s: float = 5.0,
+                      max_windows: Optional[int] = None) -> int:
+        """Block until :meth:`stop` (or ``max_windows`` elapses), driving
+        the **ambient serving autotuner** when ``DMLC_AUTOTUNE`` opts in.
+
+        Each window is one autotune epoch over the live batcher knobs
+        (:func:`~..pipeline.autotune.serving_knob_space` →
+        ``MicroBatcher.apply_knobs``): the objective is windowed
+        QPS / (1 + p99 latency) — higher is better, so the controller
+        climbs toward throughput but a cut trigger that buys QPS by
+        letting requests sit is charged for the latency it costs.  A
+        window with zero traffic (or one cut short by shutdown) is
+        aborted, not judged — idling must never steer the knobs.
+
+        With the wiring off (``DMLC_AUTOTUNE`` unset or ``0``) this is
+        exactly the pre-autotune foreground loop: sleep until stopped,
+        touch nothing.  Returns the number of windows run.
+        """
+        from ..pipeline.autotune import maybe_autotuner, serving_knob_space
+        from ..pipeline.fingerprint import autotune_key
+        tuner = maybe_autotuner(lambda: serving_knob_space(self.batcher),
+                                key=autotune_key(None, platform="serving"),
+                                gate="auto")
+        m_reqs = metrics.throughput("serving.batcher.requests")
+        m_lat = metrics.histogram("serving.latency_s")
+        windows = 0
+        while (not self._stopping
+               and (max_windows is None or windows < max_windows)):
+            if tuner is None:
+                # no-tuner path: plain interruptible sleep, no side effects
+                t0 = time.monotonic()
+                while (not self._stopping
+                       and time.monotonic() - t0 < window_s):
+                    time.sleep(min(0.05, window_s))
+                windows += 1
+                continue
+            tuner.begin_epoch()         # pushes this window's knob values
+            t0 = time.monotonic()
+            base = m_reqs.total
+            while not self._stopping and time.monotonic() - t0 < window_s:
+                time.sleep(min(0.05, window_s))
+            dt = max(1e-9, time.monotonic() - t0)
+            delta = m_reqs.total - base
+            if delta <= 0 or self._stopping:
+                tuner.abort_epoch()
+            else:
+                p99 = float(m_lat.snapshot()["p99"])
+                tuner.end_epoch((delta / dt) / (1.0 + p99))
+            windows += 1
+        if tuner is not None:
+            tuner.abort_epoch()         # drop any half-evaluated mutation
+        return windows
+
     # -- health ----------------------------------------------------------
     @property
     def health(self) -> str:
@@ -440,8 +493,9 @@ def serve_main(argv=None) -> int:
     srv.start()
     print(f"serving on {srv.host}:{srv.port}", flush=True)
     try:
-        while True:
-            time.sleep(3600)
+        # foreground loop doubles as the ambient autotuner driver when
+        # DMLC_AUTOTUNE opts in; otherwise it only sleeps
+        srv.serve_forever()
     except KeyboardInterrupt:
         srv.stop()
     return 0

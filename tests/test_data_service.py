@@ -1,0 +1,383 @@
+"""Disaggregated ingest data service: lease lifecycle, exactly-once
+delivery under worker churn, stale-grant rejection, the stranded-sender
+timeout, and the ambient serving autotune loop.
+
+Chaos schedules ride the fault-injection harness (``DMLC_FAULT_SPEC`` /
+``inject_faults``) — deterministic counts, bounded wall time, every test
+asserting both that the fault fired and that the fleet absorbed it."""
+
+import hashlib
+import socket
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+from dmlc_core_tpu.data import create_parser  # noqa: E402
+from dmlc_core_tpu.pipeline.data_service import (  # noqa: E402
+    DataServiceLoader, DataServiceWorker, Dispatcher, dispatcher_rpc)
+from dmlc_core_tpu.pipeline.device_loader import (  # noqa: E402
+    DeviceLoader, _fused_words_meta, _put_fused_buf)
+from dmlc_core_tpu.utils import clear_faults, inject_faults  # noqa: E402
+from dmlc_core_tpu.utils.metrics import metrics  # noqa: E402
+
+from conftest import free_port, start_ingest_worker  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _counter(name):
+    return metrics.counter(name).value
+
+
+ROWS = 400
+BATCH_ROWS = 32
+NNZ_CAP = 1024
+
+
+def _libsvm(tmp_path, rows=ROWS):
+    """Labels are 1..rows (never 0): fused-frame padding rows carry label
+    0, so a nonzero label identifies a real row unambiguously."""
+    rng = np.random.default_rng(7)
+    path = tmp_path / "ds.libsvm"
+    with open(path, "w") as f:
+        for i in range(rows):
+            idx = np.sort(rng.choice(np.arange(1, 300), size=6,
+                                     replace=False))
+            f.write(f"{i + 1} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    return str(path)
+
+
+def _spec(uri, num_parts):
+    return {"uri": uri, "fmt": "libsvm", "num_parts": num_parts,
+            "batch_rows": BATCH_ROWS, "nnz_cap": NNZ_CAP}
+
+
+def _frame_digest(buf, meta):
+    words = _fused_words_meta(BATCH_ROWS, int(meta))
+    return hashlib.sha1(np.asarray(buf)[:words].tobytes()).hexdigest()
+
+
+def _drain(loader):
+    """Consume one epoch: (label multiset, frame-digest multiset)."""
+    labels, digests = Counter(), Counter()
+    for kind, buf, meta, _rows in loader:
+        assert kind == "fused"
+        digests[_frame_digest(buf, meta)] += 1
+        out = _put_fused_buf(
+            np.asarray(buf)[: _fused_words_meta(BATCH_ROWS, int(meta))],
+            BATCH_ROWS, int(meta))
+        labels.update(int(x) for x in np.asarray(out["labels"])
+                      if int(x) > 0)
+        loader.recycle(buf)
+    return labels, digests
+
+
+def _single_host_baseline(uri, num_parts):
+    """The ground truth a fleet epoch must reproduce: every part served
+    by one local DeviceLoader with the worker's exact parser config."""
+    labels, digests = Counter(), Counter()
+    for part in range(num_parts):
+        loader = DeviceLoader(
+            create_parser(uri, part, num_parts, "libsvm", nthreads=1,
+                          threaded=False),
+            batch_rows=BATCH_ROWS, nnz_cap=NNZ_CAP, emit="host")
+        try:
+            for kind, buf, meta, _rows in loader:
+                digests[_frame_digest(buf, meta)] += 1
+                out = _put_fused_buf(
+                    np.asarray(buf)[: _fused_words_meta(BATCH_ROWS,
+                                                        int(meta))],
+                    BATCH_ROWS, int(meta))
+                labels.update(int(x) for x in np.asarray(out["labels"])
+                              if int(x) > 0)
+        finally:
+            loader.close()
+    return labels, digests
+
+
+def _wait_for(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# lease state machine (dispatcher alone, RPC-level fake workers)
+# ---------------------------------------------------------------------------
+
+def test_expired_lease_regranted_exactly_once(tmp_path):
+    """A granted lease whose TTL lapses is re-queued ONCE with a bumped
+    lease epoch — the sweep must not regrant an already-pending shard on
+    every pass."""
+    uri = _libsvm(tmp_path)
+    e0 = _counter("data_service.leases_expired")
+    with Dispatcher(lease_ttl_s=0.3, heartbeat_timeout_s=60.0) as d:
+        d.start()
+        dispatcher_rpc(d.address, {"cmd": "register_worker", "jobid": "w1",
+                                   "host": "127.0.0.1", "port": 1})
+        key = dispatcher_rpc(d.address, {"cmd": "register_dataset",
+                                         "spec": _spec(uri, 2)})["key"]
+        lease = dispatcher_rpc(d.address, {"cmd": "next_lease", "key": key,
+                                           "jobid": "w1"})["lease"]
+        assert lease["part"] == 0 and lease["lease_epoch"] == 1
+        assert _wait_for(lambda: d.dataset_status(key)["regrants"] == 1,
+                         timeout=5.0), d.dataset_status(key)
+        assert _counter("data_service.leases_expired") - e0 == 1
+        # several sweep intervals later the count must still be one: a
+        # pending shard is not "expired" again and again
+        time.sleep(0.5)
+        assert d.dataset_status(key)["regrants"] == 1
+        # the re-queued shard goes out under the NEW lease epoch and a
+        # completion against it lands
+        lease2 = dispatcher_rpc(d.address, {"cmd": "next_lease",
+                                            "key": key,
+                                            "jobid": "w1"})["lease"]
+        assert lease2["part"] == 0 and lease2["lease_epoch"] == 2
+        ok = dispatcher_rpc(d.address, {"cmd": "complete_lease",
+                                        "key": key, "part": 0,
+                                        "lease_epoch": 2, "jobid": "w1"})
+        assert ok["ok"] is True
+
+
+def test_stale_completion_from_resurrected_worker_rejected(tmp_path):
+    """A worker that went silent, lost its lease to a regrant, and then
+    reports the OLD grant complete must be rejected — the shard now
+    belongs to the new lease epoch."""
+    uri = _libsvm(tmp_path)
+    s0 = _counter("data_service.stale_completions")
+    with Dispatcher(lease_ttl_s=0.3, heartbeat_timeout_s=60.0) as d:
+        d.start()
+        for w in ("w1", "w2"):
+            dispatcher_rpc(d.address, {"cmd": "register_worker", "jobid": w,
+                                       "host": "127.0.0.1", "port": 1})
+        key = dispatcher_rpc(d.address, {"cmd": "register_dataset",
+                                         "spec": _spec(uri, 1)})["key"]
+        dispatcher_rpc(d.address, {"cmd": "next_lease", "key": key,
+                                   "jobid": "w1"})
+        assert _wait_for(lambda: d.dataset_status(key)["regrants"] == 1,
+                         timeout=5.0)
+        # w1 resurrects and finishes the shard it no longer owns
+        stale = dispatcher_rpc(d.address, {"cmd": "complete_lease",
+                                           "key": key, "part": 0,
+                                           "lease_epoch": 1, "jobid": "w1"})
+        assert stale == {"ok": False, "stale": True}
+        assert _counter("data_service.stale_completions") - s0 == 1
+        assert d.dataset_status(key)["completed"] == 0
+        # the survivor's completion under the current epoch stands
+        lease = dispatcher_rpc(d.address, {"cmd": "next_lease", "key": key,
+                                           "jobid": "w2"})["lease"]
+        ok = dispatcher_rpc(d.address, {"cmd": "complete_lease",
+                                        "key": key, "part": 0,
+                                        "lease_epoch":
+                                            lease["lease_epoch"],
+                                        "jobid": "w2"})
+        assert ok["ok"] is True
+        assert d.dataset_status(key)["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: worker death and mid-shard send failure, exactly-once both ways
+# ---------------------------------------------------------------------------
+
+def test_worker_killed_mid_epoch_rows_and_checksums_match(tmp_path,
+                                                          monkeypatch):
+    """DMLC_FAULT_SPEC kills one fleet worker between lease grant and
+    first frame; the epoch must still deliver every row exactly once and
+    every frame byte-identical to the single-host baseline."""
+    uri = _libsvm(tmp_path)
+    base_labels, base_digests = _single_host_baseline(uri, 4)
+    assert set(base_labels) == set(range(1, ROWS + 1))
+    f0 = _counter("faults.data_service.lease.errors")
+    d0 = _counter("data_service.dead_workers")
+    r0 = _counter("data_service.lease_regrants")
+    # the second lease pull anywhere in the fleet dies — a hard kill: no
+    # deregistration, no cleanup; the dispatcher must notice via missed
+    # heartbeats and the consumer via the broken stream
+    monkeypatch.setenv("DMLC_FAULT_SPEC",
+                       "data_service.lease:error=1:times=1:after=1")
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=0.5) as d:
+        d.start()
+        workers = [DataServiceWorker(d.address,
+                                     heartbeat_interval_s=0.1).start()
+                   for _ in range(2)]
+        try:
+            ldr = DataServiceLoader(d.address, _spec(uri, 4))
+            labels, digests = _drain(ldr)
+            ldr.close()
+        finally:
+            for w in workers:
+                w.kill()
+    assert _counter("faults.data_service.lease.errors") - f0 == 1
+    assert labels == base_labels          # every row exactly once
+    assert digests == base_digests        # every frame byte-identical
+    assert _counter("data_service.dead_workers") - d0 >= 1
+    assert _counter("data_service.lease_regrants") - r0 >= 1
+
+
+def test_send_fault_mid_shard_replays_with_dedup(tmp_path):
+    """An ingest.send failure mid-shard fails the lease (worker stays
+    alive); the replay re-serves the shard from frame 0 and the consumer
+    discards the prefix it already delivered."""
+    uri = _libsvm(tmp_path)
+    base_labels, base_digests = _single_host_baseline(uri, 2)
+    dup0 = _counter("data_service.client.dup_frames")
+    fo0 = _counter("data_service.client.failovers")
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=10.0) as d:
+        d.start()
+        with DataServiceWorker(d.address) as w:
+            w.start()
+            # frames 1-2 of the first shard land, frame 3's send dies
+            with inject_faults("ingest.send:error=1:times=1:after=2"):
+                ldr = DataServiceLoader(d.address, _spec(uri, 2))
+                labels, digests = _drain(ldr)
+                assert labels == base_labels
+                assert digests == base_digests
+                # the delivered prefix of the replayed shard was dropped,
+                # not re-yielded
+                assert _counter("data_service.client.dup_frames") - dup0 \
+                    == 2
+                assert _counter("data_service.client.failovers") - fo0 >= 1
+                # the worker survived the fault: the next epoch streams
+                # clean end to end through the same process
+                labels2, digests2 = _drain(ldr)
+                assert labels2 == base_labels
+                assert digests2 == base_digests
+                ldr.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: serve_ingest stranded-sender timeout
+# ---------------------------------------------------------------------------
+
+def test_stranded_consumer_times_out_and_worker_serves_again(tmp_path,
+                                                             monkeypatch):
+    """A consumer that connects and stops draining must not wedge the
+    ingest worker forever: the send times out (DMLC_INGEST_SEND_TIMEOUT),
+    ``ingest.client_drops`` counts it, and the worker serves the next
+    connection in full."""
+    # the payload must overflow what a stalled loopback connection can
+    # swallow in kernel buffers (~4 MB of autotuned sndbuf + the rcvbuf)
+    # or sendall never blocks: ~6 MB of identical dense-ish rows
+    path = tmp_path / "big.libsvm"
+    nrows = 12000
+    body = " ".join(f"{j}:1" for j in range(1, 65))
+    with open(path, "w") as f:
+        for i in range(nrows):
+            f.write(f"{i + 1} {body}\n")
+    monkeypatch.setenv("DMLC_INGEST_SEND_TIMEOUT", "1")
+    c0 = _counter("ingest.client_drops")
+    port = start_ingest_worker(str(path), 0, 1, max_epochs=2,
+                               batch_rows=64, nnz_cap=8192)
+    # the stranded client: tiny receive window, connect, read nothing
+    stuck = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    stuck.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    stuck.connect(("127.0.0.1", port))
+    try:
+        assert _wait_for(
+            lambda: _counter("ingest.client_drops") - c0 == 1,
+            timeout=30.0), "send never timed out"
+    finally:
+        stuck.close()
+    # the worker is back in accept(): the second connection gets the
+    # whole partition
+    from dmlc_core_tpu.pipeline import RemoteIngestLoader
+    rl = RemoteIngestLoader([("127.0.0.1", port)], batch_rows=64,
+                            emit="host")
+    seen = set()
+    try:
+        for kind, buf, meta, _rows in rl:
+            out = _put_fused_buf(
+                np.asarray(buf)[: _fused_words_meta(64, int(meta))],
+                64, int(meta))
+            seen.update(int(x) for x in np.asarray(out["labels"])
+                        if int(x) > 0)
+            rl.recycle(buf)
+    finally:
+        rl.close()
+    assert seen == set(range(1, nrows + 1))
+
+
+# ---------------------------------------------------------------------------
+# satellite: ambient serving autotuner behind serve_forever
+# ---------------------------------------------------------------------------
+
+def _tiny_server():
+    from dmlc_core_tpu.models import SparseLogReg
+    from dmlc_core_tpu.serving import (BucketLadder, InferenceEngine,
+                                       PredictionServer)
+    F = 300
+    model = SparseLogReg(num_features=F)
+    params = {"w": jnp.arange(F, dtype=jnp.float32) / F,
+              "b": jnp.float32(0.5)}
+    eng = InferenceEngine(model, params, buckets=BucketLadder([(8, 256)]))
+    return PredictionServer(eng, warmup=False)
+
+
+def test_serve_forever_without_autotune_is_inert(monkeypatch):
+    """DMLC_AUTOTUNE unset (and =0): the foreground loop only sleeps —
+    no tuner epochs, no knob movement."""
+    for gate in (None, "0"):
+        if gate is None:
+            monkeypatch.delenv("DMLC_AUTOTUNE", raising=False)
+        else:
+            monkeypatch.setenv("DMLC_AUTOTUNE", gate)
+        srv = _tiny_server().start()
+        try:
+            e0 = _counter("autotune.epochs")
+            d0 = srv.batcher.max_delay_s
+            assert srv.serve_forever(window_s=0.02, max_windows=2) == 2
+            assert _counter("autotune.epochs") == e0
+            assert srv.batcher.max_delay_s == d0
+        finally:
+            srv.stop()
+
+
+def test_serve_forever_drives_serving_autotuner(monkeypatch):
+    """DMLC_AUTOTUNE=1: each traffic-bearing window is one judged tuner
+    epoch over the live batcher knobs; idle windows abort instead."""
+    monkeypatch.setenv("DMLC_AUTOTUNE", "1")
+    srv = _tiny_server().start()
+    e0 = _counter("autotune.epochs")
+    a0 = _counter("autotune.aborted")
+    stop = threading.Event()
+
+    def traffic():
+        ids = np.array([1, 2, 3], dtype=np.int32)
+        vals = np.ones(3, dtype=np.float32)
+        ptr = np.array([0, 3], dtype=np.int32)
+        while not stop.is_set():
+            try:
+                srv.batcher.submit(ids, vals, row_ptr=ptr).result(timeout=2)
+            except Exception:       # noqa: BLE001 — shutdown race only
+                return
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.1)             # first requests land before window 1
+        n = srv.serve_forever(window_s=0.2, max_windows=3)
+        assert n == 3
+        judged = _counter("autotune.epochs") - e0
+        aborted = _counter("autotune.aborted") - a0
+        assert judged >= 2          # live traffic windows were judged
+        assert judged + aborted >= 3
+        assert metrics.gauge("autotune.objective").value > 0
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+        srv.stop()
