@@ -1209,6 +1209,44 @@ def bench_ingest_scale() -> dict:
     return r
 
 
+def _merge_child_telemetry(tag: str, states=None, trace_files=()) -> None:
+    """Fold child-process telemetry into parent artifacts when
+    ``--telemetry-out`` is live: ``<prefix>_<tag>.fleet_metrics.json``
+    (``merge_states`` over the rank-tagged registry states) and
+    ``<prefix>_<tag>.fleet_trace.json`` (child Chrome traceEvents
+    concatenated into one Perfetto-openable timeline).  Never raises —
+    telemetry must not fail a bench."""
+    prefix = os.environ.get("DMLC_TELEMETRY_OUT")
+    if not prefix:
+        return
+    try:
+        from dmlc_core_tpu import telemetry
+        if states:
+            path = f"{prefix}_{tag}.fleet_metrics.json"
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"ranks": sorted(states),
+                           "merged": telemetry.merge_states(states)},
+                          f, indent=2, sort_keys=True, default=str)
+            os.replace(tmp, path)
+        events = []
+        for p in trace_files:
+            try:
+                with open(p, "r", encoding="utf-8") as f:
+                    events.extend(json.load(f).get("traceEvents", []))
+            except (OSError, ValueError):
+                continue  # child died before its dump — merge the rest
+        if events:
+            path = f"{prefix}_{tag}.fleet_trace.json"
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+                          f)
+            os.replace(tmp, path)
+    except Exception as e:  # noqa: BLE001 — telemetry never fails a run
+        log(f"fleet telemetry merge failed: {e}")
+
+
 def _fleet_ingest_rate(nworkers: int, num_parts: int = 6,
                        attempts: int = 2) -> float:
     """One dispatcher + ``nworkers`` data-service worker subprocesses
@@ -1264,8 +1302,42 @@ def _fleet_ingest_rate(nworkers: int, num_parts: int = 6,
             best = max(best, size_mb / dt)
         return best
     finally:
-        for w in workers:
-            w.kill()
+        if os.environ.get("DMLC_TELEMETRY_OUT"):
+            # grab the heartbeat-pushed registry states BEFORE teardown,
+            # then SIGTERM (not SIGKILL) so each worker's exit hook dumps
+            # its own metrics/trace pair for the fleet merge
+            try:
+                states = disp.worker_states()
+            except Exception:  # noqa: BLE001 — telemetry never fails a run
+                states = {}
+            for w in workers:
+                w.terminate()
+            for w in workers:
+                try:
+                    w.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    w.kill()
+            prefix = os.environ["DMLC_TELEMETRY_OUT"]
+            # the exit-dump state sidecars are authoritative: complete
+            # final states, present even when the run ended before any
+            # heartbeat push reached the dispatcher.  Counting a worker
+            # via BOTH its sidecar and its heartbeat state would double
+            # its counters in the merge, so sidecars replace wholesale.
+            sidecars = {}
+            for w in workers:
+                try:
+                    p = f"{prefix}.dsworker.{w.pid}.state.json"
+                    with open(p, "r", encoding="utf-8") as f:
+                        sidecars[f"pid{w.pid}"] = json.load(f)
+                except (OSError, ValueError):
+                    continue
+            _merge_child_telemetry(
+                f"ingest_fleet.{nworkers}w", states=sidecars or states,
+                trace_files=[f"{prefix}.dsworker.{w.pid}.trace.json"
+                             for w in workers])
+        else:
+            for w in workers:
+                w.kill()
         disp.stop()
 
 
@@ -1530,6 +1602,16 @@ else:                                    # the old path: full reload
     wall = time.perf_counter() - t0
     print("WALL %d %.6f 0 0 0" % (ctx.rank, wall), flush=True)
 ctx.shutdown()
+import os
+_prefix = os.environ.get("DMLC_TELEMETRY_OUT")
+if _prefix:                              # --telemetry-out parity: each
+    import json                          # rank leaves a metrics/trace
+    from dmlc_core_tpu import telemetry  # pair + mergeable state for the
+    from dmlc_core_tpu.utils.metrics import metrics  # parent fleet merge
+    _p = "%s.reshard.%s.%s" % (_prefix, mode, jobid)
+    telemetry.dump_artifacts(_p)
+    with open(_p + ".state.json", "w") as f:
+        json.dump(metrics.state(), f, default=str)
 """
 
 
@@ -1590,11 +1672,32 @@ def bench_elastic_reshard() -> dict:
                         reborn = (int(b), int(fp), int(fc))
         return max(walls.values()), reborn
 
-    with tempfile.TemporaryDirectory(prefix="bench_reshard_") as tmp:
-        CheckpointManager(tmp).save(0, state)
-        reload_wall, _ = cohort(tmp, "reload")
-        reshard_wall, (bytes_moved, from_peers, from_ckpt) = cohort(
-            tmp, "reshard")
+    try:
+        with tempfile.TemporaryDirectory(prefix="bench_reshard_") as tmp:
+            CheckpointManager(tmp).save(0, state)
+            reload_wall, _ = cohort(tmp, "reload")
+            reshard_wall, (bytes_moved, from_peers, from_ckpt) = cohort(
+                tmp, "reshard")
+    finally:
+        # --telemetry-out parity: fold whatever rank dumps made it to
+        # disk (even from a cohort that died mid-run) into one merged
+        # snapshot + Chrome trace for the whole bench
+        prefix = os.environ.get("DMLC_TELEMETRY_OUT")
+        if prefix:
+            states = {}
+            for mode in ("reload", "reshard"):
+                for i in range(world):
+                    p = f"{prefix}.reshard.{mode}.b{i}.state.json"
+                    try:
+                        with open(p, "r", encoding="utf-8") as f:
+                            states[f"{mode}.b{i}"] = json.load(f)
+                    except (OSError, ValueError):
+                        continue
+            _merge_child_telemetry(
+                "elastic_reshard", states=states,
+                trace_files=[f"{prefix}.reshard.{mode}.b{i}.trace.json"
+                             for mode in ("reload", "reshard")
+                             for i in range(world)])
 
     return {"metric": "reshard_wall_s", "value": round(reshard_wall, 4),
             "unit": "s", "state_mb": round(nbytes / MB, 1), "world": world,

@@ -106,9 +106,10 @@ class ParsedModule:
 class LintContext:
     """Shared run state: repo layout + cross-file data rules accumulate.
 
-    ``knob_sites`` / ``metric_sites`` are populated by the env/metric
-    rules during ``check_module`` and consumed both by their
-    ``finalize`` doc cross-checks and by the inventory generator.
+    ``knob_sites`` / ``metric_sites`` / ``span_sites`` are populated by
+    the env/metric/span rules during ``check_module`` and consumed both
+    by their ``finalize`` doc cross-checks and by the inventory
+    generator.
     """
 
     def __init__(self, repo_root: str, docs_dir: Optional[str] = None,
@@ -121,6 +122,8 @@ class LintContext:
         self.knob_sites: Dict[str, Set[str]] = {}
         #: literal metric name → sorted set of repo-relative files
         self.metric_sites: Dict[str, Set[str]] = {}
+        #: literal span name → sorted set of repo-relative files
+        self.span_sites: Dict[str, Set[str]] = {}
         #: modules visited this run (rel paths) — finalize-time scoping
         self.modules: List[str] = []
         #: True when a whole directory was linted — cross-file checks
@@ -132,6 +135,9 @@ class LintContext:
 
     def note_metric(self, name: str, rel: str) -> None:
         self.metric_sites.setdefault(name, set()).add(rel)
+
+    def note_span(self, name: str, rel: str) -> None:
+        self.span_sites.setdefault(name, set()).add(rel)
 
 
 class LintRule:
@@ -166,7 +172,7 @@ def lint_rule(name: str, description: str = ""):
 def _load_builtin_rules() -> None:
     # import for registration side effects; idempotent via the registry
     from . import (rules_env, rules_io, rules_jit,  # noqa: F401
-                   rules_locks, rules_metrics, rules_threads)
+                   rules_locks, rules_metrics, rules_spans, rules_threads)
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterable[str]:

@@ -2,8 +2,8 @@
 
 ``docs/inventory.json`` is generated from the lint run's collected
 vocabulary (every ``DMLC_*`` env key reaching an env-read call, every
-literal metric name) and committed, so a PR that adds or retires a knob
-shows the change as a reviewable diff — the same shape as the
+literal metric name, every literal span name) and committed, so a PR
+that adds or retires a knob shows the change as a reviewable diff — the same shape as the
 ``BENCH_*.json`` trajectory that ``check_regression.py`` gates.
 
 ``env-discipline``'s finalize pass fails the lint when code and
@@ -32,6 +32,8 @@ def build(ctx: LintContext) -> Dict[str, Any]:
         "knobs": {k: sorted(v) for k, v in sorted(ctx.knob_sites.items())},
         "metrics": {k: sorted(v)
                     for k, v in sorted(ctx.metric_sites.items())},
+        "spans": {k: sorted(v)
+                  for k, v in sorted(ctx.span_sites.items())},
     }
 
 

@@ -30,11 +30,13 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from ...telemetry import trace as teltrace
 from ...utils import check
 from ...utils.faults import fault_point
+from ...utils.parameter import get_env
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
 from ...utils.retry import CircuitBreaker, CircuitOpen, RetryPolicy
@@ -89,6 +91,12 @@ class DataServiceLoader:
             retryable=lambda e: (isinstance(e, (OSError, DMLCError))
                                  and not isinstance(e, CircuitOpen)))
         self._breakers: Dict[str, CircuitBreaker] = {}
+        # fleet-console feedback loop: rate-limited best-effort backlog
+        # pushes to the dispatcher (<= 0 disables)
+        self._stats_interval = float(
+            get_env("DMLC_DATA_CLIENT_STATS_INTERVAL", 1.0))
+        self._last_push = 0.0
+        self._batches = 0
 
     # -- epoch machinery -------------------------------------------------
     def _start_epoch(self) -> dict:
@@ -107,6 +115,10 @@ class DataServiceLoader:
             # exactly-once ledger: frames delivered per part, and the
             # parts whose shard-end accounting has closed
             "got": {}, "done": set(),
+            # the consumer's ambient trace context, captured here so the
+            # reader threads (fresh contextvars) can re-activate it —
+            # this is the link that makes one trace span all three tiers
+            "trace": teltrace.current(),
         }
         cap = max(self._depth, len(workers))
         state["threads"] = [
@@ -141,15 +153,20 @@ class DataServiceLoader:
             with cv:
                 if state["stop"]:
                     return
-            with teltrace.span("data_service.client.stream", worker=jobid,
-                               epoch=state["epoch"]):
-                breaker.call(self._stream_once, state, addr, cap)
+            try:
+                with teltrace.activate(state.get("trace")), \
+                        teltrace.span("data_service.client.stream",
+                                      worker=jobid, epoch=state["epoch"]):
+                    breaker.call(self._stream_once, state, addr, cap)
+            finally:
+                self._publish_breaker_gauges()
+
+        def on_retry(attempt, exc):
+            metrics.counter("data_service.client.failovers").add(1)
+            metrics.counter("data_service.client.redials").add(1)
 
         try:
-            self._retry.call(
-                one_attempt,
-                on_retry=lambda attempt, exc: metrics.counter(
-                    "data_service.client.failovers").add(1))
+            self._retry.call(one_attempt, on_retry=on_retry)
         except (OSError, DMLCError, CircuitOpen) as e:
             with cv:
                 if not state["stop"]:
@@ -157,9 +174,23 @@ class DataServiceLoader:
                     logger.warning("data service: worker %s lost for the "
                                    "epoch: %r", jobid, e)
         finally:
+            self._publish_breaker_gauges()
             with cv:
                 state["live"] -= 1
                 cv.notify_all()
+
+    def _publish_breaker_gauges(self) -> None:
+        """Mirror per-worker resilience state into gauges: operators see
+        which redial paths are fast-failing without scraping logs.  The
+        per-worker gauge name embeds the jobid (a bounded set — one per
+        fleet member this consumer ever dialed)."""
+        n_open = 0
+        for jobid, b in list(self._breakers.items()):
+            is_open = 1.0 if b.state == "open" else 0.0
+            n_open += int(is_open)
+            metrics.gauge(
+                f"data_service.client.breaker_open.{jobid}").set(is_open)
+        metrics.gauge("data_service.client.breakers_open").set(float(n_open))
 
     def _stream_once(self, state: dict, addr: Tuple[str, int],
                      cap: int) -> None:
@@ -178,7 +209,12 @@ class DataServiceLoader:
         try:
             with sock:
                 from ...parallel.tracker import send_json
-                send_json(sock, {"key": self.key, "epoch": state["epoch"]})
+                # pack trace ids unconditionally: zero trace_id is the
+                # wire's 'untraced' marker (the worker roots its own
+                # local trace in that case)
+                tid, sid = teltrace.wire_ids()
+                send_json(sock, {"key": self.key, "epoch": state["epoch"],
+                                 "trace_id": tid, "parent_span": sid})
                 while True:
                     fault_point("data_service.recv")
                     hdr = _recv_exact(sock, _FRAME.size)
@@ -304,6 +340,9 @@ class DataServiceLoader:
                         f"lost with {self.num_parts - len(state['done'])} "
                         f"parts owed (errors: {errs})")
                 cv.wait(timeout=1.0)
+        if frame is not None:
+            self._batches += 1
+        self._maybe_push_stats(state, force=frame is None)
         if frame is None:
             self._finish_epoch()
             return None
@@ -317,6 +356,28 @@ class DataServiceLoader:
             jax.block_until_ready(out)
         self._pool.put(buf)
         return out
+
+    def _maybe_push_stats(self, state: dict, force: bool = False) -> None:
+        """Best-effort, rate-limited backlog push so the dispatcher's
+        ``/fleet`` board shows consumer-side pressure next to the worker
+        rates.  Never allowed to hurt the epoch: short timeout, errors
+        swallowed (the board just shows a stale row)."""
+        if self._stats_interval <= 0:
+            return
+        now = time.monotonic()
+        if not force and (now - self._last_push) < self._stats_interval:
+            return
+        self._last_push = now
+        with state["cv"]:
+            backlog = len(state["out"])
+        metrics.gauge("data_service.client.backlog").set(float(backlog))
+        try:
+            dispatcher_rpc(self.dispatcher,
+                           {"cmd": "consumer_stats", "key": self.key,
+                            "backlog": backlog, "batches": self._batches},
+                           timeout=2.0)
+        except OSError:
+            pass
 
     def _cancel_readers(self, state: Optional[dict]) -> None:
         if state is None:

@@ -23,7 +23,15 @@ The wire protocol is the tracker's JSON-line vocabulary
 connection; worker liveness rides the same
 :class:`~dmlc_core_tpu.parallel.tracker.LivenessBoard` the rendezvous
 tracker uses.  The dispatcher serves ``/metrics`` via
-``DMLC_DISPATCHER_METRICS_PORT``.
+``DMLC_DISPATCHER_METRICS_PORT``, plus two dispatcher-only views on the
+same exporter: ``/leases`` (the lease-lifecycle ledger — every
+transition as a structured event in a bounded ring, ``DMLC_LEASE_LEDGER_CAP``)
+and ``/fleet`` (the worker-fleet console: per-worker throughput from
+heartbeat-ridden metric pushes, live leases, heartbeat age, consumer
+backlog, straggler flags; ``?format=text|html`` renders the status
+board).  RPCs carrying non-zero ``trace_id``/``parent_span`` ids (see
+:func:`dispatcher_rpc`) are handled under a span parented to the remote
+caller, so a consumer's trace reaches the lease grant that fed it.
 
 The service assumes one consumer per dataset epoch (the trainer); a new
 pass calls ``start_epoch``, which re-arms every shard with a fresh
@@ -35,9 +43,14 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 from ...parallel.tracker import LivenessBoard, recv_json, send_json
+from ...telemetry import flight as flight_mod
+from ...telemetry import trace as teltrace
+from ...telemetry.aggregate import state_to_snapshot
+from ...telemetry.anomaly import StragglerBoard
 from ...telemetry.exposition import TelemetryServer
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
@@ -60,7 +73,18 @@ _PENDING, _GRANTED, _COMPLETED = "pending", "granted", "completed"
 def dispatcher_rpc(addr: Tuple[str, int], obj: dict,
                    timeout: float = 30.0) -> dict:
     """One JSON-line request/response round trip to the dispatcher (or
-    to a worker's control listener — same framing)."""
+    to a worker's control listener — same framing).
+
+    When the caller is inside an active span, its trace ids ride the
+    request as ``trace_id``/``parent_span`` (the serving wire's header
+    convention, expressed as optional JSON keys): the dispatcher handles
+    the command under a span parented to the caller, so one Perfetto
+    trace follows a request across tiers.  Untraced callers send nothing
+    extra and the server stays untraced — zero ids never create spans.
+    """
+    tid, sid = teltrace.wire_ids()
+    if tid and "trace_id" not in obj:
+        obj = {**obj, "trace_id": tid, "parent_span": sid}
     with socket.create_connection(addr, timeout=timeout) as s:
         s.settimeout(timeout)
         send_json(s, obj)
@@ -128,6 +152,17 @@ class Dispatcher:
         self._lock = threading.Lock()
         self._datasets: Dict[str, _Dataset] = {}
         self._workers: Dict[str, Tuple[str, int]] = {}  # jobid → data addr
+        # lease-lifecycle ledger: every transition as a structured event
+        # in a bounded ring — /leases serves it, the flight recorder
+        # snapshots it into incident bundles
+        self._ledger: deque = deque(
+            maxlen=max(16, int(get_env("DMLC_LEASE_LEDGER_CAP", 2048))))
+        # fleet console state: latest heartbeat-ridden metric push per
+        # worker, beat wall-times, and consumer backlog reports
+        self._worker_states: Dict[str, dict] = {}
+        self._last_beat: Dict[str, float] = {}
+        self._consumers: Dict[str, Dict[str, Any]] = {}
+        self.straggler_board = StragglerBoard()
         self._stop_ev = threading.Event()
         self._threads: List[threading.Thread] = []
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -140,7 +175,10 @@ class Dispatcher:
             telemetry_port = p if p >= 0 else None
         self.telemetry: Optional[TelemetryServer] = None
         if telemetry_port is not None:
-            self.telemetry = TelemetryServer(port=int(telemetry_port))
+            self.telemetry = TelemetryServer(
+                port=int(telemetry_port),
+                leases_fn=self.ledger_snapshot,
+                fleet_fn=self.fleet_snapshot)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -155,6 +193,9 @@ class Dispatcher:
             self._threads.append(t)
         if self.telemetry is not None:
             self.telemetry.start()
+        # incident bundles dumped in this process carry the lease ledger
+        # — a churn postmortem reads transitions, not log archaeology
+        flight_mod.register_contributor("lease_ledger", self.ledger_snapshot)
         log_info("data-service dispatcher on %s:%d (lease ttl %.1fs, "
                  "heartbeat timeout %.1fs)", self.host, self.port,
                  self.lease_ttl_s, self.heartbeat_timeout_s)
@@ -162,6 +203,7 @@ class Dispatcher:
 
     def stop(self) -> None:
         self._stop_ev.set()
+        flight_mod.unregister_contributor("lease_ledger")
         if self.telemetry is not None:
             self.telemetry.stop()
         # shutdown() before close(): close() alone does not wake a thread
@@ -199,14 +241,108 @@ class Dispatcher:
             dead = self.liveness.dead_members()
             return {j: a for j, a in self._workers.items() if j not in dead}
 
+    def worker_states(self) -> Dict[str, dict]:
+        """Latest per-worker registry states pushed on heartbeats (the
+        fleet console's raw material; benches merge these for the
+        child-process telemetry that would otherwise die with the kill)."""
+        with self._lock:
+            return dict(self._worker_states)
+
+    def ledger_snapshot(self) -> Dict[str, Any]:
+        """The ``/leases`` body: the transition event ring plus the live
+        lease table — enough to reconstruct a per-shard timeline."""
+        with self._lock:
+            events = list(self._ledger)
+            now = time.monotonic()
+            leases: Dict[str, List[Dict[str, Any]]] = {}
+            for key, ds in self._datasets.items():
+                leases[key] = [
+                    {"part": ls.part, "state": ls.state,
+                     "lease_epoch": ls.lease_epoch, "worker": ls.worker,
+                     "regrants": ls.regrants,
+                     "ttl_remaining_s": (round(ls.deadline - now, 3)
+                                         if ls.deadline is not None
+                                         else None)}
+                    for ls in ds.leases]
+        return {"schema": "dmlc.data_service.leases/1", "ts": time.time(),
+                "events": events, "leases": leases}
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """The ``/fleet`` body: per-worker throughput / leases /
+        heartbeat age / straggler flags, consumer backlog, dataset
+        progress.  A dead worker flips ``alive`` within one liveness
+        sweep of the heartbeat timeout."""
+        try:
+            suspects = set(self.straggler_board.suspects())
+        except Exception:   # <3 workers / no pushes yet — board is moot
+            suspects = set()
+        now = time.monotonic()
+        with self._lock:
+            dead = self.liveness.dead_members()
+            held: Dict[str, int] = {}
+            datasets: Dict[str, Dict[str, Any]] = {}
+            for key, ds in self._datasets.items():
+                status = {"epoch": ds.epoch, "pending": 0, "granted": 0,
+                          "completed": 0}
+                for ls in ds.leases:
+                    status[ls.state] += 1
+                    if ls.state == _GRANTED and ls.worker:
+                        held[ls.worker] = held.get(ls.worker, 0) + 1
+                datasets[key] = status
+            workers: Dict[str, Dict[str, Any]] = {}
+            for jobid, addr in self._workers.items():
+                state = self._worker_states.get(jobid)
+                snap = state_to_snapshot(state) if state else {}
+                by = snap.get("data_service.worker.bytes", {})
+                shards = snap.get("data_service.worker.shards", {})
+                beat = self._last_beat.get(jobid)
+                workers[jobid] = {
+                    "addr": f"{addr[0]}:{addr[1]}",
+                    "alive": jobid not in dead,
+                    "heartbeat_age_s": (round(now - beat, 3)
+                                        if beat is not None else None),
+                    "live_leases": held.get(jobid, 0),
+                    "mb_s": float(by.get("windowed_rate",
+                                         by.get("rate", 0.0)) or 0.0) / 1e6,
+                    "shards": int(shards.get("value", 0) or 0),
+                    "straggler": jobid in suspects,
+                }
+            consumers = {key: {"backlog": int(c.get("backlog", 0)),
+                               "batches": int(c.get("batches", 0)),
+                               "age_s": round(now - c.get("ts", now), 3)}
+                         for key, c in self._consumers.items()}
+        return {"schema": "dmlc.data_service.fleet/1", "ts": time.time(),
+                "heartbeat_timeout_s": self.heartbeat_timeout_s,
+                "workers": workers, "consumers": consumers,
+                "datasets": datasets}
+
+    def _beat(self, jobid: str) -> None:
+        """Liveness beat + wall-time bookkeeping for /fleet heartbeat age
+        (the board's own timestamps are private to its death sweep)."""
+        self.liveness.beat(jobid)
+        with self._lock:
+            self._last_beat[jobid] = time.monotonic()
+
     # -- lease machinery (call under self._lock) ------------------------
-    def _regrant(self, ls: _Lease, why: str) -> None:
+    def _ledger_event(self, key: str, ls: _Lease, event: str,
+                      **extra: Any) -> None:
+        # every caller holds self._lock (see the section comment above);
+        # the helper reads _Lease fields mid-transition, so taking the
+        # lock here would deadlock on the non-reentrant mutex
+        # dmlclint: disable-next-line=lock-discipline — callers hold the lock
+        self._ledger.append({
+            "ts": time.time(), "key": key, "part": ls.part,
+            "event": event, "state": ls.state,
+            "lease_epoch": ls.lease_epoch, "worker": ls.worker, **extra})
+
+    def _regrant(self, key: str, ls: _Lease, why: str) -> None:
         ls.state = _PENDING
         ls.lease_epoch += 1
         ls.worker = None
         ls.deadline = None
         ls.regrants += 1
         metrics.counter("data_service.lease_regrants").add(1)
+        self._ledger_event(key, ls, "regranted", why=why)
         logger.warning("dispatcher: re-granting part %d (%s) — lease "
                        "epoch now %d", ls.part, why, ls.lease_epoch)
 
@@ -226,11 +362,16 @@ class Dispatcher:
                         if ls.state != _GRANTED:
                             continue
                         if any(ls.worker == j for j, _ in newly_dead):
-                            self._regrant(ls, f"worker {ls.worker} died")
+                            self._ledger_event(ds.key, ls, "worker_died",
+                                               why=f"worker {ls.worker} "
+                                                   f"silent")
+                            self._regrant(ds.key, ls,
+                                          f"worker {ls.worker} died")
                         elif ls.deadline is not None and now > ls.deadline:
                             metrics.counter(
                                 "data_service.leases_expired").add(1)
-                            self._regrant(ls, "ttl expired")
+                            self._ledger_event(ds.key, ls, "expired")
+                            self._regrant(ds.key, ls, "ttl expired")
 
     # -- request handling -----------------------------------------------
     def _accept_loop(self) -> None:
@@ -248,7 +389,17 @@ class Dispatcher:
             msg = recv_json(conn.makefile("r"))
             if msg is None:
                 return
-            reply = self._dispatch(msg)
+            ctx = teltrace.from_wire(msg.get("trace_id"),
+                                     msg.get("parent_span"))
+            if ctx is not None:
+                # traced caller: handle under a span parented to it, so
+                # the grant/complete shows up inside the consumer's trace
+                with teltrace.activate(ctx), \
+                        teltrace.span("data_service.dispatcher.rpc",
+                                      cmd=msg.get("cmd")):
+                    reply = self._dispatch(msg)
+            else:
+                reply = self._dispatch(msg)
             send_json(conn, reply)
         except (OSError, ValueError, KeyError, TypeError) as e:
             logger.warning("dispatcher connection error: %s", e)
@@ -269,7 +420,25 @@ class Dispatcher:
         if cmd == "deregister_worker":
             return self._cmd_deregister_worker(msg)
         if cmd == "heartbeat":
-            self.liveness.beat(str(msg["jobid"]))
+            jobid = str(msg["jobid"])
+            self._beat(jobid)
+            state = msg.get("state")
+            if isinstance(state, dict):
+                # metric push riding the heartbeat: last write wins (each
+                # push is a full registry state, not a delta); the same
+                # pushes feed cross-worker straggler detection
+                with self._lock:
+                    self._worker_states[jobid] = state
+                self.straggler_board.update(jobid, state)
+            return {"ok": True}
+        if cmd == "consumer_stats":
+            # the client's backlog report — the /fleet console's
+            # consumer-side pressure signal
+            with self._lock:
+                self._consumers[str(msg["key"])] = {
+                    "backlog": int(msg.get("backlog", 0)),
+                    "batches": int(msg.get("batches", 0)),
+                    "ts": time.monotonic()}
             return {"ok": True}
         if cmd == "list_workers":
             return {"workers": {j: list(a) for j, a
@@ -293,7 +462,7 @@ class Dispatcher:
         addr = (str(msg["host"]), int(msg["port"]))
         with self._lock:
             self._workers[jobid] = addr
-        self.liveness.beat(jobid)
+        self._beat(jobid)
         log_info("dispatcher: worker %r registered at %s:%d", jobid, *addr)
         return {"ok": True}
 
@@ -301,12 +470,15 @@ class Dispatcher:
         jobid = str(msg["jobid"])
         with self._lock:
             self._workers.pop(jobid, None)
+            self._worker_states.pop(jobid, None)
+            self._last_beat.pop(jobid, None)
             # a clean departure re-queues whatever it still held — no need
             # to wait out the TTL for a worker that said goodbye
             for ds in self._datasets.values():
                 for ls in ds.leases:
                     if ls.state == _GRANTED and ls.worker == jobid:
-                        self._regrant(ls, f"worker {jobid} deregistered")
+                        self._regrant(ds.key, ls,
+                                      f"worker {jobid} deregistered")
         self.liveness.forget(jobid)
         return {"ok": True}
 
@@ -343,11 +515,16 @@ class Dispatcher:
                     ls.lease_epoch += 1
                     ls.worker = None
                     ls.deadline = None
+                self._ledger.append({
+                    "ts": time.time(), "key": ds.key, "part": None,
+                    "event": "epoch_started", "state": _PENDING,
+                    "lease_epoch": None, "worker": None,
+                    "epoch": ds.epoch, "num_parts": len(ds.leases)})
             return {"epoch": ds.epoch, "num_parts": len(ds.leases)}
 
     def _cmd_next_lease(self, msg: dict) -> dict:
         jobid = str(msg["jobid"])
-        self.liveness.beat(jobid)
+        self._beat(jobid)
         with self._lock:
             ds = self._datasets[str(msg["key"])]
             grant: Optional[_Lease] = None
@@ -367,6 +544,16 @@ class Dispatcher:
             grant.worker = jobid
             grant.deadline = time.monotonic() + self.lease_ttl_s
             metrics.counter("data_service.leases_granted").add(1)
+            self._ledger_event(ds.key, grant, "granted",
+                               ttl_s=self.lease_ttl_s)
+            if teltrace.current() is not None:
+                # the cross-tier link: the consumer's trace reaches the
+                # grant decision (worker RPCs carry the stream's ids)
+                s = teltrace.start_span(
+                    "data_service.lease_grant", key=ds.key,
+                    part=grant.part, lease_epoch=grant.lease_epoch,
+                    worker=jobid)
+                s.end()
             return {"lease": {"part": grant.part,
                               "lease_epoch": grant.lease_epoch,
                               "spec": ds.spec}}
@@ -382,16 +569,21 @@ class Dispatcher:
                 # been re-granted: its delivery raced the replay and must
                 # not mark the shard done under the NEW grant
                 metrics.counter("data_service.stale_completions").add(1)
+                self._ledger_event(ds.key, ls, "stale_completion",
+                                   by=jobid,
+                                   stale_epoch=int(msg["lease_epoch"]))
                 logger.warning(
                     "dispatcher: stale completion of part %d by %r "
                     "(lease epoch %s, current %d, state %s) — rejected",
                     ls.part, jobid, msg["lease_epoch"], ls.lease_epoch,
                     ls.state)
                 return {"ok": False, "stale": True}
+            completed_by = ls.worker
             ls.state = _COMPLETED
             ls.worker = None
             ls.deadline = None
             metrics.counter("data_service.leases_completed").add(1)
+            self._ledger_event(ds.key, ls, "completed", by=completed_by)
             return {"ok": True}
 
     def _cmd_fail_lease(self, msg: dict) -> dict:
@@ -405,5 +597,7 @@ class Dispatcher:
             # GRANTED (worker send failed) or COMPLETED (the consumer saw
             # an incomplete delivery the worker believed it finished —
             # the consumer's view of arrival is ground truth)
-            self._regrant(ls, str(msg.get("why", "reported failed")))
+            self._ledger_event(ds.key, ls, "failed",
+                               why=str(msg.get("why", "reported failed")))
+            self._regrant(ds.key, ls, str(msg.get("why", "reported failed")))
             return {"ok": True}

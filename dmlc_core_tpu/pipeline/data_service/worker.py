@@ -26,6 +26,7 @@ which is exactly the process-death schedule the chaos tests replay.
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import threading
@@ -167,8 +168,12 @@ class DataServiceWorker:
     def _heartbeat_loop(self) -> None:
         while not self._stop_ev.wait(self.heartbeat_interval_s):
             try:
+                # the beat doubles as the fleet-console metrics push: the
+                # dispatcher merges these states into /fleet (same
+                # mergeable-state payload ranks push to the tracker)
                 dispatcher_rpc(self.dispatcher,
-                               {"cmd": "heartbeat", "jobid": self.jobid},
+                               {"cmd": "heartbeat", "jobid": self.jobid,
+                                "state": metrics.state()},
                                timeout=5.0)
             except OSError as e:
                 logger.warning("worker %s: heartbeat failed: %s",
@@ -194,8 +199,14 @@ class DataServiceWorker:
             if req is None:
                 return
             key = str(req["key"])
-            with teltrace.span("data_service.serve_stream", key=key,
-                               worker=self.jobid, peer=str(addr)) as sp:
+            # a traced consumer packs its ids into the stream request; a
+            # zero/absent id means untraced → this span roots its own
+            # local trace (never invents a cross-tier link)
+            ctx = teltrace.from_wire(req.get("trace_id"),
+                                     req.get("parent_span"))
+            with teltrace.activate(ctx), \
+                    teltrace.span("data_service.serve_stream", key=key,
+                                  worker=self.jobid, peer=str(addr)) as sp:
                 sp.attrs["shards"] = self._serve_stream(conn, key)
         except FaultInjected as e:
             # chaos schedule says this worker dies NOW: no lease cleanup,
@@ -270,6 +281,7 @@ class DataServiceWorker:
                 _send_all(conn, _FRAME.pack(part, CTRL_SHARD_END, frames))
                 sp.attrs.update(frames=frames, bytes=sent)
             metrics.counter("data_service.worker.shards").add(1)
+            metrics.throughput("data_service.worker.bytes").add(int(sent))
         except (OSError, ValueError, DMLCError) as e:
             # the consumer did not get this shard: re-queue it for any
             # living worker (possibly this one, on the next connection).
@@ -298,7 +310,14 @@ class DataServiceWorker:
 
 def data_service_worker_main(argv=None) -> int:
     """CLI: ``python -m dmlc_core_tpu.pipeline.data_service.worker
-    <dispatcher_host:port> [host=H] [port=N]`` — serve until killed."""
+    <dispatcher_host:port> [host=H] [port=N]`` — serve until killed.
+
+    With ``DMLC_TELEMETRY_OUT`` set (how the bench harness runs fleet
+    workers), SIGTERM becomes a *clean* departure: stop, then flush this
+    process's metrics snapshot + Chrome trace to
+    ``<prefix>.dsworker.<pid>.*`` so the parent can merge per-worker
+    telemetry into one artifact set."""
+    import signal
     import sys
     args = list(sys.argv[1:] if argv is None else argv)
     if not args:
@@ -310,12 +329,27 @@ def data_service_worker_main(argv=None) -> int:
     w = DataServiceWorker((dhost, int(dport)),
                           host=kw.get("host", "127.0.0.1"),
                           port=int(kw.get("port", 0)))
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
     w.start()
     try:
-        while True:
-            time.sleep(3600)
+        while not done.wait(0.5):
+            pass
+        w.stop()
     except KeyboardInterrupt:
         w.stop()
+    prefix = str(get_env("DMLC_TELEMETRY_OUT", ""))
+    if prefix:
+        from ...telemetry import dump_artifacts
+        p = f"{prefix}.dsworker.{os.getpid()}"
+        dump_artifacts(p)
+        # mergeable-state sidecar: the bench parent folds these with
+        # merge_states even when the run was too short for a heartbeat
+        # push to reach the dispatcher
+        tmp = f"{p}.state.json.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(metrics.state(), f, default=str)
+        os.replace(tmp, f"{p}.state.json")
     return 0
 
 

@@ -488,6 +488,10 @@ class DeviceLoader:
         self.fields = bool(fields)
         self.stats = PackStats()
         self.emit = emit
+        # trace context of the constructing (consumer) thread: the pack /
+        # transfer stage threads re-activate it so their spans join the
+        # trainer's trace rather than rooting one orphan trace per stage
+        self._trace = teltrace.current()
         self._cache_path = self._resolve_cache(cache)
         # page-cache knobs: 0/None defer to the (leniently parsed) env
         # defaults; explicit values are the autotuner's application path
@@ -761,8 +765,9 @@ class DeviceLoader:
 
     def _pack_host_ragged(self, block):
         t0 = time.monotonic()
-        with teltrace.span("device_loader.pack", rows=block.size,
-                           ragged=True), self._m_pack.time():
+        with teltrace.activate(self._trace), \
+                teltrace.span("device_loader.pack", rows=block.size,
+                              ragged=True), self._m_pack.time():
             host = pack_ragged(block, self.batch_rows, self.nnz_cap,
                                self.stats, id_mod=self.id_mod,
                                want_fields=self.fields)
@@ -772,8 +777,9 @@ class DeviceLoader:
 
     def _pack_host(self, block, fused: bool):
         t0 = time.monotonic()
-        with teltrace.span("device_loader.pack",
-                           rows=getattr(block, "size", self.batch_rows)), \
+        with teltrace.activate(self._trace), \
+                teltrace.span("device_loader.pack",
+                              rows=getattr(block, "size", self.batch_rows)), \
                 self._m_pack.time():
             if self.layout == "flat":
                 host = pack_flat(block, self.batch_rows, self.nnz_cap,
@@ -918,7 +924,8 @@ class DeviceLoader:
         t0 = time.monotonic()
         # pool mode times under its own stage: K workers accumulate
         # overlapping seconds, which must not be read as serial h2d time
-        with teltrace.span("device_loader.h2d", sync=sync), \
+        with teltrace.activate(self._trace), \
+                teltrace.span("device_loader.h2d", sync=sync), \
                 (self._m_h2d_pool if sync else self._m_h2d).time():
             if item[0] == "fused":
                 _, buf, nnz, rows_real = item

@@ -280,6 +280,10 @@ class RemoteIngestLoader:
         depth = max(2, int(prefetch))
         self._depth = depth
         self._closed = False
+        # the constructing thread's trace context: pipeline-stage threads
+        # re-activate it so their spans join the trainer's trace instead
+        # of rooting orphans
+        self._trace = teltrace.current()
         self._pool = _BufPool(cap=2 * depth + 2)
         self._frames: ThreadedIter = ThreadedIter(
             max_capacity=max(depth, len(self.addresses)))
@@ -470,8 +474,9 @@ class RemoteIngestLoader:
         view, meta, rows, buf = item
         self._check_frame(view, meta)
         self._maybe_bind()
-        with teltrace.span("remote_ingest.h2d",
-                           rows=(None if rows is None else int(rows))), \
+        with teltrace.activate(self._trace), \
+                teltrace.span("remote_ingest.h2d",
+                              rows=(None if rows is None else int(rows))), \
                 self._m_h2d.time():
             out = _put_fused_buf(view, self.batch_rows, meta)
             import jax

@@ -15,6 +15,8 @@ Layers (see ``docs/observability.md``):
   bundles on fatal paths, injected faults, SLO breaches, or ``/flight``.
 * :mod:`telemetry.anomaly` — streaming stall/straggler detection and the
   declarative ``DMLC_SLO_SPEC`` rule monitor.
+* :mod:`telemetry.profiling` — stdlib sampling stack profiler behind
+  ``/profile`` and the flight recorder's incident attachment.
 * :mod:`telemetry.xla_introspect` — jit retrace watchdog and device
   memory gauges.
 
@@ -37,7 +39,9 @@ from .chrome_trace import to_chrome_trace, write_chrome_trace
 from .exposition import (TelemetryServer, maybe_start_from_env,
                          render_prometheus, render_series)
 from .flight import (FlightRecorder, dump_incident, flight_recorder,
-                     maybe_arm_from_env)
+                     maybe_arm_from_env, register_contributor,
+                     unregister_contributor)
+from .profiling import SamplingProfiler, incident_profile, profile_for
 from .trace import (Span, SpanRecorder, TraceContext, activate, add_event,
                     current, current_trace_id, format_id, new_trace_id,
                     recorder, span, start_span)
@@ -53,7 +57,8 @@ __all__ = [
     "merge_states", "state_to_snapshot", "render_fleet",
     "dump_artifacts",
     "FlightRecorder", "flight_recorder", "dump_incident",
-    "maybe_arm_from_env",
+    "maybe_arm_from_env", "register_contributor", "unregister_contributor",
+    "SamplingProfiler", "profile_for", "incident_profile",
     "StreamingStat", "StallDetector", "StragglerBoard",
     "SloRule", "SloMonitor", "SloSpecError", "parse_slo_spec",
     "maybe_monitor_from_env",

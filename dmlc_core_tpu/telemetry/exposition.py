@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -34,8 +35,8 @@ from ..utils.logging import log_info, log_warning
 from ..utils.parameter import get_env
 from . import trace as _trace
 
-__all__ = ["render_prometheus", "render_series", "TelemetryServer",
-           "maybe_start_from_env"]
+__all__ = ["render_prometheus", "render_series", "render_fleet_board",
+           "TelemetryServer", "maybe_start_from_env"]
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -160,11 +161,76 @@ def render_prometheus(snapshot: Dict[str, Dict[str, Any]],
     return render_series([(labels, snapshot)], prefix=prefix)
 
 
+def _text_table(headers: List[str], rows: List[List[str]]) -> List[str]:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    out.extend(fmt.format(*r) for r in rows)
+    return out
+
+
+def render_fleet_board(doc: Dict[str, Any], html: bool = False) -> str:
+    """Zero-dependency status board over a dispatcher ``/fleet`` doc.
+
+    Plain aligned text (also legible in a terminal via ``curl``); with
+    ``html=True`` the same text is wrapped in a minimal self-refreshing
+    page — no JS, no CSS framework, nothing to vendor.
+    """
+    lines: List[str] = ["data-service fleet"]
+    workers = doc.get("workers", {}) or {}
+    rows = []
+    for jobid in sorted(workers):
+        w = workers[jobid]
+        rows.append([
+            jobid,
+            str(w.get("addr", "?")),
+            "DEAD" if not w.get("alive", True) else
+            ("straggler" if w.get("straggler") else "up"),
+            f"{w.get('heartbeat_age_s', 0.0):.1f}s",
+            f"{w.get('mb_s', 0.0):.1f}",
+            str(w.get("live_leases", 0)),
+            str(w.get("shards", 0)),
+        ])
+    lines.append("")
+    lines.extend(_text_table(
+        ["worker", "addr", "state", "hb_age", "MB/s", "leases", "shards"],
+        rows))
+    consumers = doc.get("consumers", {}) or {}
+    if consumers:
+        lines.append("")
+        lines.extend(_text_table(
+            ["consumer", "backlog", "age"],
+            [[k, str(c.get("backlog", 0)), f"{c.get('age_s', 0.0):.1f}s"]
+             for k, c in sorted(consumers.items())]))
+    datasets = doc.get("datasets", {}) or {}
+    if datasets:
+        lines.append("")
+        lines.extend(_text_table(
+            ["dataset", "epoch", "pending", "granted", "completed"],
+            [[k, str(d.get("epoch", 0)), str(d.get("pending", 0)),
+              str(d.get("granted", 0)), str(d.get("completed", 0))]
+             for k, d in sorted(datasets.items())]))
+    text = "\n".join(lines) + "\n"
+    if not html:
+        return text
+    import html as _html
+    return ("<!doctype html><html><head>"
+            "<meta http-equiv=\"refresh\" content=\"2\">"
+            "<title>dmlc fleet</title></head><body><pre>"
+            + _html.escape(text) + "</pre></body></html>\n")
+
+
 class TelemetryServer:
     """Daemon-thread HTTP exporter: ``/metrics`` (Prometheus text),
     ``/healthz`` (JSON status, 503 when overloaded), ``/spans`` (recent
-    span records as JSON), ``/flight`` (on-demand incident bundle), and
-    ``/stragglers`` (tracker only — cross-rank straggler board JSON).
+    span records as JSON), ``/flight`` (on-demand incident bundle),
+    ``/stragglers`` (tracker only — cross-rank straggler board JSON),
+    ``/profile?seconds=N`` (collapsed-stack sampling profile of this
+    process), and — when the hosting process injects them — ``/leases``
+    (dispatcher lease-lifecycle ledger) and ``/fleet`` (dispatcher
+    worker-fleet console; ``?format=text|html`` renders the status
+    board instead of JSON).
 
     All content callbacks are injectable so the same class serves a
     process-local registry (serving server, standalone exporter) or the
@@ -178,6 +244,9 @@ class TelemetryServer:
                  spans_fn: Optional[Callable[[], List[Dict[str, Any]]]] = None,
                  flight_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  stragglers_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 leases_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 fleet_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 profile_fn: Optional[Callable[[float], str]] = None,
                  ) -> None:
         if metrics_fn is None:
             from ..utils.metrics import metrics as _registry
@@ -188,11 +257,16 @@ class TelemetryServer:
             spans_fn = _trace.recorder.snapshot
         if flight_fn is None:
             flight_fn = self._default_flight
+        if profile_fn is None:
+            profile_fn = self._default_profile
         self._metrics_fn = metrics_fn
         self._health_fn = health_fn
         self._spans_fn = spans_fn
         self._flight_fn = flight_fn
         self._stragglers_fn = stragglers_fn
+        self._leases_fn = leases_fn
+        self._fleet_fn = fleet_fn
+        self._profile_fn = profile_fn
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -207,6 +281,13 @@ class TelemetryServer:
         if path is not None:
             doc["dumped_to"] = path
         return doc
+
+    @staticmethod
+    def _default_profile(seconds: float) -> str:
+        """``GET /profile?seconds=N``: one bounded sampling window of
+        every thread in this process, collapsed-stack text."""
+        from . import profiling as _profiling
+        return _profiling.profile_for(seconds)
 
     @staticmethod
     def _default_health() -> str:
@@ -243,7 +324,9 @@ class TelemetryServer:
                     pass
 
             def do_GET(self):   # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0]
+                path, _, rawq = self.path.partition("?")
+                query = {k: vs[-1] for k, vs
+                         in urllib.parse.parse_qs(rawq).items()}
                 try:
                     if path == "/metrics":
                         body = outer._metrics_fn().encode("utf-8")
@@ -276,6 +359,47 @@ class TelemetryServer:
                                        json.dumps(outer._stragglers_fn(),
                                                   default=str)
                                        .encode("utf-8"))
+                    elif path == "/leases":
+                        if outer._leases_fn is None:
+                            # only the data-service dispatcher owns a
+                            # lease table; everyone else 404s
+                            self._send(404, "text/plain",
+                                       b"no lease ledger here "
+                                       b"(dispatcher-only endpoint)\n")
+                        else:
+                            self._send(200, "application/json",
+                                       json.dumps(outer._leases_fn(),
+                                                  default=str)
+                                       .encode("utf-8"))
+                    elif path == "/fleet":
+                        if outer._fleet_fn is None:
+                            self._send(404, "text/plain",
+                                       b"no fleet console here "
+                                       b"(dispatcher-only endpoint)\n")
+                        else:
+                            doc = outer._fleet_fn()
+                            fmt = query.get("format", "json")
+                            if fmt == "html":
+                                self._send(200, "text/html; charset=utf-8",
+                                           render_fleet_board(doc, html=True)
+                                           .encode("utf-8"))
+                            elif fmt == "text":
+                                self._send(200,
+                                           "text/plain; charset=utf-8",
+                                           render_fleet_board(doc)
+                                           .encode("utf-8"))
+                            else:
+                                self._send(200, "application/json",
+                                           json.dumps(doc, default=str)
+                                           .encode("utf-8"))
+                    elif path == "/profile":
+                        try:
+                            seconds = float(query.get("seconds", "1"))
+                        except ValueError:
+                            seconds = 1.0
+                        body = outer._profile_fn(seconds)
+                        self._send(200, "text/plain; charset=utf-8",
+                                   body.encode("utf-8"))
                     else:
                         self._send(404, "text/plain", b"not found\n")
                 except Exception as e:   # scrape must never kill the server
@@ -288,10 +412,14 @@ class TelemetryServer:
             target=self._httpd.serve_forever, name="dmlc-telemetry",
             daemon=True)
         self._thread.start()
+        extra = "".join(
+            label for label, fn in (
+                (" /stragglers", self._stragglers_fn),
+                (" /leases", self._leases_fn),
+                (" /fleet", self._fleet_fn)) if fn is not None)
         log_info("telemetry exporter listening on %s:%d "
-                 "(/metrics /healthz /spans /flight%s)",
-                 self._requested[0], self.port,
-                 " /stragglers" if self._stragglers_fn is not None else "")
+                 "(/metrics /healthz /spans /flight /profile%s)",
+                 self._requested[0], self.port, extra)
         return self
 
     def stop(self) -> None:

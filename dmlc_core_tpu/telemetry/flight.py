@@ -49,7 +49,7 @@ import sys
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..utils.logging import get_log_tail, log_info, log_warning
 from ..utils.metrics import MetricsRegistry, metrics
@@ -58,9 +58,30 @@ from . import trace as _trace
 from .chrome_trace import to_chrome_trace
 
 __all__ = ["FlightRecorder", "flight_recorder", "dump_incident", "note",
-           "note_fault", "maybe_arm_from_env", "INCIDENT_SCHEMA"]
+           "note_fault", "maybe_arm_from_env", "register_contributor",
+           "unregister_contributor", "INCIDENT_SCHEMA"]
 
 INCIDENT_SCHEMA = "dmlc.flight.incident/1"
+
+#: pluggable bundle sections: name → zero-arg callable returning a
+#: JSON-ready value, snapshotted into every bundle under that key.
+#: Subsystems owning per-process state the recorder cannot reach register
+#: here (the data-service dispatcher contributes its lease ledger); a
+#: failing contributor degrades to an error string, never kills the dump.
+_contrib_lock = threading.Lock()
+_contributors: Dict[str, Callable[[], Any]] = {}
+
+
+def register_contributor(name: str, fn: Callable[[], Any]) -> None:
+    """Attach a named section to every future incident bundle (last
+    registration per name wins — a restarted dispatcher re-registers)."""
+    with _contrib_lock:
+        _contributors[name] = fn
+
+
+def unregister_contributor(name: str) -> None:
+    with _contrib_lock:
+        _contributors.pop(name, None)
 
 
 def _counter_deltas(old: Dict[str, Dict[str, Any]],
@@ -159,7 +180,24 @@ class FlightRecorder:
         anomaly_mod = sys.modules.get("dmlc_core_tpu.telemetry.anomaly")
         faults_mod = sys.modules.get("dmlc_core_tpu.utils.faults")
         rank = get_env("DMLC_RANK", None)
+        with _contrib_lock:
+            contribs = dict(_contributors)
+        sections: Dict[str, Any] = {}
+        for name, fn in contribs.items():
+            try:
+                sections[name] = fn()
+            except Exception as e:   # a contributor must not kill the dump
+                sections[name] = f"<contributor failed: {e}>"
+        # incident-time stacks: what every thread was doing when the
+        # trigger fired (short bounded window; DMLC_FLIGHT_PROFILE_S=0
+        # opts out)
+        try:
+            from . import profiling as _profiling
+            sections["profile_collapsed"] = _profiling.incident_profile()
+        except Exception as e:
+            sections["profile_collapsed"] = f"<profiler failed: {e}>"
         return {
+            **sections,
             "schema": INCIDENT_SCHEMA,
             "reason": reason,
             "detail": detail,
@@ -208,6 +246,9 @@ class FlightRecorder:
             doc["files"] = {"incident": "incident.json",
                             "trace": "trace.json",
                             "log_tail": "log_tail.txt"}
+            prof = doc.get("profile_collapsed")
+            if isinstance(prof, str) and prof:
+                doc["files"]["profile"] = "profile.txt"
             # tmp + rename per file: a crash mid-dump (likely — this IS
             # the crash path) must not leave a half-written bundle that
             # post-mortem tooling then chokes on
@@ -223,6 +264,10 @@ class FlightRecorder:
             _put("trace.json", lambda f: json.dump(to_chrome_trace(), f))
             _put("log_tail.txt",
                  lambda f: f.write("\n".join(tail) + ("\n" if tail else "")))
+            if isinstance(prof, str) and prof:
+                # collapsed stacks as their own file: flamegraph.pl and
+                # speedscope read the format directly, no JSON unwrapping
+                _put("profile.txt", lambda f: f.write(prof + "\n"))
         except OSError as e:
             # the black box must never become the crash: report and move on
             log_warning("flight recorder dump to %s failed: %s", path, e)

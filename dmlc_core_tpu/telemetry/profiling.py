@@ -1,0 +1,181 @@
+"""Stdlib sampling stack profiler — the incident-time "what is every
+thread doing" answer, with zero dependencies and zero cost when idle.
+
+``py-spy``/``perf`` cannot be assumed on a TPU worker image, and cProfile
+is a tracing profiler: its per-call hook is far too heavy to leave armed
+in a serving or ingest hot loop.  A *sampling* profiler pays only at the
+sample clock: a daemon thread wakes at ``DMLC_PROFILE_HZ`` (default 67 —
+deliberately co-prime with 10 ms scheduler ticks so samples do not beat
+against the interpreter's own switch interval), snapshots every thread's
+stack via :func:`sys._current_frames`, and folds each stack into
+collapsed form (``mod:func;mod:func <count>`` — the flamegraph.pl /
+speedscope interchange format), so a profile window is a text blob small
+enough to ride inside an incident bundle.
+
+Three entry points, by audience:
+
+* :class:`SamplingProfiler` — own the window yourself (tests, long
+  experiments): ``start()`` / ``stop()`` / ``collapsed()``.
+* :func:`profile_for` — one bounded window, returns the collapsed text;
+  this is what a ``TelemetryServer`` mounts at ``/profile?seconds=N``
+  (the HTTP thread blocks for the window; the server is threading, so
+  concurrent scrapes still get /metrics).
+* :func:`incident_profile` — the flight-recorder hook: a short window
+  (``DMLC_FLIGHT_PROFILE_S``, default 0.25 s) captured *inside*
+  ``bundle()`` so every stall/SLO incident carries the stacks that were
+  running when the trigger fired, not a reconstruction after the fact.
+
+Sampler accounting lands in ``utils.metrics`` (``profile.samples``) so a
+forgotten always-on profiler is visible in any snapshot.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from ..utils.metrics import metrics
+from ..utils.parameter import get_env
+
+__all__ = ["SamplingProfiler", "profile_for", "incident_profile"]
+
+#: default sample rate; co-prime with common 10 ms scheduler quanta
+_DEFAULT_HZ = 67.0
+#: hard bounds on a /profile window — a scrape must not pin an HTTP
+#: thread for minutes, and a sub-10ms window cannot hold even one sample
+_MIN_WINDOW_S = 0.05
+_MAX_WINDOW_S = 60.0
+
+
+def _frame_label(frame) -> str:
+    """``module:function`` — stable across hosts (no absolute paths), the
+    granularity flamegraphs aggregate well at."""
+    code = frame.f_code
+    mod = frame.f_globals.get("__name__", "?")
+    return f"{mod}:{code.co_name}"
+
+
+class SamplingProfiler:
+    """Fold ``sys._current_frames`` samples into collapsed stacks.
+
+    Thread-safe; one sampler thread per instance.  Stacks are keyed
+    root-first (outermost frame leftmost), matching what flamegraph
+    tooling expects.  ``max_stacks`` bounds the fold table so a pathological
+    workload (e.g. generated code with unbounded distinct frames) cannot
+    grow memory without bound — overflow folds into a sentinel bucket.
+    """
+
+    def __init__(self, hz: Optional[float] = None,
+                 max_stacks: int = 10000) -> None:
+        if hz is None:
+            hz = get_env("DMLC_PROFILE_HZ", _DEFAULT_HZ)
+        self.hz = max(1.0, min(1000.0, float(hz)))
+        self.max_stacks = int(max_stacks)
+        self._counts: Dict[str, int] = {}
+        self._samples = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+
+    def start(self) -> "SamplingProfiler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="dmlc-profiler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "SamplingProfiler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- sampling --
+
+    def _loop(self) -> None:
+        interval = 1.0 / self.hz
+        me = threading.get_ident()
+        while not self._stop.wait(interval):
+            self.sample_once(skip_tid=me)
+
+    def sample_once(self, skip_tid: Optional[int] = None) -> None:
+        """Take one sample of every live thread (public for tests: a
+        deterministic single sample without the wall-clock loop)."""
+        frames = sys._current_frames()
+        folded = []
+        for tid, frame in frames.items():
+            if tid == skip_tid:
+                continue
+            parts = []
+            f = frame
+            depth = 0
+            while f is not None and depth < 128:
+                parts.append(_frame_label(f))
+                f = f.f_back
+                depth += 1
+            parts.reverse()
+            folded.append(";".join(parts))
+        del frames
+        with self._lock:
+            self._samples += len(folded)
+            for stack in folded:
+                if stack not in self._counts \
+                        and len(self._counts) >= self.max_stacks:
+                    stack = "<overflow>"
+                self._counts[stack] = self._counts.get(stack, 0) + 1
+        metrics.counter("profile.samples").add(len(folded))
+
+    # -- output --
+
+    @property
+    def samples(self) -> int:
+        with self._lock:
+            return self._samples
+
+    def collapsed(self) -> str:
+        """Collapsed-stack text: one ``stack count`` line per distinct
+        stack, heaviest first — feed directly to flamegraph.pl or paste
+        into speedscope."""
+        with self._lock:
+            items = sorted(self._counts.items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+        return "\n".join(f"{stack} {n}" for stack, n in items)
+
+
+def profile_for(seconds: float, hz: Optional[float] = None) -> str:
+    """Blocking bounded window → collapsed-stack text (the ``/profile``
+    endpoint body).  The window is clamped to [0.05, 60] s: an HTTP
+    scrape must terminate, and a shorter window cannot hold a sample."""
+    seconds = max(_MIN_WINDOW_S, min(_MAX_WINDOW_S, float(seconds)))
+    prof = SamplingProfiler(hz=hz)
+    with prof:
+        time.sleep(seconds)
+    # a very short window on a quiet interpreter can miss the clock
+    # entirely; one explicit sample guarantees non-empty output
+    if prof.samples == 0:
+        prof.sample_once()
+    return prof.collapsed()
+
+
+def incident_profile() -> str:
+    """The flight-recorder attachment: one short window sampled at
+    incident time (``DMLC_FLIGHT_PROFILE_S``, default 0.25 s — long
+    enough for ~16 samples at the default rate, short enough that
+    ``bundle()`` stays interactive)."""
+    window = get_env("DMLC_FLIGHT_PROFILE_S", 0.25)
+    if window <= 0:       # explicit opt-out: profiling disabled
+        return ""
+    return profile_for(window)

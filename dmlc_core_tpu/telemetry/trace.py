@@ -45,7 +45,7 @@ from ..utils.parameter import get_env
 __all__ = [
     "TraceContext", "Span", "SpanRecorder", "recorder", "current",
     "current_trace_id", "new_trace_id", "start_span", "span", "activate",
-    "add_event", "format_id",
+    "add_event", "format_id", "wire_ids", "from_wire",
 ]
 
 
@@ -131,6 +131,28 @@ def current_trace_id() -> Optional[str]:
     """Hex trace id of the active context (log-correlation helper)."""
     ctx = current()
     return format_id(ctx.trace_id) if ctx is not None else None
+
+
+def wire_ids() -> "tuple[int, int]":
+    """``(trace_id, span_id)`` of the active context for wire injection;
+    ``(0, 0)`` when untraced — zero is the wire's 'untraced' marker, so
+    senders can pack unconditionally (the serving header convention,
+    shared by the data-service JSON RPCs)."""
+    ctx = current()
+    return (ctx.trace_id, ctx.span_id) if ctx is not None else (0, 0)
+
+
+def from_wire(trace_id: Any, span_id: Any) -> Optional[TraceContext]:
+    """Reconstruct a remote parent from wire ids.  A zero, absent, or
+    malformed trace id means the request is untraced → ``None`` (safe to
+    hand straight to :func:`activate` / ``start_span(parent=...)``)."""
+    try:
+        tid, sid = int(trace_id or 0), int(span_id or 0)
+    except (TypeError, ValueError):
+        return None
+    if tid == 0:
+        return None
+    return TraceContext(tid, sid)
 
 
 class Span:

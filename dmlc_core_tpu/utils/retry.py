@@ -273,6 +273,7 @@ class CircuitBreaker:
             if (self._clock() - self._opened_at >= self.cooldown_s
                     and not self._probing):
                 self._probing = True        # this caller is the probe
+                _trace_event("circuit_probe", circuit=self.name)
                 return
             metrics.counter(f"circuit.{self.name}.fast_fails").add(1)
             _trace_event("circuit_fast_fail", circuit=self.name)
@@ -282,9 +283,14 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            recovered = self._opened_at is not None
             self._failures = 0
             self._opened_at = None
             self._probing = False
+        if recovered:
+            # the probe came back: the dependency healed.  Traced so a
+            # Perfetto lane shows the open→closed bracket, not just the trip
+            _trace_event("circuit_close", circuit=self.name)
 
     def record_failure(self) -> None:
         with self._lock:

@@ -76,7 +76,7 @@ def test_incident_bundle_schema_golden(tmp_path):
     path = rec.arm(str(tmp_path)).dump("unit_test", why="golden")
     assert path is not None and os.path.isdir(path)
     assert sorted(os.listdir(path)) == ["incident.json", "log_tail.txt",
-                                        "trace.json"]
+                                        "profile.txt", "trace.json"]
     doc = json.load(open(os.path.join(path, "incident.json")))
     for key in ("schema", "reason", "detail", "ts", "pid", "host", "rank",
                 "slo_spec", "fault_spec", "metrics", "metrics_delta",
@@ -89,6 +89,10 @@ def test_incident_bundle_schema_golden(tmp_path):
     assert doc["metrics"]["drill.work"]["value"] == 4
     # counter moved since the ring snapshot → it shows in the delta
     assert doc["metrics_delta"]["deltas"]["drill.work"] == 3
+    # the incident carries the stacks that were running when it fired
+    assert doc["files"]["profile"] == "profile.txt"
+    prof = open(os.path.join(path, "profile.txt")).read()
+    assert prof.strip(), "collapsed-stack profile must be non-empty"
     _assert_chrome_trace_valid(
         json.load(open(os.path.join(path, "trace.json"))))
 

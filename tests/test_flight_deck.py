@@ -1,0 +1,438 @@
+"""Data-plane flight deck: cross-tier trace propagation, the
+lease-lifecycle ledger behind ``/leases``, the ``/fleet`` console,
+incident profiling, and the client's resilience gauges.
+
+The e2e trace tests run dispatcher, workers, and consumer in one process
+(threads + real sockets), so the process-global span recorder sees all
+three tiers — exactly the merged view a Perfetto export renders."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from collections import Counter
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dmlc_core_tpu.pipeline.data_service import (  # noqa: E402
+    DataServiceLoader, DataServiceWorker, Dispatcher, dispatcher_rpc)
+from dmlc_core_tpu.pipeline.device_loader import (  # noqa: E402
+    _fused_words_meta, _put_fused_buf)
+from dmlc_core_tpu.telemetry import flight  # noqa: E402
+from dmlc_core_tpu.telemetry import profiling  # noqa: E402
+from dmlc_core_tpu.telemetry import trace as teltrace  # noqa: E402
+from dmlc_core_tpu.utils import clear_faults  # noqa: E402
+from dmlc_core_tpu.utils.metrics import metrics  # noqa: E402
+
+from conftest import free_port  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+ROWS = 200
+BATCH_ROWS = 32
+NNZ_CAP = 1024
+
+
+def _libsvm(tmp_path, rows=ROWS):
+    rng = np.random.default_rng(11)
+    path = tmp_path / "deck.libsvm"
+    with open(path, "w") as f:
+        for i in range(rows):
+            idx = np.sort(rng.choice(np.arange(1, 300), size=6,
+                                     replace=False))
+            f.write(f"{i + 1} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    return str(path)
+
+
+def _spec(uri, num_parts):
+    return {"uri": uri, "fmt": "libsvm", "num_parts": num_parts,
+            "batch_rows": BATCH_ROWS, "nnz_cap": NNZ_CAP}
+
+
+def _drain_labels(loader):
+    labels = Counter()
+    for kind, buf, meta, _rows in loader:
+        assert kind == "fused"
+        out = _put_fused_buf(
+            np.asarray(buf)[: _fused_words_meta(BATCH_ROWS, int(meta))],
+            BATCH_ROWS, int(meta))
+        labels.update(int(x) for x in np.asarray(out["labels"])
+                      if int(x) > 0)
+        loader.recycle(buf)
+    return labels
+
+
+def _wait_for(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _spans_by_name(name):
+    return [r for r in teltrace.recorder.snapshot()
+            if r.get("kind") == "span" and r.get("name") == name]
+
+
+# ---------------------------------------------------------------------------
+# tentpole: one trace id across consumer → worker → dispatcher
+# ---------------------------------------------------------------------------
+
+def test_one_trace_spans_all_three_tiers(tmp_path):
+    """A traced consumer epoch produces spans on every tier sharing ONE
+    trace id: the client stream readers, the workers' serve/parse/pack
+    spans, the dispatcher's RPC handling, and the lease-grant decision."""
+    uri = _libsvm(tmp_path)
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=10.0) as d:
+        d.start()
+        workers = [DataServiceWorker(d.address).start() for _ in range(2)]
+        try:
+            with teltrace.span("test.flight_deck.epoch") as root:
+                root_tid = teltrace.format_id(root.trace_id)
+                ldr = DataServiceLoader(d.address, _spec(uri, 3))
+                labels = _drain_labels(ldr)
+                ldr.close()
+            assert set(labels) == set(range(1, ROWS + 1))
+            # worker-side spans are recorded when the serving thread
+            # unwinds — poll briefly instead of racing it
+            cross_tier = ("data_service.client.stream",
+                          "data_service.serve_stream",
+                          "data_service.serve_shard",
+                          "data_service.dispatcher.rpc",
+                          "data_service.lease_grant")
+            for name in cross_tier:
+                assert _wait_for(
+                    lambda n=name: any(s["trace_id"] == root_tid
+                                       for s in _spans_by_name(n)),
+                    timeout=5.0), \
+                    f"no {name} span joined trace {root_tid}"
+            # the dispatcher span is parented to the remote caller, not
+            # floating: every one in this trace names a parent
+            for s in _spans_by_name("data_service.dispatcher.rpc"):
+                if s["trace_id"] == root_tid:
+                    assert s["parent_id"] is not None
+        finally:
+            for w in workers:
+                w.stop()
+
+
+def test_untraced_rpc_stays_untraced():
+    """A zero/absent trace id on the wire must NOT grow spans on the
+    server: the dispatcher handles the command untraced."""
+    assert teltrace.from_wire(0, 0) is None
+    assert teltrace.from_wire(None, None) is None
+    assert teltrace.from_wire("junk", 3) is None
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=60.0) as d:
+        d.start()
+        teltrace.recorder.clear()
+        assert teltrace.current() is None       # this caller is untraced
+        dispatcher_rpc(d.address, {"cmd": "register_worker", "jobid": "u1",
+                                   "host": "127.0.0.1", "port": 1})
+        dispatcher_rpc(d.address, {"cmd": "heartbeat", "jobid": "u1"})
+        assert _spans_by_name("data_service.dispatcher.rpc") == []
+        assert _spans_by_name("data_service.lease_grant") == []
+
+
+# ---------------------------------------------------------------------------
+# tentpole: lease-lifecycle ledger + /leases
+# ---------------------------------------------------------------------------
+
+def test_lease_ledger_records_lifecycle_and_serves_endpoint(tmp_path):
+    uri = _libsvm(tmp_path)
+    with Dispatcher(lease_ttl_s=0.3, heartbeat_timeout_s=60.0,
+                    telemetry_port=0) as d:
+        d.start()
+        dispatcher_rpc(d.address, {"cmd": "register_worker", "jobid": "w1",
+                                   "host": "127.0.0.1", "port": 1})
+        key = dispatcher_rpc(d.address, {"cmd": "register_dataset",
+                                         "spec": _spec(uri, 1)})["key"]
+        dispatcher_rpc(d.address, {"cmd": "next_lease", "key": key,
+                                   "jobid": "w1"})
+        # TTL lapses → expired + regranted land in the ledger
+        assert _wait_for(lambda: any(
+            e["event"] == "regranted"
+            for e in d.ledger_snapshot()["events"]), timeout=5.0)
+        lease = dispatcher_rpc(d.address, {"cmd": "next_lease", "key": key,
+                                           "jobid": "w1"})["lease"]
+        # the resurrected epoch-1 completion is ledgered as stale
+        dispatcher_rpc(d.address, {"cmd": "complete_lease", "key": key,
+                                   "part": 0, "lease_epoch": 1,
+                                   "jobid": "w1"})
+        dispatcher_rpc(d.address, {"cmd": "complete_lease", "key": key,
+                                   "part": 0,
+                                   "lease_epoch": lease["lease_epoch"],
+                                   "jobid": "w1"})
+        events = [e["event"] for e in d.ledger_snapshot()["events"]]
+        for ev in ("granted", "expired", "regranted", "stale_completion",
+                   "completed"):
+            assert ev in events, (ev, events)
+        # order: the first grant precedes its expiry precedes the regrant
+        assert events.index("granted") < events.index("expired") \
+            < events.index("regranted")
+        # a fresh pass is one epoch_started marker
+        dispatcher_rpc(d.address, {"cmd": "start_epoch", "key": key})
+        assert any(e["event"] == "epoch_started" and e["epoch"] == 2
+                   for e in d.ledger_snapshot()["events"])
+        # the HTTP view serves the same schema
+        code, body = _get(
+            f"http://127.0.0.1:{d.telemetry.port}/leases")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["schema"] == "dmlc.data_service.leases/1"
+        assert doc["leases"][key][0]["state"] == "pending"   # re-armed
+        assert len(doc["events"]) == len(events) + 1
+
+
+def test_leases_endpoint_is_dispatcher_only():
+    from dmlc_core_tpu.telemetry.exposition import TelemetryServer
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        code, _ = _get(f"http://127.0.0.1:{srv.port}/leases")
+        assert code == 404
+        code, _ = _get(f"http://127.0.0.1:{srv.port}/fleet")
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: /fleet console
+# ---------------------------------------------------------------------------
+
+def test_fleet_console_reflects_worker_death_and_rates(tmp_path):
+    uri = _libsvm(tmp_path)
+    with Dispatcher(lease_ttl_s=30.0, heartbeat_timeout_s=0.4,
+                    telemetry_port=0) as d:
+        d.start()
+        for w in ("alive-1", "doomed-2"):
+            dispatcher_rpc(d.address, {"cmd": "register_worker", "jobid": w,
+                                       "host": "127.0.0.1", "port": 1})
+        key = dispatcher_rpc(d.address, {"cmd": "register_dataset",
+                                         "spec": _spec(uri, 2)})["key"]
+        dispatcher_rpc(d.address, {"cmd": "next_lease", "key": key,
+                                   "jobid": "alive-1"})
+        dispatcher_rpc(d.address, {"cmd": "consumer_stats", "key": key,
+                                   "backlog": 3, "batches": 17})
+        # beat only alive-1 (with a metric push) past the silent
+        # worker's timeout; /fleet must flip doomed-2 within one window
+        state = {"data_service.worker.bytes":
+                 {"type": "throughput", "total": 5_000_000,
+                  "rate": 2.5e6, "windowed_rate": 2.5e6},
+                 "data_service.worker.shards":
+                 {"type": "counter", "value": 4}}
+        deadline = time.monotonic() + 1.2       # 3x the 0.4s timeout
+        while time.monotonic() < deadline:
+            dispatcher_rpc(d.address, {"cmd": "heartbeat",
+                                       "jobid": "alive-1", "state": state})
+            time.sleep(0.1)
+        code, body = _get(f"http://127.0.0.1:{d.telemetry.port}/fleet")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["schema"] == "dmlc.data_service.fleet/1"
+        w1, w2 = doc["workers"]["alive-1"], doc["workers"]["doomed-2"]
+        assert w1["alive"] is True and w2["alive"] is False
+        assert w1["heartbeat_age_s"] < w2["heartbeat_age_s"]
+        assert w1["mb_s"] == pytest.approx(2.5)
+        assert w1["shards"] == 4
+        assert w1["live_leases"] == 1
+        assert doc["consumers"][key]["backlog"] == 3
+        assert doc["consumers"][key]["batches"] == 17
+        assert doc["datasets"][key]["granted"] == 1
+        # the zero-dependency boards render the same facts
+        code, text = _get(
+            f"http://127.0.0.1:{d.telemetry.port}/fleet?format=text")
+        assert code == 200
+        assert "alive-1" in text and "DEAD" in text
+        code, html = _get(
+            f"http://127.0.0.1:{d.telemetry.port}/fleet?format=html")
+        assert code == 200
+        assert html.startswith("<!doctype html>") or "<pre>" in html
+
+
+# ---------------------------------------------------------------------------
+# tentpole: incident profiling
+# ---------------------------------------------------------------------------
+
+def test_sampling_profiler_collapsed_output():
+    s0 = metrics.counter("profile.samples").value
+    prof = profiling.SamplingProfiler(hz=200)
+    prof.sample_once()                      # deterministic single sample
+    out = prof.collapsed()
+    assert out.strip()
+    # collapsed-stack grammar: "frame;frame;... count" per line,
+    # root-first labels of module:function form
+    line = out.splitlines()[0]
+    stack, count = line.rsplit(" ", 1)
+    assert int(count) >= 1
+    assert ";" in stack or ":" in stack
+    # this very test function is on some sampled thread's stack
+    assert "test_sampling_profiler_collapsed_output" in out
+    assert metrics.counter("profile.samples").value > s0
+
+
+def test_profile_for_window_and_endpoint():
+    out = profiling.profile_for(0.15)
+    assert out.strip(), "a window over a live interpreter has samples"
+    from dmlc_core_tpu.telemetry.exposition import TelemetryServer
+    srv = TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        code, body = _get(
+            f"http://127.0.0.1:{srv.port}/profile?seconds=0.1")
+        assert code == 200
+        assert body.strip()
+        # malformed query degrades to the default window, not a 500
+        code, _ = _get(
+            f"http://127.0.0.1:{srv.port}/profile?seconds=bogus&x=1")
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+def test_incident_profile_env_gates(monkeypatch):
+    monkeypatch.setenv("DMLC_FLIGHT_PROFILE_S", "0")
+    assert profiling.incident_profile() == ""
+    monkeypatch.setenv("DMLC_FLIGHT_PROFILE_S", "0.05")
+    assert profiling.incident_profile().strip()
+
+
+def test_flight_bundle_carries_ledger_and_profile(tmp_path):
+    """An incident bundle dumped while a dispatcher lives in-process
+    carries the lease ledger (contributor section) and a non-empty
+    collapsed-stack profile."""
+    uri = _libsvm(tmp_path)
+    with Dispatcher(lease_ttl_s=30.0, heartbeat_timeout_s=60.0) as d:
+        d.start()
+        dispatcher_rpc(d.address, {"cmd": "register_worker", "jobid": "w1",
+                                   "host": "127.0.0.1", "port": 1})
+        key = dispatcher_rpc(d.address, {"cmd": "register_dataset",
+                                         "spec": _spec(uri, 1)})["key"]
+        dispatcher_rpc(d.address, {"cmd": "next_lease", "key": key,
+                                   "jobid": "w1"})
+        rec = flight.FlightRecorder()
+        rec._min_interval = 0.0
+        path = rec.arm(str(tmp_path)).dump("deck_drill")
+        assert path is not None
+        doc = json.load(open(os.path.join(path, "incident.json")))
+        assert doc["lease_ledger"]["schema"] == "dmlc.data_service.leases/1"
+        assert any(e["event"] == "granted"
+                   for e in doc["lease_ledger"]["events"])
+        assert doc["files"]["profile"] == "profile.txt"
+        prof = open(os.path.join(path, "profile.txt")).read()
+        assert prof.strip()
+    # after stop() the contributor is gone: bundles elsewhere never see
+    # a dead dispatcher's ledger
+    rec2 = flight.FlightRecorder()
+    assert "lease_ledger" not in rec2.bundle("post_stop")
+
+
+# ---------------------------------------------------------------------------
+# satellite: client resilience gauges
+# ---------------------------------------------------------------------------
+
+def test_client_breaker_state_exposed_as_gauges(tmp_path, monkeypatch):
+    """A ghost fleet member (registered, never serving) trips its
+    per-worker breaker; the loader publishes that as gauges while the
+    epoch completes off the living worker."""
+    monkeypatch.setenv("DMLC_DATA_CLIENT_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("DMLC_DATA_CLIENT_RETRIES", "3")
+    monkeypatch.setenv("DMLC_DATA_CLIENT_BACKOFF_BASE", "0.01")
+    monkeypatch.setenv("DMLC_DATA_CLIENT_BACKOFF_MAX", "0.05")
+    uri = _libsvm(tmp_path)
+    r0 = metrics.counter("data_service.client.redials").value
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=60.0) as d:
+        d.start()
+        dispatcher_rpc(d.address, {"cmd": "register_worker",
+                                   "jobid": "ghost", "host": "127.0.0.1",
+                                   "port": free_port()})   # nobody listens
+        with DataServiceWorker(d.address) as w:
+            w.start()
+            ldr = DataServiceLoader(d.address, _spec(uri, 2))
+            labels = _drain_labels(ldr)
+            ldr.close()
+    assert set(labels) == set(range(1, ROWS + 1))
+    assert metrics.gauge(
+        "data_service.client.breaker_open.ghost").value == 1.0
+    assert metrics.gauge("data_service.client.breakers_open").value >= 1.0
+    assert metrics.counter("data_service.client.redials").value > r0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: chaos run — death mid-epoch, one merged trace, full bundle
+# ---------------------------------------------------------------------------
+
+def test_chaos_death_merged_trace_and_bundle(tmp_path, monkeypatch):
+    """The ISSUE's acceptance drill: a worker is killed mid-epoch by
+    DMLC_FAULT_SPEC; the (shared) trace shows the re-granted lease served
+    under the same consumer trace id by a survivor, /fleet flips the dead
+    worker, and the incident bundle carries ledger + profile."""
+    uri = _libsvm(tmp_path)
+    monkeypatch.setenv("DMLC_FAULT_SPEC",
+                       "data_service.lease:error=1:times=1:after=1")
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=0.5,
+                    telemetry_port=0) as d:
+        d.start()
+        workers = [DataServiceWorker(d.address,
+                                     heartbeat_interval_s=0.1).start()
+                   for _ in range(2)]
+        try:
+            with teltrace.span("test.chaos.epoch") as root:
+                root_tid = teltrace.format_id(root.trace_id)
+                ldr = DataServiceLoader(d.address, _spec(uri, 4))
+                labels = _drain_labels(ldr)
+                ldr.close()
+            assert set(labels) == set(range(1, ROWS + 1))
+            # the ledger shows the death → regrant → completion arc
+            events = d.ledger_snapshot()["events"]
+            kinds = [e["event"] for e in events]
+            assert "worker_died" in kinds or "failed" in kinds, kinds
+            assert "regranted" in kinds
+            regrant = next(e for e in events if e["event"] == "regranted")
+            done = [e for e in events if e["event"] == "completed"
+                    and e["part"] == regrant["part"]
+                    and e["lease_epoch"] > 1]
+            assert done, "re-granted shard never completed by a survivor"
+            # the re-granted lease's grant decision is in the SAME trace
+            grants = [s for s in _spans_by_name("data_service.lease_grant")
+                      if s["trace_id"] == root_tid
+                      and s["attrs"].get("part") == regrant["part"]
+                      and s["attrs"].get("lease_epoch") > 1]
+            assert grants, "regrant not visible in the consumer's trace"
+            # /fleet flips the killed worker within a heartbeat timeout
+            def one_dead():
+                doc = json.loads(_get(
+                    f"http://127.0.0.1:{d.telemetry.port}/fleet")[1])
+                return sum(0 if w["alive"] else 1
+                           for w in doc["workers"].values()) >= 1
+            assert _wait_for(one_dead, timeout=5.0)
+            # incident bundle: ledger section + non-empty profile
+            rec = flight.FlightRecorder()
+            rec._min_interval = 0.0
+            path = rec.arm(str(tmp_path)).dump("chaos_drill")
+            doc = json.load(open(os.path.join(path, "incident.json")))
+            assert any(e["event"] == "regranted"
+                       for e in doc["lease_ledger"]["events"])
+            assert open(os.path.join(path, "profile.txt")).read().strip()
+        finally:
+            for w in workers:
+                w.kill()

@@ -132,6 +132,60 @@ def test_metric_doc_two_way_check(tmp_path):
     assert len(findings) == 2
 
 
+# -- span-vocabulary --------------------------------------------------------
+
+def test_span_grammar(tmp_path):
+    findings, _, ctx = _lint_snippet(tmp_path, """\
+        from dmlc_core_tpu.telemetry import trace as teltrace
+        with teltrace.span("data_service.serve_stream"):
+            pass
+        teltrace.start_span("reshard")          # single segment: legal
+        teltrace.span("Bad Name")
+        name = "dyn." + "x"
+        teltrace.span(name)                     # dynamic: skipped
+        "abc".split("b")[0].span if False else None
+    """, rules=["span-vocabulary"])
+    assert [f.line for f in findings] == [5]
+    assert "data_service.serve_stream" in ctx.span_sites
+    assert "reshard" in ctx.span_sites
+
+
+SPAN_DOC = """\
+## Span catalog
+
+| Span | Emitted by | Meaning |
+|---|---|---|
+| `app.{serve,drain}` | worker | epoch phases |
+| `app.old_phase` | worker | retired phase |
+| `app.rpc.<cmd>` | server | per-command handling |
+
+| Name | Type | Meaning |
+|---|---|---|
+| `app.latency_s` | histogram | must not leak into the span table |
+"""
+
+
+def test_span_doc_two_way_check(tmp_path):
+    pkg = _fake_repo(tmp_path, SPAN_DOC, """\
+        from dmlc_core_tpu.telemetry import trace as teltrace
+        teltrace.span("app.serve")
+        teltrace.span("app.drain")
+        teltrace.start_span("app.rpc.heartbeat")
+        teltrace.span("app.undocumented")
+    """)
+    findings, _, _ = lint_paths([str(pkg)], rules=["span-vocabulary"],
+                                repo_root=str(tmp_path))
+    msgs = [f.message for f in findings]
+    # app.undocumented missing a row; app.old_phase documented but gone
+    assert any("app.undocumented" in m for m in msgs)
+    assert any("app.old_phase" in m for m in msgs)
+    # braces and wildcards cover; the metric table never leaks spans
+    assert not any("app.serve" in m for m in msgs)
+    assert not any("app.rpc" in m for m in msgs)
+    assert not any("app.latency_s" in m for m in msgs)
+    assert len(findings) == 2
+
+
 # -- lock-discipline --------------------------------------------------------
 
 def test_lock_mixed_guard_flagged(tmp_path):
@@ -348,11 +402,12 @@ def test_cli_exit_codes_and_json(tmp_path, capsys):
     assert doc["findings"][0]["rule"] == "env-discipline"
 
 
-def test_cli_lists_all_six_rules(capsys):
+def test_cli_lists_all_builtin_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule in ("env-discipline", "metric-vocabulary", "lock-discipline",
-                 "atomic-write", "retrace-hazard", "thread-hygiene"):
+    for rule in ("env-discipline", "metric-vocabulary", "span-vocabulary",
+                 "lock-discipline", "atomic-write", "retrace-hazard",
+                 "thread-hygiene"):
         assert rule in out
 
 
