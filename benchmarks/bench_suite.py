@@ -1710,6 +1710,126 @@ def bench_elastic_reshard() -> dict:
             "leaves_from_checkpoint": int(from_ckpt)}
 
 
+_EMBED_CHILD = r"""
+import sys, time
+import numpy as np
+from dmlc_core_tpu.parallel import RabitContext
+from dmlc_core_tpu.embed import ShardedEmbeddingTable
+from dmlc_core_tpu.utils.metrics import metrics
+
+uri, port, jobid, rows_s, dim_s, steps_s, brows_s = sys.argv[1:8]
+num_rows, dim = int(rows_s), int(dim_s)
+steps, batch_rows = int(steps_s), int(brows_s)
+ctx = RabitContext(uri, int(port), jobid=jobid)
+rank, world = ctx.rank, ctx.world_size
+t = ShardedEmbeddingTable(num_rows, dim, rank=rank, world=world,
+                          replicas=1, seed=3, serve=True)
+t.sync_addresses(ctx)
+nnz = batch_rows * 16
+rng = np.random.default_rng(100 + rank)
+batches = []
+for _ in range(steps):
+    ids = rng.integers(0, num_rows, nnz)
+    # half the traffic keys a hot 1% of rows: dedup + the hot-row cache
+    # have real work, like production id distributions
+    ids[: nnz // 2] = rng.integers(0, max(1, num_rows // 100), nnz // 2)
+    batches.append({
+        "ids": ids.astype(np.int64),
+        "vals": rng.random(nnz).astype(np.float32),
+        "segments": np.sort(rng.integers(0, batch_rows, nnz)).astype(
+            np.int32),
+        "labels": np.zeros(batch_rows, np.float32),
+        "weights": np.ones(batch_rows, np.float32),
+        "nnz_used": np.int32(nnz), "rows_used": np.int32(batch_rows)})
+g = np.ones((batch_rows, dim), np.float32)
+t.lookup(batches[0]); t.backward(batches[0], g)     # compile outside
+ctx.allreduce(np.zeros(1))                          # align cohort start
+t0 = time.perf_counter()
+for b in batches:
+    t.backward(b, g * 0 + t.lookup(b) * 0 + 1)      # lookup feeds grad
+t.flush(ctx)
+wall = time.perf_counter() - t0
+snap = t.build_snapshot()                           # None over budget
+print("EMB %d %.6f %d %d %d %d %d" % (
+    rank, wall, steps * batch_rows,
+    metrics.counter("embed.exchange_bytes").value,
+    metrics.counter("embed.cache_hits").value,
+    t.resident_bytes, 0 if snap is None else 1), flush=True)
+ctx.allreduce(np.zeros(1))                          # all reads done
+t.close()
+ctx.shutdown()
+"""
+
+
+def bench_embed_shard() -> dict:
+    """Sharded embedding lookup/update throughput (ISSUE 12): a 3-rank
+    cohort cooperatively trains ONE table whose total bytes exceed a
+    single rank's ``DMLC_RESHARD_MAX_BYTES`` snapshot budget — no rank
+    could hold (or even snapshot) the whole table, which is the point of
+    the subsystem.  Each rank streams skewed ragged batches through
+    lookup (dedup → cache → fan-out exchange) + backward, then one
+    collective flush.  Headline is cohort looked-up rows/s; the paired
+    lower-better metric is wire bytes per looked-up row (what dedup and
+    the hot-row cache exist to shrink)."""
+    import subprocess
+
+    from dmlc_core_tpu.parallel import RabitTracker
+
+    world, dim = 3, 64
+    table_mb = int(os.environ.get("DMLC_BENCH_EMBED_MB", str(TARGET_MB)))
+    num_rows = (table_mb * MB) // (4 * dim)
+    total_bytes = num_rows * dim * 4
+    # budget below the full table, above one rank's 2/3 resident share:
+    # every rank CAN snapshot what it holds, none could hold it all
+    budget = int(total_bytes * 0.85)
+    steps, batch_rows = 24, 256
+
+    tracker = RabitTracker(num_workers=world, host_ip="127.0.0.1")
+    tracker.start()
+    envd = tracker.worker_envs()
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               DMLC_RESHARD_MAX_BYTES=str(budget))
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _EMBED_CHILD,
+         envd["DMLC_TRACKER_URI"], str(envd["DMLC_TRACKER_PORT"]),
+         f"em{i}", str(num_rows), str(dim), str(steps), str(batch_rows)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for i in range(world)]
+    walls, resident, exch, hits, snap_ok = {}, {}, 0, 0, True
+    rows_done = 0
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        if p.returncode != 0:
+            raise RuntimeError(f"embed child rc={p.returncode}: "
+                               f"{err[-500:]}")
+        for ln in out.splitlines():
+            if ln.startswith("EMB "):
+                _, r, w, rows, xb, ch, res, ok = ln.split()
+                walls[int(r)] = float(w)
+                rows_done += int(rows)
+                exch += int(xb)
+                hits += int(ch)
+                resident[int(r)] = int(res)
+                snap_ok = snap_ok and bool(int(ok))
+    tracker.join(timeout=30)
+    wall = max(walls.values())
+    if max(resident.values()) >= total_bytes:
+        raise RuntimeError("embed bench invariant broken: a rank resides "
+                           "the full table")
+    return {"metric": "embed_lookup_rows_s",
+            "value": round(rows_done / wall, 1), "unit": "rows/s",
+            "world": world, "table_mb": round(total_bytes / MB, 1),
+            "num_rows": int(num_rows), "dim": dim,
+            "snapshot_budget_mb": round(budget / MB, 1),
+            "per_rank_resident_mb": round(max(resident.values()) / MB, 1),
+            "resident_frac_of_table": round(
+                max(resident.values()) / total_bytes, 3),
+            "per_rank_snapshot_fits": bool(snap_ok),
+            "exchange_bytes_per_row": round(exch / max(rows_done, 1), 1),
+            "cache_hits": int(hits),
+            "batches": steps, "batch_rows": batch_rows}
+
+
 # Run order = dict order.  The virtual-mesh configs (subprocess CPU runs,
 # no tunnel involved) come before the long device-bound train loop: a
 # wedged tunnel grant mid-fm_train (observed r03: >1h stall inside one
@@ -1754,6 +1874,7 @@ ALL = {
     "allreduce_mesh8": (bench_allreduce_mesh8, "allreduce_mesh8_psum_wall"),
     "sp_mesh8": (bench_sp_mesh8, "sp_mesh8_attention_wall"),
     "elastic_reshard": (bench_elastic_reshard, "reshard_wall_s"),
+    "embed_shard": (bench_embed_shard, "embed_lookup_rows_s"),
 }
 
 
@@ -1778,9 +1899,12 @@ CPU_MESH = {"allreduce_mesh8", "sp_mesh8"}
 #  ingest_fleet is host-path by construction too: dispatcher, workers and
 #  consumer all live on loopback and the consumer drains host frames —
 #  the number is wire+lease throughput, no device in the loop.
+#  embed_shard is host-path by construction like elastic_reshard: the
+#  number is dedup + loopback-exchange + flush throughput over the
+#  control plane; the per-batch pooled gather is a CPU-jitted kernel.
 HOST_ONLY = {"stream", "csv", "recordio", "cache", "higgs", "ingest_cached",
              "ingest_ragged", "ingest_autotune", "elastic_reshard",
-             "ingest_fleet"}
+             "ingest_fleet", "embed_shard"}
 # superseded in the default order (ingest_scale measures workers_2 too);
 # still runnable by explicit name
 DEFAULT_SKIP = {"remote_ingest"}

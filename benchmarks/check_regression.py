@@ -53,10 +53,14 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 #  tokens either: reshard_wall_s / ckpt_reload_wall_s gate lower-better
 #  via "wall", reshard_vs_reload_speedup gates higher-better via
 #  "speedup".
+#  bytes_per_row (ISSUE 12 sharded embeddings): wire cost of one looked-up
+#  row after dedup + hot-row caching — every byte shaved is exchange
+#  bandwidth back; the family's embed_lookup_rows_s gates higher-better
+#  via "rows" as usual.
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
                  "errors", "misses", "padding_ratio", "truncated",
-                 "epochs_to_converge")
+                 "epochs_to_converge", "bytes_per_row")
 
 
 def _direction(key: str) -> Optional[str]:

@@ -60,7 +60,8 @@ except Exception:  # pragma: no cover - pallas-less jax build
     _HAVE_PALLAS = False
 
 __all__ = ["ragged_segment_sum", "ragged_dense_matvec", "ragged_embed_sum",
-           "ragged_fm_pairwise", "mask_ragged", "mask_batch"]
+           "ragged_embed_grad", "ragged_fm_pairwise", "mask_ragged",
+           "mask_batch"]
 
 # DMA ring depth + per-operand SMEM scalar budget: the values proven on
 # hardware by pallas_embed (TPU_MICRO_r04) — this module ships THREE
@@ -342,6 +343,30 @@ def ragged_embed_sum(ids: jax.Array, vals: jax.Array, segments: jax.Array,
                          fm=False,
                          interpret=jax.default_backend() != "tpu")
     return out[:num_rows]
+
+
+def ragged_embed_grad(ids: jax.Array, vals: jax.Array, segments: jax.Array,
+                      nnz_used: jax.Array, g_rows: jax.Array,
+                      num_table_rows: int) -> jax.Array:
+    """Backward twin of :func:`ragged_embed_sum` w.r.t. the table: given
+    upstream gradients ``g_rows[num_rows, dim]`` for the pooled output,
+    return ``grad[num_table_rows, dim]`` with ``grad[ids[i]] += vals[i] ·
+    g_rows[segments[i]]`` summed over live entries.  XLA scatter-add only
+    — the sparse-update path consumes a *dense over the referenced rows*
+    gradient and re-sparsifies by unique id, so a predicated Pallas
+    variant buys nothing here.  Tail entries are masked to ``(id 0, val
+    0.0)`` and so contribute exact ``0.0`` to row 0: the result is a pure
+    function of the live entries, whatever garbage sits past
+    ``nnz_used``."""
+    num_rows = g_rows.shape[0]
+    ids, vals, segments = mask_ragged(ids, vals, segments, nnz_used,
+                                      num_rows)
+    # masked segments point at num_rows (one past the end of g_rows);
+    # clamp for the gather — the masked val 0.0 kills the contribution
+    seg = jnp.minimum(segments, jnp.int32(num_rows - 1))
+    contrib = g_rows[seg] * vals[:, None]
+    out = jnp.zeros((num_table_rows, g_rows.shape[1]), g_rows.dtype)
+    return out.at[ids].add(contrib)
 
 
 def ragged_fm_pairwise(ids: jax.Array, vals: jax.Array,

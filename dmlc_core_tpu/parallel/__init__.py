@@ -2,9 +2,9 @@
 client, cluster launchers (reference ``tracker/`` — SURVEY §2.5, §5.8)."""
 
 from .mesh import (make_mesh, parse_mesh_spec, data_parallel_mesh,  # noqa: F401
-                   process_mesh_info, row_partition, remap_rows)
+                   process_mesh_info, row_partition, remap_rows, row_owners)
 from .collectives import (allreduce, broadcast, allgather,  # noqa: F401
-                          reduce_scatter, MeshCollectives)
+                          reduce_scatter, all_to_all, MeshCollectives)
 from .tracker import (RabitTracker, PSTracker, compute_tree,  # noqa: F401
                       compute_ring)
 from .rabit import RabitContext  # noqa: F401
@@ -15,8 +15,9 @@ from .elastic import ElasticJaxMesh, ResyncResult  # noqa: F401
 __all__ = [
     "PSTracker",
     "make_mesh", "parse_mesh_spec", "data_parallel_mesh", "process_mesh_info",
-    "row_partition", "remap_rows",
-    "allreduce", "broadcast", "allgather", "reduce_scatter", "MeshCollectives",
+    "row_partition", "remap_rows", "row_owners",
+    "allreduce", "broadcast", "allgather", "reduce_scatter", "all_to_all",
+    "MeshCollectives",
     "RabitTracker", "compute_tree", "compute_ring", "RabitContext",
     "StateHandle", "ReshardStats", "HostSnapshot", "snapshot_tree",
     "redistribute",

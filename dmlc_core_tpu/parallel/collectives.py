@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..utils import DMLCError, check
 
 __all__ = ["allreduce", "broadcast", "allgather", "reduce_scatter",
-           "MeshCollectives", "OPS"]
+           "all_to_all", "MeshCollectives", "OPS"]
 
 OPS: Dict[str, Callable] = {
     "sum": jax.lax.psum,
@@ -62,6 +62,20 @@ def allgather(x: jax.Array, axis_name: str, axis: int = 0,
 def reduce_scatter(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
                                 tiled=True)
+
+
+def all_to_all(x: jax.Array, axis_name: str, split_axis: int = 0,
+               concat_axis: int = 0, tiled: bool = True) -> jax.Array:
+    """In-jit all-to-all over a mesh axis: split ``split_axis`` into
+    ``world`` chunks, send chunk *d* to coordinate *d*, concatenate the
+    received chunks along ``concat_axis``.  This is the mapped-primitive
+    lowering of the sharded-embedding exchange (DrJAX's mapped
+    ``all_to_all``, PAPERS.md: arxiv 2403.07128): when table shards and
+    batch ids live on one process's mesh, the same shuffle the
+    cross-process exchange does over TCP lowers to a single XLA
+    collective over ICI."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
 
 
 class MeshCollectives:
@@ -105,6 +119,13 @@ class MeshCollectives:
         elif kind == "allgather":
             def body(x):
                 return allgather(x, axis)
+        elif kind == "all_to_all":
+            # local block is [1, world, ...]: exchange the second axis,
+            # then restore the leading layout so rank r's block is the
+            # column in[:, r] — i.e. out[d] = in[:, d] globally
+            def body(x):
+                y = all_to_all(x, axis, split_axis=1, concat_axis=0)
+                return jnp.swapaxes(y, 0, 1)
         else:
             raise DMLCError(f"unknown collective {kind!r}")
 
@@ -145,5 +166,21 @@ class MeshCollectives:
         per_rank = np.asarray(per_rank)
         x = self._stack(per_rank)
         fn = self._jitted("allgather", "sum", 0, per_rank.shape,
+                          per_rank.dtype)
+        return np.asarray(fn(x))
+
+    def all_to_all(self, per_rank: np.ndarray) -> np.ndarray:
+        """Rabit-style all-to-all: ``per_rank[src, dst, ...]`` (row *src*
+        = rank *src*'s outbox, entry *dst* = its chunk for rank *dst*)
+        → ``out[dst, src, ...]`` where ``out[d]`` is rank *d*'s inbox —
+        ``out[d, s] == per_rank[s, d]``.  One XLA collective; this is the
+        in-mesh lowering of the sharded-embedding id/row shuffle."""
+        per_rank = np.asarray(per_rank)
+        check(per_rank.ndim >= 2
+              and per_rank.shape[0] == self.world_size
+              and per_rank.shape[1] == self.world_size,
+              f"all_to_all wants [world, world, ...], got {per_rank.shape}")
+        x = self._stack(per_rank)
+        fn = self._jitted("all_to_all", "sum", 0, per_rank.shape,
                           per_rank.dtype)
         return np.asarray(fn(x))

@@ -285,13 +285,19 @@ class ElasticJaxMesh:
             # a non-holder (peers/checkpoint cover it), never blocks the
             # rebuild.
             try:
-                state = handle.get_state()
+                if getattr(handle, "snapshot", None) is not None:
+                    # row-sharded owners (embed tables) hand back a ready
+                    # HostSnapshot with ranged + replica blocks that the
+                    # whole-leaf snapshot_tree path cannot express
+                    snap = handle.snapshot()
+                else:
+                    state = handle.get_state()
+                    if state is not None:
+                        snap = _reshard.snapshot_tree(state)
             except Exception as e:  # noqa: BLE001 — degrade, don't wedge
                 log_warning("elastic: state snapshot failed (%s) — this "
                             "rank recovers from peers/checkpoint", e)
-                state = None
-            if state is not None:
-                snap = _reshard.snapshot_tree(state)
+                snap = None
         data_plane = _data_plane_enabled()
         if data_plane:
             import jax

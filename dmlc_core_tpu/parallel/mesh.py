@@ -27,7 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..utils import DMLCError, check
 
 __all__ = ["make_mesh", "parse_mesh_spec", "process_mesh_info",
-           "data_parallel_mesh", "row_partition", "remap_rows"]
+           "data_parallel_mesh", "row_partition", "remap_rows",
+           "row_owners"]
 
 
 def parse_mesh_spec(spec: str) -> Dict[str, int]:
@@ -92,6 +93,26 @@ def row_partition(n_rows: int, parts: int) -> List[Tuple[int, int]]:
         out.append((start, stop))
         start = stop
     return out
+
+
+def row_owners(n_rows: int, parts: int, rows) -> "np.ndarray":
+    """Vectorized inverse of :func:`row_partition`: for each global row id
+    in ``rows`` (array-like of ints in ``[0, n_rows)``), the rank whose
+    ``[start, stop)`` range owns it.  Because the first ``n_rows % parts``
+    ranges carry one extra row, ownership is a closed form — no layout
+    table or searchsorted needed — and stays a pure function of
+    ``(n_rows, parts)`` like the partition itself."""
+    check(parts > 0, f"row_owners needs parts > 0, got {parts}")
+    rows = np.asarray(rows, dtype=np.int64)
+    if rows.size and (rows.min() < 0 or rows.max() >= n_rows):
+        raise DMLCError(f"row_owners: row ids outside [0, {n_rows})")
+    base, extra = divmod(n_rows, parts)
+    if base == 0:
+        # parts > n_rows: row r lives alone in range r
+        return rows.copy()
+    fat = extra * (base + 1)          # rows covered by the +1 ranges
+    return np.where(rows < fat, rows // (base + 1),
+                    extra + (rows - fat) // base)
 
 
 def remap_rows(n_rows: int, old_parts: int, new_parts: int

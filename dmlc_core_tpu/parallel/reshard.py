@@ -168,6 +168,14 @@ class StateHandle:
     whole leaf, the replicated default).  ``checkpoint`` (manager or
     directory) is the last-resort source for shards no survivor holds.
 
+    ``snapshot`` — optional zero-arg callable returning a ready
+    :class:`HostSnapshot` (or None for "holds nothing") — replaces the
+    default ``snapshot_tree(get_state())`` path.  It exists for state
+    that is *already row-sharded in host memory* (the sharded embedding
+    table): such owners record ranged blocks via ``HostSnapshot.add(...,
+    start=, global_rows=)`` — including replica blocks of peers' shards —
+    which the whole-leaf ``snapshot_tree`` copy cannot express.
+
     COLLECTIVE CONTRACT: register the handle at the same point relative
     to control-plane collectives on every rank — the redistribute rounds
     run inside ``ensure()`` and must execute uniformly cohort-wide.
@@ -179,13 +187,16 @@ class StateHandle:
                  plan: Optional[Callable[[str, Tuple[int, ...]],
                                          Optional[Tuple[int, int]]]] = None,
                  checkpoint: Any = None,
-                 checkpoint_step: Optional[int] = None) -> None:
+                 checkpoint_step: Optional[int] = None,
+                 snapshot: Optional[Callable[[], Optional["HostSnapshot"]]]
+                 = None) -> None:
         self.get_state = get_state
         self.set_state = set_state
         self.template = template
         self.plan = plan
         self.checkpoint = checkpoint
         self.checkpoint_step = checkpoint_step
+        self.snapshot = snapshot
 
     def resolve_template(self) -> Any:
         t = self.template

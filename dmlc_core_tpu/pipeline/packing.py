@@ -34,7 +34,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -43,7 +43,7 @@ from ..utils.logging import IdOverflowError, log_warning
 from ..utils.metrics import metrics
 
 __all__ = ["pack_flat", "pack_rowmajor", "pack_ragged", "batch_slices",
-           "ragged_slices", "PackStats", "IdOverflowError"]
+           "ragged_slices", "dedup_ids", "PackStats", "IdOverflowError"]
 
 
 @dataclass
@@ -321,6 +321,22 @@ def ragged_slices(block: RowBlock, batch_rows: int,
                 f"truncates — raise the capacity")
         yield block.slice(start, end)
         start = end
+
+
+def dedup_ids(ids: np.ndarray, nnz_used: int
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Dedup a ragged batch's live id prefix for the sharded-embedding
+    wire: returns ``(uniq, pos)`` where ``uniq`` is the sorted unique
+    int64 id set of ``ids[:nnz_used]`` and ``pos`` (int32, ``nnz_used``
+    long) remaps each live entry into ``uniq``-space
+    (``uniq[pos[i]] == ids[i]``).  A batch that references a hot id a
+    thousand times then ships (and caches) its row once; the pooled
+    gather runs over the compacted row matrix with ``pos`` as the id
+    array.  Tail entries past ``nnz_used`` are garbage by the ragged
+    contract and never inspected."""
+    live = np.asarray(ids[:int(nnz_used)], dtype=np.int64)
+    uniq, pos = np.unique(live, return_inverse=True)
+    return uniq, pos.astype(np.int32, copy=False)
 
 
 def pack_ragged(block: RowBlock, batch_rows: int, nnz_cap: int,

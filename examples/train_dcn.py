@@ -9,6 +9,28 @@ parse → CSR RowBlock → fixed-shape device batches → jitted train step),
 with the cross network in place of the FM pairwise term: one sparse
 gather per step, then L dense [D, D] matmuls — the family member whose
 per-step compute is almost entirely MXU (see ``docs/models.md``).
+
+Scaling past one host
+---------------------
+The embedding here is a dense ``[features, dim]`` leaf inside the model
+params — fine until ``--features`` outgrows a single rank.  The sharded
+migration (``docs/distributed.md`` § "Sharded embeddings") swaps that
+leaf for a ``dmlc_core_tpu.embed.ShardedEmbeddingTable``:
+
+1. construct ``ShardedEmbeddingTable(args.features, args.dim, rank=...,
+   world=..., serve=True)`` instead of letting the model own the leaf —
+   a world-1 table is bit-identical to this script's gather, so the
+   swap can be validated single-host first;
+2. replace the in-step gather with ``pooled = table.lookup(batch)`` and
+   feed ``pooled`` to the cross/deep tower as a dense input;
+3. after the tower's backward, call ``table.backward(batch, g_pooled)``
+   and flush at the epoch boundary (``table.flush(ctx)``) in the
+   collective order ``examples/train_embed_shard.py`` demonstrates;
+4. register ``table.state_handle()`` with the elastic mesh so resizes
+   move shards live instead of re-reading checkpoints.
+
+``examples/train_embed_shard.py`` is the worked end-state of this
+migration, including crash recovery.
 """
 
 from __future__ import annotations

@@ -1,0 +1,283 @@
+"""DLRM-style training over a SHARDED embedding table — the table is
+bigger than any one rank, and a rank death costs zero checkpoint reads.
+
+Usage (the launcher respawns crashed ranks; ``--elastic`` is required)::
+
+    python -m dmlc_core_tpu.parallel.launcher.submit \
+        --cluster tpu -n 3 --elastic --max-attempts 2 -- \
+        python examples/train_embed_shard.py <uri> \
+            [--features N --dim D --hidden H --epochs E] \
+            [--crash-rank R --crash-epoch E] [--dispatcher HOST:PORT]
+
+The model is pooled-embedding + MLP: each ragged CSR batch looks up a
+:class:`~dmlc_core_tpu.embed.ShardedEmbeddingTable` (deduped fan-out
+exchange to the owning ranks, hot-row cache, replica failover), the
+pooled ``[batch_rows, dim]`` output feeds a small dense tower, and the
+pooled gradient flows back through ``table.backward`` as sparse
+per-row updates that only cross the wire at the epoch flush.
+
+**Determinism contract** (what the chaos test asserts): the table is
+FROZEN within an epoch — lookups are read-only and gradients accumulate
+host-side — and the epoch flush is collective (every holder applies
+every rank's grads in rank order), so the run is bit-reproducible
+kill-or-no-kill.  A reborn rank COMPUTES its join epoch: it restores
+the epoch number, dense tower, and shard-server addresses from the tiny
+rabit checkpoint, looks up every row remotely (its own shard is served
+by replica holders while it owns nothing), and contributes gradients
+exactly as the dead rank would have.  No embedding row is ever read
+from a checkpoint — ``from_ckpt`` stays 0 in the EPOCH records.
+
+Epoch sync point, in collective order — identical on every rank:
+(1) loss allreduce, (2) dense-tower averaging allreduces, (3) the
+collective ``table.flush``, (4) ``mesh.resync()`` — on a rebuild the
+resharder redistributes shards live (``remap_rows`` intervals), then
+``sync_addresses`` + ``rebuild_replicas`` restore the serving layout —
+and (5) the rabit position checkpoint LAST.
+
+``--dispatcher`` feeds batches from the disaggregated data service
+instead of the local parser (demo/throughput mode: shard leases are
+dynamic, so per-rank batch sets are not run-reproducible — chaos tests
+use the default deterministic ``create_parser`` partition path).
+
+``--crash-rank/--crash-epoch`` inject a one-shot crash (first attempt
+only) at the TOP of the epoch loop; ``fault_point("embed.epoch")``
+arms the same kill via ``DMLC_FAULT_SPEC`` (e.g.
+``embed.epoch:error=1:times=1:after=1`` kills entering epoch 1, after
+epoch 0 is fully synced and checkpointed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _ragged_from_fused(buf: np.ndarray, meta: int, rows: int):
+    """Host-side decode of one v2 fused wire frame (``ids|vals|row_ptr|
+    labels|weights``) back into the ragged batch dict the table speaks.
+    The compact v3 wire would need the dictionary decode — out of scope
+    for this example."""
+    nnz = meta & 0xFFFFFFFF
+    if meta >> 32:
+        raise ValueError("train_embed_shard: compact (v3) wire frames are "
+                         "not supported here — run the service with the "
+                         "plain v2 wire")
+    rp = buf[2 * nnz:2 * nnz + rows + 1]
+    total = int(rp[rows])
+    segments = np.empty(nnz, np.int32)
+    segments[:total] = np.repeat(np.arange(rows, dtype=np.int32),
+                                 np.diff(rp))
+    weights = buf[2 * nnz + 2 * rows + 1:2 * nnz + 3 * rows + 1].view(
+        np.float32)
+    return {"ids": buf[:nnz].copy(),
+            "vals": buf[nnz:2 * nnz].view(np.float32).copy(),
+            "segments": segments,
+            "row_ptr": rp.copy(),
+            "labels": buf[2 * nnz + rows + 1:2 * nnz + 2 * rows + 1].view(
+                np.float32).copy(),
+            "weights": weights.copy(),
+            "nnz_used": np.int32(total),
+            "rows_used": np.int32(int((weights != 0).sum()))}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("uri")
+    ap.add_argument("--features", type=int, default=1 << 16)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-rows", type=int, default=128)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=0.1,
+                    help="dense-tower SGD step")
+    ap.add_argument("--embed-lr", type=float, default=0.05,
+                    help="embedding-row SGD step (applied at flush)")
+    ap.add_argument("--crash-rank", type=int, default=-1)
+    ap.add_argument("--crash-epoch", type=int, default=-1)
+    ap.add_argument("--state-ckpt-dir", default="",
+                    help="arm the resharder's per-leaf checkpoint fallback")
+    ap.add_argument("--dispatcher", default="",
+                    help="HOST:PORT of a data-service dispatcher; default "
+                         "is the deterministic local-parser partition")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dmlc_core_tpu.data import create_parser
+    from dmlc_core_tpu.embed import ShardedEmbeddingTable
+    from dmlc_core_tpu.parallel import ElasticJaxMesh, RabitContext
+    from dmlc_core_tpu.pipeline.packing import pack_ragged, ragged_slices
+    from dmlc_core_tpu.utils.faults import FaultInjected, fault_point
+
+    nnz_cap = args.batch_rows * 16
+    attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
+    ctx = RabitContext.from_env()
+    rank, world = ctx.rank, ctx.world_size
+
+    # deterministic dense tower: identical init on every rank
+    rng = np.random.default_rng(7)
+    dense = {
+        "w1": (rng.standard_normal((args.dim, args.hidden))
+               / np.sqrt(args.dim)).astype(np.float32),
+        "b1": np.zeros(args.hidden, np.float32),
+        "w2": (rng.standard_normal(args.hidden)
+               / np.sqrt(args.hidden)).astype(np.float32),
+        "b2": np.zeros((), np.float32),
+    }
+
+    start_epoch = 0
+    saved_addrs = None
+    if attempt > 0:
+        saved = ctx.load_checkpoint()     # rabit seq fast-forwards here
+        if saved is not None:
+            start_epoch = saved["epoch"] + 1
+            dense = {k: np.asarray(v) for k, v in saved["dense"].items()}
+            saved_addrs = saved["addrs"]
+        print(f"rank {rank} reborn (attempt {attempt}), "
+              f"resuming at epoch {start_epoch}", flush=True)
+
+    # A reborn rank holds NOTHING (hold=False): its shard lives on the
+    # survivors' replicas until the next resync redistributes it back.
+    # It still serves (empty answers make clients fail over) and still
+    # COMPUTES its join epoch via remote lookups.
+    table = ShardedEmbeddingTable(
+        args.features, args.dim, rank=rank, world=world,
+        replicas=args.replicas, lr=args.embed_lr, hold=(attempt == 0),
+        flush_every=0, serve=True)
+    if saved_addrs is not None:
+        table.set_addresses(saved_addrs)
+
+    mesh = ElasticJaxMesh(ctx)            # launcher provides the base port
+    mesh.register_state(table.state_handle(
+        checkpoint=args.state_ckpt_dir or None))
+    if attempt == 0:
+        mesh.initialize()
+        table.sync_addresses(ctx)
+        # checkpoint the post-join position IMMEDIATELY so a rank that
+        # dies before its first epoch checkpoint still restores a rabit
+        # seq (and address map) matching the survivors
+        ctx.checkpoint({"epoch": -1, "dense": dense,
+                        "addrs": table.addresses})
+    # A REBORN rank must NOT initialize here: survivors are blocked in
+    # the epoch-loss allreduce, so the reborn's next rabit collective
+    # must be that same allreduce (table lookups are point-to-point TCP
+    # and don't consume rabit frames).
+
+    @jax.jit
+    def step(d, pooled, labels, weights):
+        def f(dd, p):
+            h = jnp.tanh(p @ dd["w1"] + dd["b1"])
+            logit = h @ dd["w2"] + dd["b2"]
+            ll = (labels * jax.nn.log_sigmoid(logit)
+                  + (1.0 - labels) * jax.nn.log_sigmoid(-logit))
+            return -(weights * ll).sum()
+
+        loss, (gd, gp) = jax.value_and_grad(f, argnums=(0, 1))(d, pooled)
+        return loss, gd, gp
+
+    def batches():
+        if args.dispatcher:
+            from dmlc_core_tpu.pipeline.data_service import DataServiceLoader
+            host, _, port = args.dispatcher.rpartition(":")
+            spec = {"uri": args.uri, "fmt": "libsvm",
+                    "num_parts": max(world * 4, 8),
+                    "batch_rows": args.batch_rows, "nnz_cap": nnz_cap,
+                    "id_mod": args.features}
+            loader = DataServiceLoader((host, int(port)), spec, emit="host")
+            try:
+                for kind, buf, meta, rows in loader:
+                    yield _ragged_from_fused(buf, meta, rows)
+                    loader.recycle(buf)
+            finally:
+                loader.close()
+        else:
+            for container in create_parser(args.uri, rank, world, "libsvm"):
+                block = container.get_block()
+                for sl in ragged_slices(block, args.batch_rows, nnz_cap):
+                    yield pack_ragged(sl, args.batch_rows, nnz_cap,
+                                      id_mod=args.features)
+
+    def digest() -> str:
+        h = hashlib.sha1()
+        for k in sorted(dense):
+            h.update(k.encode())
+            h.update(np.ascontiguousarray(dense[k]).tobytes())
+        s, e = table.partition[rank]
+        block = table.read_block(s, e) if s < e else None
+        h.update(block.tobytes() if block is not None else b"")
+        return h.hexdigest()[:16]
+
+    for epoch in range(start_epoch, args.epochs):
+        if (attempt == 0 and rank == args.crash_rank
+                and epoch == args.crash_epoch):
+            print(f"rank {rank} CRASHING at epoch {epoch}", flush=True)
+            os._exit(7)
+        try:
+            # chaos kill site: TOP of the epoch loop — epoch e-1 is fully
+            # synced and checkpointed, epoch e not yet computed, nothing
+            # pending.  The reborn recomputes THIS epoch from the rabit
+            # checkpoint + remote lookups; survivors block in the loss
+            # allreduce until the launcher respawns it.
+            fault_point("embed.epoch")
+        except FaultInjected:
+            print(f"rank {rank} CRASHING at epoch {epoch}", flush=True)
+            os._exit(7)
+
+        loss_sum = 0.0
+        weight_sum = 0.0
+        for batch in batches():
+            pooled = table.lookup(batch)
+            loss, gd, gp = step(dense, pooled, batch["labels"],
+                                batch["weights"])
+            for k in dense:
+                dense[k] = dense[k] - args.lr * np.asarray(gd[k])
+            table.backward(batch, np.asarray(gp))
+            loss_sum += float(loss)
+            weight_sum += float(batch["weights"].sum())
+
+        # Epoch sync point — see module docstring for the collective order.
+        agg = ctx.allreduce(np.array([loss_sum, weight_sum], np.float64))
+        mean_loss = float(agg[0]) / max(float(agg[1]), 1.0)
+        for k in sorted(dense):
+            summed = ctx.allreduce(np.ascontiguousarray(
+                np.atleast_1d(dense[k]), dtype=np.float64))
+            dense[k] = ((summed / world).astype(np.float32)
+                        .reshape(dense[k].shape))
+        table.flush(ctx)
+        res = mesh.resync()
+        if res.rebuilt:
+            # adopt_restored already ran via the state handle; re-agree
+            # the (possibly new) server addresses, then refetch replica
+            # blocks from the new primaries
+            table.sync_addresses(ctx)
+            table.rebuild_replicas()
+        ctx.checkpoint({"epoch": epoch, "dense": dense,
+                        "addrs": table.addresses})
+        stats = res.stats
+        rec = {"rank": rank, "epoch": epoch, "loss": round(mean_loss, 6),
+               "gen": mesh.generation, "rebuilt": bool(res),
+               "digest": digest(),
+               "from_peers": getattr(stats, "leaves_from_peers", 0),
+               "from_ckpt": getattr(stats, "leaves_from_checkpoint", 0),
+               "bytes_moved": getattr(stats, "bytes_moved", 0),
+               "resident": table.resident_bytes}
+        print("EPOCH " + json.dumps(rec), flush=True)
+        print(f"rank {rank} epoch {epoch} mean_loss {mean_loss:.5f}"
+              + (f" [mesh rebuilt -> gen {mesh.generation}]"
+                 if res.rebuilt else ""), flush=True)
+
+    print(f"rank {rank} DONE gen={mesh.generation}", flush=True)
+    table.close()
+    mesh.close()
+    ctx.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,19 @@ Works with any registered URI scheme (file/http/s3/gs/hdfs). Demonstrates
 the full ladder: URI → partitioned InputSplit → native parse → CSR
 RowBlock → fixed-shape device batches → jitted train step, with periodic
 metrics and a checkpoint at the end.
+
+Scaling past one host
+---------------------
+The FM factor matrix is a dense ``[features, dim]`` param leaf; when
+``--features`` no longer fits one rank, migrate the embedding side to
+``dmlc_core_tpu.embed.ShardedEmbeddingTable`` (``docs/distributed.md``
+§ "Sharded embeddings"): construct the table with ``world=1`` first (its
+lookup is bit-identical to the dense gather, so the swap validates
+single-host), move the per-row pooled sum to ``table.lookup(batch)`` /
+``table.backward(batch, g_pooled)``, flush at epoch boundaries with
+``table.flush(ctx)``, and register ``table.state_handle()`` with the
+elastic mesh.  ``examples/train_embed_shard.py`` is the worked
+end-state, including crash recovery.
 """
 
 from __future__ import annotations

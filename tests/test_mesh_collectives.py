@@ -50,6 +50,21 @@ def test_mesh_collectives_allreduce_broadcast_allgather():
     np.testing.assert_allclose(coll.allgather(per_rank), per_rank)
 
 
+def test_mesh_collectives_all_to_all_transposes_rank_blocks():
+    """The embedding-exchange primitive: rank s's d-th slot lands in rank
+    d's s-th slot — globally a transpose of the leading two axes."""
+    need8()
+    mesh = data_parallel_mesh()
+    coll = MeshCollectives(mesh, "dp")
+    world = coll.world_size
+    per_rank = np.arange(world * world * 3, dtype=np.float32).reshape(
+        world, world, 3)
+    got = coll.all_to_all(per_rank)
+    np.testing.assert_allclose(got, per_rank.swapaxes(0, 1))
+    # involution: exchanging twice is the identity
+    np.testing.assert_allclose(coll.all_to_all(np.asarray(got)), per_rank)
+
+
 def test_graft_entry_dryrun():
     need8()
     import sys

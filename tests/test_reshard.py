@@ -50,6 +50,45 @@ def test_remap_rows_shrink_and_grow():
         assert all(a[2] == b[1] for a, b in zip(feeds, feeds[1:]))
 
 
+def test_remap_rows_edge_cases_and_minimality():
+    """Degenerate layouts (more parts than rows, empty shards) and the
+    moved-set property: a remap plan must move EXACTLY the rows whose
+    owner changed — nothing replayed, nothing gratuitous."""
+    from dmlc_core_tpu.parallel import row_owners
+
+    # parts > n_rows on either side: trailing empty shards get no feeds
+    assert remap_rows(2, 4, 1) == [[(0, 0, 1), (1, 1, 2)]]
+    assert remap_rows(2, 1, 4) == [[(0, 0, 1)], [(0, 1, 2)], [], []]
+    assert remap_rows(0, 2, 3) == [[], [], []]
+
+    for n in (1, 2, 7, 10, 97):
+        for old_p in (1, 2, 3, 5, 12):
+            for new_p in (1, 2, 4, 11):
+                plan = remap_rows(n, old_p, new_p)
+                assert len(plan) == new_p
+                # exactly-once cover: the union of feeds is a disjoint
+                # in-order tiling of [0, n)
+                cover = [iv for feeds in plan for iv in feeds]
+                assert sum(hi - lo for _, lo, hi in cover) == n
+                flat = sorted((lo, hi) for _, lo, hi in cover)
+                assert all(a[1] == b[0] for a, b in zip(flat, flat[1:]))
+                if n:
+                    assert flat[0][0] == 0 and flat[-1][1] == n
+                # feeds only name ranks that actually own those rows
+                rows = np.arange(n, dtype=np.int64)
+                old_own = row_owners(n, old_p, rows) if n else rows
+                new_own = row_owners(n, new_p, rows) if n else rows
+                moved = 0
+                for new_rank, feeds in enumerate(plan):
+                    for old_rank, lo, hi in feeds:
+                        assert (old_own[lo:hi] == old_rank).all()
+                        assert (new_own[lo:hi] == new_rank).all()
+                        if old_rank != new_rank:
+                            moved += hi - lo
+                # minimality: moved rows == rows whose owner changed
+                assert moved == int((old_own != new_own).sum())
+
+
 # ---------------------------------------------------------------------------
 # snapshot
 # ---------------------------------------------------------------------------
