@@ -17,8 +17,17 @@ Sweeps the load axes that matter for a serving replica:
   overload      queue bound set tiny — verifies explicit shed, measures
                 goodput under 4x admission pressure
 
+``--router`` swaps the sweep for the serving-fleet one (committed as
+BENCH_router_r{N}.json): a direct single-replica baseline, the same
+load through a router over 1/2/3 replicas (the scaling curve), a
+single-connection round-trip pair measuring the hop cost proper (the
+≤10% p50 overhead bar), 2× admission pressure over two small-queue
+replicas (``shed_pct``), and a rolling restart of all three replicas
+under load (``rolling_restart_p99_ms``, zero failed requests).
+
 Usage: python benchmarks/bench_serving.py [out.json]
                                           [--telemetry-out PREFIX]
+                                          [--router]
 Env:   DMLC_SERVE_REQUESTS (default 2000), DMLC_SERVE_FEATURES (2^16),
        DMLC_SERVE_MODEL (fm), DMLC_SERVE_DIM (16),
        DMLC_TELEMETRY_OUT (same as --telemetry-out)
@@ -44,6 +53,181 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def router_bench(model, params, *, requests: int, features: int):
+    """The serving-fleet sweep: router scaling, shed under 2x pressure,
+    rolling restart under load.  Returns the scenarios dict + headline
+    numbers (callers merge into the artifact)."""
+    import contextlib
+    import threading
+
+    from dmlc_core_tpu.serving import (InferenceEngine, PredictionServer,
+                                       ReplicaRegistry, ServingRouter,
+                                       run_load)
+    from dmlc_core_tpu.utils.metrics import metrics
+
+    @contextlib.contextmanager
+    def env(**kw):
+        old = {k: os.environ.get(k) for k in kw}
+        os.environ.update({k: str(v) for k, v in kw.items()})
+        try:
+            yield
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def replica(max_queue=256):
+        engine = InferenceEngine(model, params, postprocess="sigmoid")
+        # metrics_port=0: ephemeral /healthz so the router reads queue
+        # fraction, exactly the production wiring
+        return PredictionServer(engine, max_queue=max_queue, warmup=True,
+                                metrics_port=0).start()
+
+    def counters(snap):
+        return {k: v["value"] for k, v in sorted(snap.items())
+                if k.startswith(("serving.router.", "fleet.",
+                                 "retry.", "circuit."))
+                and "value" in v}
+
+    out = {}
+
+    def finish(name, rep):
+        snap = metrics.snapshot()
+        rep["router_counters"] = counters(snap)
+        out[name] = rep
+        log(f"{name}: qps={rep['qps']:.0f} "
+            f"p50={rep['latency_ms']['p50']:.2f}ms "
+            f"p99={rep['latency_ms']['p99']:.2f}ms ok={rep['ok']} "
+            f"shed={rep['overload']} rejected={rep['rejected']}")
+
+    # direct baseline: one replica, no router — the capacity-point
+    # comparison for the scaling curve below
+    metrics.reset()
+    srv = replica()
+    try:
+        finish("direct", run_load(srv.host, srv.port, requests=requests,
+                                  features=features, concurrency=4,
+                                  pipeline_depth=16))
+    finally:
+        srv.stop()
+
+    # hop cost proper: a single-connection round trip, direct vs through
+    # the router.  The saturation shapes above co-schedule the router,
+    # three engines and the load generator on one interpreter, so their
+    # p50 delta measures GIL contention, not the hop — this pair is the
+    # ≤10% overhead acceptance bar.
+    rt_requests = min(requests, 1500)
+    metrics.reset()
+    srv = replica()
+    try:
+        finish("direct_rt", run_load(srv.host, srv.port,
+                                     requests=rt_requests,
+                                     features=features, concurrency=1,
+                                     pipeline_depth=1))
+    finally:
+        srv.stop()
+    metrics.reset()
+    srv = replica()
+    router = ServingRouter(replicas=[
+        (srv.host, srv.port, srv.telemetry.port)]).start()
+    try:
+        finish("router_rt", run_load(router.host, router.port,
+                                     requests=rt_requests,
+                                     features=features, concurrency=1,
+                                     pipeline_depth=1))
+    finally:
+        router.stop()
+        srv.stop()
+
+    # the same capacity-point load through a static router over 1/2/3
+    # replicas — scaling curve + the ≤10% p50 overhead acceptance bar
+    for n in (1, 2, 3):
+        metrics.reset()
+        srvs = [replica() for _ in range(n)]
+        router = ServingRouter(replicas=[
+            (s.host, s.port, s.telemetry.port) for s in srvs]).start()
+        try:
+            finish(f"router_{n}",
+                   run_load(router.host, router.port, requests=requests,
+                            features=features, concurrency=4,
+                            pipeline_depth=16))
+        finally:
+            router.stop()
+            for s in srvs:
+                s.stop()
+
+    # 2x-capacity admission pressure over two tiny-queue replicas: the
+    # router hedges overload rejects across the fleet first, then sheds
+    # honestly once the whole fleet is saturated
+    metrics.reset()
+    srvs = [replica(max_queue=16) for _ in range(2)]
+    router = ServingRouter(replicas=[
+        (s.host, s.port, s.telemetry.port) for s in srvs]).start()
+    try:
+        finish("overload_2x",
+               run_load(router.host, router.port, requests=requests,
+                        features=features, concurrency=8,
+                        pipeline_depth=32))
+    finally:
+        router.stop()
+        for s in srvs:
+            s.stop()
+
+    # rolling restart: registry-fed router, three replicas restarted one
+    # by one (new ports) under a paced closed loop — zero failed requests
+    # is the acceptance bar, the p99 is the disruption headline
+    metrics.reset()
+    reg = ReplicaRegistry(heartbeat_timeout_s=1.0).start()
+    rr_requests = max(requests, 2000)
+    with env(DMLC_ROUTER_REGISTRY=f"{reg.host}:{reg.port}",
+             DMLC_ROUTER_HEARTBEAT="0.1", DMLC_ROUTER_RETRIES="6"):
+        srvs = [replica() for _ in range(3)]
+        router = ServingRouter(registry=reg.address, sync_s=0.1).start()
+        rep = {}
+        t = threading.Thread(
+            target=lambda: rep.update(
+                run_load(router.host, router.port, requests=rr_requests,
+                         features=features, concurrency=2,
+                         pipeline_depth=1, timeout=120.0)),
+            name="bench-rr-load", daemon=True)
+        try:
+            t.start()
+            time.sleep(0.3)
+            for i in range(3):
+                old = srvs[i]
+                old.stop()
+                srvs[i] = replica()      # fresh port, auto-registers
+                time.sleep(0.5)
+            t.join(timeout=180.0)
+        finally:
+            router.stop()
+            for s in srvs:
+                s.stop()
+            reg.stop()
+    rep["requests"] = rr_requests
+    finish("rolling_restart", rep)
+
+    headlines = {
+        "router_overhead_p50": (
+            (out["router_rt"]["latency_ms"]["p50"]
+             - out["direct_rt"]["latency_ms"]["p50"])
+            / max(out["direct_rt"]["latency_ms"]["p50"], 1e-9)),
+        "scaling_qps": {str(n): out[f"router_{n}"]["qps"]
+                        for n in (1, 2, 3)},
+        "shed_pct": 100.0 * out["overload_2x"]["overload"]
+        / max(1, out["overload_2x"]["ok"] + out["overload_2x"]["overload"]),
+        "rolling_restart_p99_ms": out["rolling_restart"]["latency_ms"]["p99"],
+        "rolling_restart_failed": out["rolling_restart"]["rejected"],
+    }
+    log(f"router overhead p50: {headlines['router_overhead_p50'] * 100:+.1f}%"
+        f"  shed_pct={headlines['shed_pct']:.1f}"
+        f"  rolling_restart_p99={headlines['rolling_restart_p99_ms']:.1f}ms"
+        f"  failed={headlines['rolling_restart_failed']}")
+    return out, headlines
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -54,6 +238,9 @@ def main() -> int:
     from dmlc_core_tpu.utils.metrics import metrics
 
     argv = sys.argv[1:]
+    router_mode = "--router" in argv
+    if router_mode:
+        argv.remove("--router")
     telemetry_prefix = os.environ.get("DMLC_TELEMETRY_OUT")
     if "--telemetry-out" in argv:
         i = argv.index("--telemetry-out")
@@ -72,11 +259,26 @@ def main() -> int:
     params = model.init(jax.random.PRNGKey(0))
 
     report = {
-        "bench": "serving", "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "bench": "router" if router_mode else "serving",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "backend": jax.default_backend(), "model": model_name,
         "features": features, "dim": dim, "requests": requests,
         "scenarios": {},
     }
+
+    if router_mode:
+        scenarios, headlines = router_bench(model, params,
+                                            requests=requests,
+                                            features=features)
+        report["scenarios"] = scenarios
+        report.update(headlines)
+        blob = json.dumps(report, indent=2)
+        print(blob)
+        if argv:
+            with open(argv[0], "w") as f:
+                f.write(blob + "\n")
+            log(f"wrote {argv[0]}")
+        return 0
 
     def scenario(name, *, max_queue=256, arm_flight=False, engine_kw=None,
                  **load_kw):

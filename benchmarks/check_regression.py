@@ -57,10 +57,15 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 #  row after dedup + hot-row caching — every byte shaved is exchange
 #  bandwidth back; the family's embed_lookup_rows_s gates higher-better
 #  via "rows" as usual.
+#  The router family (ISSUE 13, BENCH_router_r*.json) gates lower-better
+#  on shed_pct (via "shed"), rolling_restart_p99_ms (via "p99"/"_ms") and
+#  router_overhead_p50 (via "overhead"); scaling_qps gates higher-better
+#  via "qps".
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
                  "errors", "misses", "padding_ratio", "truncated",
-                 "epochs_to_converge", "bytes_per_row")
+                 "epochs_to_converge", "bytes_per_row",
+                 "shed_pct", "rolling_restart_p99_ms")
 
 
 def _direction(key: str) -> Optional[str]:

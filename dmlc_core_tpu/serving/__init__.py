@@ -17,6 +17,10 @@ users"):
 * :mod:`server` / :mod:`client` — pipelined length-prefixed TCP frames
   (the `pipeline/ingest_service.py` wire idiom) carrying CSR requests
   and float predictions, plus a load-generator mode for benchmarking.
+* :mod:`fleet`   — horizontal scale-out: replica registry (heartbeat
+  liveness, multi-model map), least-loaded routing front-end speaking
+  the same wire protocol, and canary checkpoint rollout with
+  auto-rollback.
 
 Everything reports into ``utils.metrics`` (QPS, queue depth, batch
 occupancy, p50/p95/p99 latency via the ``Histogram`` primitive).  See
@@ -30,10 +34,15 @@ from .batcher import (DeadlineExceeded, MicroBatcher, Overloaded,  # noqa: F401
 from .server import PredictionServer  # noqa: F401
 from .client import (PredictClient, ServerOverloaded, ServerRejected,  # noqa: F401
                      run_load)
+# fleet imports come last: its modules import from .server/.client
+from .fleet import (ReplicaAgent, ReplicaRegistry, RolloutManager,  # noqa: F401
+                    ServingRouter, fleet_rpc)
 
 __all__ = [
     "ShapeBucket", "BucketLadder", "InferenceEngine", "RequestTooLarge",
     "MicroBatcher", "Overloaded", "DeadlineExceeded", "Shutdown",
     "PredictionServer", "PredictClient", "ServerOverloaded",
     "ServerRejected", "run_load",
+    "ReplicaRegistry", "ReplicaAgent", "ServingRouter", "RolloutManager",
+    "fleet_rpc",
 ]

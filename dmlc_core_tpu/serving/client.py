@@ -35,9 +35,10 @@ from ..utils.metrics import Histogram, metrics
 from ..utils.parameter import get_env
 from ..utils.retry import (CircuitBreaker, Deadline, DeadlineExpired,
                            RetriesExhausted, RetryPolicy)
-from .server import (REQ_HEADER, RSP_HEADER, STATUS_DEADLINE,
-                     STATUS_NAMES, STATUS_OK, STATUS_OVERLOADED,
-                     STATUS_SHUTDOWN, _recv_exact)
+from .server import (HELLO_REQ_ID, REQ_HEADER, RSP_HEADER,
+                     STATUS_DEADLINE, STATUS_NAMES, STATUS_OK,
+                     STATUS_OVERLOADED, STATUS_SHUTDOWN, _recv_exact,
+                     pack_hello)
 
 __all__ = ["PredictClient", "ServerOverloaded", "ServerRejected",
            "run_load"]
@@ -72,17 +73,38 @@ class PredictClient:
       ``DMLC_SERVING_RECONNECT=0`` restores fail-fast.
     * :meth:`submit` stays raw — one frame, no retries — because pipelined
       callers (the load generator) want to SEE every shed.
+    * ``endpoints`` extends every (re)dial into an ordered sweep over
+      replica addresses — a router-less client fails over across a
+      static fleet: the primary ``(host, port)`` is tried first, then
+      each fallback in order, and the reconnect budget applies to whole
+      sweeps, not single addresses.  Landing anywhere but the previous
+      address counts on ``serving.client.failovers``.
+    * ``model_id`` (when set) sends the HELLO preamble on every new
+      connection, so a misrouted endpoint rejects at dial time instead
+      of scoring against the wrong checkpoint.
 
     Counters: ``retry.serving.client.*`` (overload retries),
-    ``serving.client.reconnects``, ``circuit.serving.reconnect.*``.
+    ``serving.client.reconnects``, ``serving.client.failovers``,
+    ``circuit.serving.reconnect.*``.
     """
 
     def __init__(self, host: str, port: int,
                  connect_timeout: float = 30.0, *,
-                 reconnect: Optional[bool] = None) -> None:
+                 reconnect: Optional[bool] = None,
+                 endpoints: Optional[List[Tuple[str, int]]] = None,
+                 model_id: Optional[str] = None) -> None:
         self._host = host
         self._port = int(port)
         self._connect_timeout = connect_timeout
+        self._model_id = model_id
+        # ordered dial list: the primary first, then every distinct
+        # fallback in caller order
+        self._endpoints: List[Tuple[str, int]] = [(host, int(port))]
+        for ep in endpoints or []:
+            addr = (str(ep[0]), int(ep[1]))
+            if addr not in self._endpoints:
+                self._endpoints.append(addr)
+        self._last_ep: Optional[Tuple[str, int]] = None
         if reconnect is None:
             reconnect = get_env("DMLC_SERVING_RECONNECT", True)
         self._reconnect_enabled = bool(reconnect)
@@ -104,16 +126,40 @@ class PredictClient:
         self._pending: Dict[int, Tuple[Future, bytes]] = {}
         self._next_id = 0
         self._closed = False
+        self._dead: Optional[DMLCError] = None   # terminal reader error
         self._gen = 0              # bumps on every (re)connection
         self._sock = self._dial()
         self._start_reader(self._gen)
 
     def _dial(self) -> socket.socket:
-        sock = socket.create_connection((self._host, self._port),
-                                        timeout=self._connect_timeout)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        sock.settimeout(None)
-        return sock
+        """One sweep over the ordered endpoint list; raises the LAST
+        dial error only when every endpoint refused."""
+        last_exc: Optional[OSError] = None
+        for addr in self._endpoints:
+            try:
+                sock = socket.create_connection(
+                    addr, timeout=self._connect_timeout)
+            except OSError as e:
+                last_exc = e
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            if self._model_id is not None:
+                try:
+                    sock.sendall(pack_hello(self._model_id))
+                except OSError as e:
+                    last_exc = e
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
+            if self._last_ep is not None and addr != self._last_ep:
+                metrics.counter("serving.client.failovers").add(1)
+            self._last_ep = addr
+            return sock
+        raise last_exc if last_exc is not None else OSError(
+            "no endpoints configured")
 
     def _start_reader(self, gen: int) -> None:
         self._reader = threading.Thread(
@@ -145,6 +191,12 @@ class PredictClient:
                                       STATUS_OK else n)
                 if payload is None:
                     raise DMLCError("server died mid-response")
+                if req_id == HELLO_REQ_ID:
+                    # only a REJECTED hello is ever answered; reconnect
+                    # retries can't fix a model mismatch, so fail hard
+                    self._reconnect_enabled = False
+                    raise DMLCError("model hello rejected: "
+                                    + payload.decode("utf-8", "replace"))
                 if status == STATUS_SHUTDOWN and self._reconnect_enabled:
                     # a draining/restarting replica answers SHUTDOWN for
                     # requests it will never serve; leave them in
@@ -219,7 +271,12 @@ class PredictClient:
             pass
 
     def _fail_all_pending(self, err: DMLCError) -> None:
+        # once this runs no reader thread exists, so a later submit()
+        # would hang forever — the same lock that swaps the pending map
+        # marks the client dead, closing the race where a submit lands
+        # between the swap and the flag
         with self._plock:
+            self._dead = err
             pending, self._pending = self._pending, {}
         for fut, _frame in pending.values():
             self._resolve(fut, exc=err)
@@ -239,6 +296,9 @@ class PredictClient:
         with self._plock:
             if self._closed:
                 fut.set_exception(DMLCError("client closed"))
+                return fut
+            if self._dead is not None:
+                fut.set_exception(self._dead)
                 return fut
             req_id = self._next_id
             self._next_id += 1
@@ -350,7 +410,9 @@ def run_load(host: str, port: int, *, requests: int = 2000,
              concurrency: int = 4, pipeline_depth: int = 8,
              rows_per_req: int = 4, nnz_per_row: int = 32,
              features: int = 1 << 16, seed: int = 0,
-             timeout: float = 60.0) -> Dict[str, Any]:
+             timeout: float = 60.0,
+             endpoints: Optional[List[Tuple[str, int]]] = None,
+             model_id: Optional[str] = None) -> Dict[str, Any]:
     """Drive a serving endpoint and measure it.
 
     ``concurrency`` connections each keep ``pipeline_depth`` requests in
@@ -370,7 +432,9 @@ def run_load(host: str, port: int, *, requests: int = 2000,
     def worker(widx: int, n: int) -> None:
         rng = np.random.default_rng(seed + widx)
         try:
-            client = PredictClient(host, port, connect_timeout=timeout)
+            client = PredictClient(host, port, connect_timeout=timeout,
+                                   endpoints=endpoints,
+                                   model_id=model_id)
         except OSError as e:
             with lock:
                 errors.append(f"connect: {e}")

@@ -1,0 +1,29 @@
+"""Replicated serving fleet: registry control plane, routing front-end,
+canary checkpoint rollout.
+
+The serving tier's horizontal story (ROADMAP "[scale/serving]"), in the
+shape of the data-service dispatcher (PR 10, tf.data-service lineage —
+PAPERS.md arxiv 2210.14826): a small JSON-line control plane owns
+membership and liveness while the data plane stays on the existing
+pipelined serving wire protocol.
+
+* :mod:`registry` — :class:`ReplicaRegistry` (auto-registration,
+  heartbeat liveness, multi-model map) + :class:`ReplicaAgent` (runs
+  inside a replica: registers, heartbeats, applies reload directives).
+* :mod:`router`   — :class:`ServingRouter`, a pipelined TCP front-end
+  fanning requests across replicas with least-loaded pick-2 weighting,
+  degraded-drain, straggler eviction and replica-aware retry budgets.
+* :mod:`rollout`  — :class:`RolloutManager`, canary checkpoint rollout:
+  stage a hot-reload on a replica subset, bake against SLO/p99 deltas,
+  promote fleet-wide or auto-roll-back, every transition in a bounded
+  ledger served at ``/rollouts`` and attached to flight bundles.
+
+See docs/serving.md ("Serving fleet") for topology and knobs.
+"""
+
+from .registry import ReplicaAgent, ReplicaRegistry, fleet_rpc  # noqa: F401
+from .rollout import RolloutManager  # noqa: F401
+from .router import ServingRouter  # noqa: F401
+
+__all__ = ["ReplicaRegistry", "ReplicaAgent", "fleet_rpc",
+           "ServingRouter", "RolloutManager"]

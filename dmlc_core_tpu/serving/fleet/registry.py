@@ -1,0 +1,529 @@
+"""Replica registry: membership, heartbeat liveness, multi-model map.
+
+One registry process owns the serving fleet's metadata, exactly the
+dispatcher's role for the ingest fleet (`pipeline/data_service/`): a
+replica registers (or simply starts heartbeating — an unknown jobid's
+heartbeat carrying an address IS a registration, so a replica that
+outlives a registry restart re-appears on its next beat), rides its
+health report and a full metrics-registry state push on every beat, and
+is declared dead by the shared
+:class:`~dmlc_core_tpu.parallel.tracker.LivenessBoard` rules when it
+falls silent.  The state pushes feed the same tracker-side
+:class:`~dmlc_core_tpu.telemetry.anomaly.StragglerBoard` the data
+service uses, so the router can evict a replica that is alive but
+consistently slower than its peers.
+
+The **multi-model map** (``model_id`` → checkpoint dir → replica set)
+lets one fleet serve many checkpoints: each replica names its model at
+registration, ``list_replicas`` filters by model, and the canary
+rollout machinery (:mod:`.rollout`) moves a model's stable checkpoint
+pointer independently of every other model's.
+
+Control flow back to replicas is **pull-based**: the registry never
+dials a replica.  Directives (canary/promote/rollback hot-reloads)
+queue per-jobid and ride heartbeat *replies*; the replica applies them
+and acks on its next beat.  A replica behind NAT or a container bridge
+needs no reachable control port.
+
+Wire protocol: the tracker's JSON-line vocabulary (``send_json`` /
+``recv_json``), one request per connection; traced requests
+(``trace_id``/``parent_span`` keys) are handled under a
+``serving.fleet.rpc`` span parented to the caller.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...parallel.tracker import LivenessBoard, recv_json, send_json
+from ...telemetry import flight as flight_mod
+from ...telemetry import trace as teltrace
+from ...telemetry.anomaly import StragglerBoard
+from ...telemetry.exposition import TelemetryServer
+from ...utils.logging import DMLCError, get_logger, log_info
+from ...utils.metrics import metrics
+from ...utils.parameter import get_env
+
+__all__ = ["ReplicaRegistry", "ReplicaAgent", "fleet_rpc"]
+
+logger = get_logger()
+
+#: replica report keys copied verbatim from a heartbeat into the record
+_REPORT_KEYS = ("health", "queue_fraction", "queue_depth", "inflight",
+                "p99_ms", "qps", "step", "params_version", "slo_breaches",
+                "reload_error")
+
+
+def fleet_rpc(addr: Tuple[str, int], obj: dict,
+              timeout: float = 30.0) -> dict:
+    """One JSON-line request/response round trip to the replica registry
+    (the dispatcher_rpc idiom: trace ids ride as optional JSON keys)."""
+    tid, sid = teltrace.wire_ids()
+    if tid and "trace_id" not in obj:
+        obj = {**obj, "trace_id": tid, "parent_span": sid}
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.settimeout(timeout)
+        send_json(s, obj)
+        reply = recv_json(s.makefile("r"))
+    if reply is None:
+        raise DMLCError(f"registry {addr} closed without replying "
+                        f"to {obj.get('cmd')!r}")
+    if "error" in reply:
+        raise DMLCError(f"registry: {reply['error']}")
+    return reply
+
+
+class ReplicaRegistry:
+    """TCP control-plane server for the serving fleet.
+
+    >>> reg = ReplicaRegistry(); reg.start()
+    >>> # replicas: ReplicaAgent(server, reg.address).start()
+    >>> # router:   ServingRouter(registry=reg.address)
+    >>> reg.stop()
+
+    ``heartbeat_timeout_s`` (default ``DMLC_ROUTER_HEARTBEAT_TIMEOUT``,
+    5 s) declares a silent replica dead; the router drops it from the
+    candidate set on its next registry sync.  ``telemetry_port`` mounts
+    a :class:`TelemetryServer` with the fleet console (``/fleet``) and
+    the rollout ledger (``/rollouts``) — the router usually fronts
+    these instead, proxying over RPC.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 telemetry_port: Optional[int] = None):
+        if heartbeat_timeout_s is None:
+            heartbeat_timeout_s = get_env("DMLC_ROUTER_HEARTBEAT_TIMEOUT",
+                                          5.0)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.liveness = LivenessBoard(self.heartbeat_timeout_s)
+        self.straggler_board = StragglerBoard()
+        self._lock = threading.Lock()
+        #: jobid → replica record (address + latest heartbeat report)
+        self._replicas: Dict[str, Dict[str, Any]] = {}
+        #: model_id → {"ckpt_dir", "step"} — the stable pointer the
+        #: rollout machinery moves on promote
+        self._models: Dict[str, Dict[str, Any]] = {}
+        #: jobid → queued directives, drained into heartbeat replies
+        self._directives: Dict[str, List[dict]] = {}
+        self._last_beat: Dict[str, float] = {}
+        self._stop_ev = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._m_replicas = metrics.gauge("fleet.registry.replicas")
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.host, self.port = self._srv.getsockname()[:2]
+        from .rollout import RolloutManager
+        self.rollouts = RolloutManager(self)
+        self.telemetry: Optional[TelemetryServer] = None
+        if telemetry_port is not None:
+            self.telemetry = TelemetryServer(
+                port=int(telemetry_port),
+                fleet_fn=self.fleet_snapshot,
+                rollouts_fn=self.rollouts.snapshot)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ReplicaRegistry":
+        for target, name in ((self._accept_loop, "fleet-registry-accept"),
+                             (self._sweep_loop, "fleet-registry-sweep")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.rollouts.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
+        # incident bundles dumped in this process carry the rollout
+        # ledger — a bad-canary postmortem reads transitions directly
+        flight_mod.register_contributor("rollout_ledger",
+                                        self.rollouts.snapshot)
+        log_info("serving fleet registry on %s:%d (heartbeat timeout "
+                 "%.1fs)", self.host, self.port, self.heartbeat_timeout_s)
+        return self
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        flight_mod.unregister_contributor("rollout_ledger")
+        self.rollouts.stop()
+        if self.telemetry is not None:
+            self.telemetry.stop()
+        # shutdown() before close(): close() alone does not wake a thread
+        # blocked inside accept() (see PredictionServer.stop)
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- introspection ---------------------------------------------------
+    def replica_records(self, model_id: Optional[str] = None
+                        ) -> Dict[str, Dict[str, Any]]:
+        """jobid → record copy (with ``alive``/``straggler`` flags) —
+        the rollout manager's and ``list_replicas``'s shared view."""
+        try:
+            suspects = set(self.straggler_board.suspects())
+        except Exception:   # <3 replicas / no pushes yet — board is moot
+            suspects = set()
+        dead = self.liveness.dead_members()
+        with self._lock:
+            out = {}
+            for jobid, rec in self._replicas.items():
+                if model_id is not None and rec.get("model_id") != model_id:
+                    continue
+                out[jobid] = {**rec, "alive": jobid not in dead,
+                              "straggler": jobid in suspects}
+            return out
+
+    def models_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            by_model: Dict[str, List[str]] = {}
+            for jobid, rec in self._replicas.items():
+                by_model.setdefault(str(rec.get("model_id")), []) \
+                    .append(jobid)
+            return {m: {**ptr, "replicas": sorted(by_model.get(m, []))}
+                    for m, ptr in self._models.items()} | {
+                m: {"ckpt_dir": None, "step": None, "replicas": sorted(js)}
+                for m, js in by_model.items() if m not in self._models}
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """The ``/fleet`` body: per-replica health / load / heartbeat age
+        / straggler flags plus the multi-model map."""
+        now = time.monotonic()
+        records = self.replica_records()
+        with self._lock:
+            beats = dict(self._last_beat)
+        replicas = {}
+        for jobid, rec in records.items():
+            beat = beats.get(jobid)
+            replicas[jobid] = {
+                "addr": f"{rec.get('host')}:{rec.get('port')}",
+                "model_id": rec.get("model_id"),
+                "health": rec.get("health", "?"),
+                "alive": rec.get("alive", True),
+                "straggler": rec.get("straggler", False),
+                "heartbeat_age_s": (round(now - beat, 3)
+                                    if beat is not None else None),
+                "queue_fraction": rec.get("queue_fraction", 0.0),
+                "inflight": rec.get("inflight", 0),
+                "qps": rec.get("qps", 0.0),
+                "p99_ms": rec.get("p99_ms"),
+                "step": rec.get("step"),
+            }
+        return {"schema": "dmlc.serving.fleet/1", "ts": time.time(),
+                "heartbeat_timeout_s": self.heartbeat_timeout_s,
+                "replicas": replicas, "models": self.models_snapshot()}
+
+    # -- rollout plumbing ------------------------------------------------
+    def push_directive(self, jobid: str, directive: dict) -> None:
+        """Queue a directive for a replica's next heartbeat reply."""
+        with self._lock:
+            self._directives.setdefault(jobid, []).append(directive)
+
+    def stable_pointer(self, model_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._models.get(model_id) or {})
+
+    def set_stable_pointer(self, model_id: str, ckpt_dir: Optional[str],
+                           step: Optional[int]) -> None:
+        with self._lock:
+            self._models[model_id] = {"ckpt_dir": ckpt_dir, "step": step}
+
+    # -- liveness --------------------------------------------------------
+    def _beat(self, jobid: str) -> None:
+        self.liveness.beat(jobid)
+        with self._lock:
+            self._last_beat[jobid] = time.monotonic()
+
+    def _sweep_loop(self) -> None:
+        interval = max(0.05, self.heartbeat_timeout_s / 4.0)
+        while not self._stop_ev.wait(interval):
+            for jobid, silence in self.liveness.sweep():
+                metrics.counter("fleet.registry.dead_replicas").add(1)
+                logger.warning("fleet registry: replica %r silent for "
+                               "%.1fs — declaring dead", jobid, silence)
+
+    # -- request handling ------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop_ev.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             name="fleet-registry-rpc",
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(30.0)
+            msg = recv_json(conn.makefile("r"))
+            if msg is None:
+                return
+            ctx = teltrace.from_wire(msg.get("trace_id"),
+                                     msg.get("parent_span"))
+            if ctx is not None:
+                with teltrace.activate(ctx), \
+                        teltrace.span("serving.fleet.rpc",
+                                      cmd=msg.get("cmd")):
+                    reply = self._dispatch(msg)
+            else:
+                reply = self._dispatch(msg)
+            send_json(conn, reply)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            logger.warning("fleet registry connection error: %s", e)
+            try:
+                send_json(conn, {"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, msg: dict) -> dict:
+        cmd = msg.get("cmd")
+        if cmd == "register_replica":
+            return self._cmd_register(msg)
+        if cmd == "deregister_replica":
+            return self._cmd_deregister(msg)
+        if cmd == "heartbeat":
+            return self._cmd_heartbeat(msg)
+        if cmd == "list_replicas":
+            model = msg.get("model_id")
+            recs = self.replica_records(model)
+            return {"replicas": [
+                {"jobid": j, "host": r.get("host"), "port": r.get("port"),
+                 "health_port": r.get("health_port"),
+                 "model_id": r.get("model_id"),
+                 "health": r.get("health", "ok"),
+                 "queue_fraction": r.get("queue_fraction", 0.0),
+                 "inflight": r.get("inflight", 0),
+                 "alive": r.get("alive", True),
+                 "straggler": r.get("straggler", False),
+                 "step": r.get("step")}
+                for j, r in sorted(recs.items())]}
+        if cmd == "set_model":
+            self.set_stable_pointer(str(msg["model_id"]),
+                                    msg.get("ckpt_dir"), msg.get("step"))
+            return {"ok": True}
+        if cmd == "models":
+            return {"models": self.models_snapshot()}
+        if cmd == "fleet":
+            return self.fleet_snapshot()
+        if cmd == "stage_rollout":
+            return self.rollouts.stage(
+                str(msg["model_id"]), str(msg["ckpt_dir"]),
+                step=msg.get("step"), fraction=msg.get("fraction"),
+                bake_s=msg.get("bake_s"))
+        if cmd == "rollouts":
+            return self.rollouts.snapshot()
+        return {"error": f"unknown cmd {cmd!r}"}
+
+    def _register(self, msg: dict) -> None:
+        jobid = str(msg["jobid"])
+        rec = {"host": str(msg["host"]), "port": int(msg["port"]),
+               "health_port": msg.get("health_port"),
+               "model_id": str(msg.get("model_id") or "default")}
+        with self._lock:
+            self._replicas.setdefault(jobid, {}).update(rec)
+            self._m_replicas.set(len(self._replicas))
+        self._beat(jobid)
+
+    def _cmd_register(self, msg: dict) -> dict:
+        self._register(msg)
+        log_info("fleet registry: replica %r registered at %s:%s "
+                 "(model=%s)", msg["jobid"], msg["host"], msg["port"],
+                 msg.get("model_id") or "default")
+        return {"ok": True}
+
+    def _cmd_deregister(self, msg: dict) -> dict:
+        jobid = str(msg["jobid"])
+        with self._lock:
+            self._replicas.pop(jobid, None)
+            self._directives.pop(jobid, None)
+            self._last_beat.pop(jobid, None)
+            self._m_replicas.set(len(self._replicas))
+        self.liveness.forget(jobid)
+        self.rollouts.on_replica_gone(jobid)
+        return {"ok": True}
+
+    def _cmd_heartbeat(self, msg: dict) -> dict:
+        jobid = str(msg["jobid"])
+        with self._lock:
+            known = jobid in self._replicas
+        if not known and "host" in msg and "port" in msg:
+            # auto-registration: the first beat after a registry restart
+            # (or a replica that skipped explicit registration) carries
+            # its address — a heartbeat IS a registration
+            self._register(msg)
+            log_info("fleet registry: replica %r auto-registered via "
+                     "heartbeat", jobid)
+        self._beat(jobid)
+        report = {k: msg[k] for k in _REPORT_KEYS if k in msg}
+        with self._lock:
+            if jobid in self._replicas:
+                self._replicas[jobid].update(report)
+            directives = self._directives.pop(jobid, [])
+        state = msg.get("state")
+        if isinstance(state, dict):
+            # metric push riding the heartbeat: feeds cross-replica
+            # straggler detection, same as the data-service fleet
+            self.straggler_board.update(jobid, state)
+        for ack in msg.get("applied") or []:
+            self.rollouts.on_ack(jobid, ack)
+        return {"ok": True, "directives": directives}
+
+
+class ReplicaAgent:
+    """The replica-side half of the control plane, run inside a
+    :class:`~dmlc_core_tpu.serving.server.PredictionServer` process.
+
+    Registers the replica, then heartbeats at ``DMLC_ROUTER_HEARTBEAT``
+    cadence carrying the live ``/healthz`` body (health word,
+    queue-depth fraction, inflight), serving p99, checkpoint step and a
+    full metrics-state push; applies hot-reload directives carried in
+    heartbeat replies and acks them on the next beat.  A dead registry
+    never takes the replica down: failed beats log at most once per
+    outage and the loop keeps probing (the next successful beat
+    re-registers via the heartbeat auto-registration path).
+
+    ``report_overrides`` lets tests and operators force report fields
+    (e.g. ``{"slo_breaches": 1}`` to drill the canary auto-rollback).
+    """
+
+    def __init__(self, server: Any, registry_addr: Tuple[str, int], *,
+                 jobid: Optional[str] = None,
+                 model_id: Optional[str] = None,
+                 interval_s: Optional[float] = None):
+        self.server = server
+        self.registry_addr = (str(registry_addr[0]), int(registry_addr[1]))
+        self.jobid = jobid or f"replica-{server.host}:{server.port}"
+        self.model_id = (model_id or getattr(server, "model_id", None)
+                         or "default")
+        if interval_s is None:
+            interval_s = get_env("DMLC_ROUTER_HEARTBEAT", 1.0)
+        self.interval_s = max(0.05, float(interval_s))
+        self.report_overrides: Dict[str, Any] = {}
+        self._acks: List[dict] = []
+        self._lock = threading.Lock()
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._registry_down = False
+
+    # -- report assembly -------------------------------------------------
+    def _report(self) -> Dict[str, Any]:
+        doc = self.server.health_doc() if hasattr(self.server,
+                                                  "health_doc") else {}
+        snap = metrics.snapshot()
+        lat = snap.get("serving.latency_s") or {}
+        reqs = snap.get("serving.batcher.requests") or {}
+        engine = getattr(self.server, "engine", None)
+        report: Dict[str, Any] = {
+            "jobid": self.jobid, "host": self.server.host,
+            "port": self.server.port, "model_id": self.model_id,
+            "health": doc.get("status", "ok"),
+            "queue_fraction": doc.get("queue_fraction", 0.0),
+            "queue_depth": doc.get("queue_depth", 0),
+            "inflight": doc.get("inflight", 0),
+            "p99_ms": float(lat.get("p99", 0.0) or 0.0) * 1e3,
+            "qps": float(reqs.get("windowed_rate",
+                                  reqs.get("rate", 0.0)) or 0.0),
+            "step": getattr(self, "_step", None),
+            "params_version": getattr(engine, "params_version", None),
+            "slo_breaches": int(
+                metrics.gauge("slo.active_breaches").value),
+            "state": snap,
+        }
+        telemetry = getattr(self.server, "telemetry", None)
+        if telemetry is not None:
+            report["health_port"] = telemetry.port
+        report.update(self.report_overrides)
+        return report
+
+    def _apply(self, directive: dict) -> None:
+        kind = directive.get("kind")
+        ack = {"rollout_id": directive.get("rollout_id"), "kind": kind}
+        if kind == "reload":
+            try:
+                step = self.server.reload_from_checkpoint(
+                    str(directive["ckpt_dir"]), directive.get("step"))
+                self._step = step
+                ack.update(ok=True, step=step)
+            except Exception as e:  # noqa: BLE001 — a bad checkpoint must
+                # not kill the replica; the registry learns via the ack
+                ack.update(ok=False, error=str(e))
+                logger.warning("fleet agent %s: reload directive failed: "
+                               "%s", self.jobid, e)
+        else:
+            ack.update(ok=False, error=f"unknown directive {kind!r}")
+        with self._lock:
+            self._acks.append(ack)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ReplicaAgent":
+        try:
+            fleet_rpc(self.registry_addr,
+                      {"cmd": "register_replica", **self._report()},
+                      timeout=5.0)
+        except (OSError, DMLCError) as e:
+            # heartbeat auto-registration picks this up once the
+            # registry is reachable
+            logger.warning("fleet agent %s: registration deferred (%s)",
+                           self.jobid, e)
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"fleet-agent-{self.jobid}",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        try:
+            fleet_rpc(self.registry_addr,
+                      {"cmd": "deregister_replica", "jobid": self.jobid},
+                      timeout=2.0)
+        except (OSError, DMLCError):
+            pass               # registry gone — its sweep will notice
+
+    def _run(self) -> None:
+        while not self._stop_ev.wait(self.interval_s):
+            msg = {"cmd": "heartbeat", **self._report()}
+            with self._lock:
+                if self._acks:
+                    msg["applied"], self._acks = self._acks, []
+            try:
+                reply = fleet_rpc(self.registry_addr, msg, timeout=5.0)
+            except (OSError, DMLCError) as e:
+                if not self._registry_down:
+                    self._registry_down = True
+                    logger.warning("fleet agent %s: heartbeat failed "
+                                   "(%s) — will keep probing", self.jobid, e)
+                with self._lock:
+                    # re-queue unacked directives' acks for the next beat
+                    self._acks = msg.get("applied", []) + self._acks
+                continue
+            self._registry_down = False
+            for directive in reply.get("directives") or []:
+                self._apply(directive)

@@ -1,0 +1,721 @@
+"""Pipelined TCP routing front-end for the serving replica fleet.
+
+The router speaks the existing serving wire protocol on both sides
+(`serving/server.py` frame layout, verbatim), so clients need zero
+changes: point a :class:`~dmlc_core_tpu.serving.client.PredictClient`
+at the router and every request fans out across replicas.  Per-request
+req_ids are rewritten on the backend leg (client ids are only unique
+per connection; the fleet needs them unique per replica link) and
+restored on the way back; ``trace_id``/``parent_span`` pass through
+untouched, with a ``serving.router.request`` span spliced between the
+client's and the replica's.
+
+**Replica selection** is least-loaded power-of-two-choices: sample two
+candidates, send to the one with the lower ``inflight + 8 ×
+queue_fraction`` score (router-local inflight is instant; the
+queue-depth fraction from the replica's ``/healthz`` body ages up to a
+poll interval).  The candidate set is filtered hard before sampling:
+
+* ``overloaded`` replicas and replicas whose per-replica
+  :class:`~dmlc_core_tpu.utils.retry.CircuitBreaker` is open are out;
+* ``degraded`` replicas are **drained** — eligible only when no ``ok``
+  replica remains (the `/healthz` degrade signal exists precisely so
+  the balancer backs off before the shed cliff);
+* replicas flagged by the tracker-side straggler board
+  (`telemetry/anomaly.py`, via the registry's heartbeat state pushes)
+  are evicted from rotation until the flag clears;
+* a model-tagged connection (HELLO preamble) only considers replicas
+  serving that ``model_id``.
+
+**Retry budget** is replica-aware: a shed (OVERLOADED), a draining
+replica's SHUTDOWN answer, or a lost backend connection triggers an
+immediate hedged resubmit to a *different* replica (the ``tried`` set
+grows per attempt) under the ``DMLC_ROUTER_RETRIES`` budget
+(:meth:`RetryPolicy.from_env`).  There is deliberately **no backoff
+sleep** on this path — the resubmit IS the backoff, because it lands
+on a replica whose queue the router already believes is shorter; a
+sleeping reader thread would head-of-line-block every other response
+on that replica link.  Non-idempotent rejects (BAD_REQUEST, TOO_LARGE,
+DEADLINE_EXCEEDED) are **never** retried — they pass through verbatim.
+
+Membership comes from either a static replica list or a
+:class:`~.registry.ReplicaRegistry` (``list_replicas`` sync at
+``DMLC_ROUTER_SYNC_INTERVAL``); replica ``/healthz`` bodies are polled
+directly at ``DMLC_ROUTER_HEALTH_INTERVAL`` for fresher load signal
+than heartbeat cadence provides.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...telemetry import trace as teltrace
+from ...telemetry.exposition import TelemetryServer
+from ...utils.logging import DMLCError, get_logger, log_info
+from ...utils.metrics import metrics
+from ...utils.parameter import get_env
+from ...utils.retry import CircuitBreaker, CircuitOpen, RetryPolicy
+from ..server import (HELLO_REQ_ID, REQ_HEADER, RSP_HEADER,
+                      STATUS_NAMES, STATUS_OK, STATUS_OVERLOADED,
+                      STATUS_SHUTDOWN, _MAX_NNZ, _MAX_ROWS,
+                      _recv_exact, pack_hello)
+from .registry import fleet_rpc
+
+__all__ = ["ServingRouter"]
+
+logger = get_logger()
+
+#: queue_fraction's weight against router-local inflight in the
+#: load score: a full replica queue counts like 8 in-flight requests
+_QUEUE_WEIGHT = 8.0
+
+STATUS_BAD_REQUEST = 5          # mirror of server.STATUS_BAD_REQUEST
+
+
+class _ClientConn:
+    """One front-side client connection: write lock + the model tag its
+    HELLO (if any) declared."""
+
+    __slots__ = ("cid", "sock", "wlock", "model_id", "alive")
+
+    def __init__(self, cid: int, sock: socket.socket):
+        self.cid = cid
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.model_id = "default"
+        self.alive = True
+
+    def respond(self, req_id: int, status: int, payload: bytes) -> None:
+        n = len(payload) // 4 if status == STATUS_OK else len(payload)
+        try:
+            with self.wlock:
+                self.sock.sendall(RSP_HEADER.pack(req_id, status, n)
+                                  + payload)
+        except OSError:
+            self.alive = False   # reader thread owns the cleanup
+
+
+class _Pending:
+    """One in-flight request: enough to forward the answer back and to
+    replay the frame tail against a different replica."""
+
+    __slots__ = ("bid", "client", "client_req_id", "trace_id",
+                 "parent_span", "rows", "nnz", "tail", "attempts",
+                 "tried", "replica_key", "span")
+
+    def __init__(self, bid: int, client: _ClientConn, client_req_id: int,
+                 trace_id: int, parent_span: int, rows: int, nnz: int,
+                 tail: bytes, span: Optional[Any]):
+        self.bid = bid
+        self.client = client
+        self.client_req_id = client_req_id
+        self.trace_id = trace_id
+        self.parent_span = parent_span
+        self.rows = rows
+        self.nnz = nnz
+        self.tail = tail
+        self.attempts = 0
+        self.tried: set = set()
+        self.replica_key: Optional[str] = None
+        self.span = span
+
+
+class _Replica:
+    """Router-side view of one backend replica: membership facts from
+    the registry/static list, load facts from ``/healthz`` polls, plus
+    the lazy backend connection and its reader."""
+
+    def __init__(self, key: str, host: str, port: int, *,
+                 health_port: Optional[int] = None,
+                 model_id: str = "default",
+                 jobid: Optional[str] = None):
+        self.key = key
+        self.host = host
+        self.port = int(port)
+        self.health_port = health_port
+        self.model_id = model_id
+        self.jobid = jobid or key
+        self.state = "ok"            # ok | degraded | overloaded
+        self.queue_fraction = 0.0
+        self.alive = True
+        self.straggler = False
+        self.inflight = 0            # router-local, under self.lock
+        # per-replica breaker: a replica that keeps failing fast-fails
+        # locally instead of eating the whole retry budget every request
+        self.breaker = CircuitBreaker.from_env(
+            "DMLC_ROUTER", name=f"router.{key}")
+        self.lock = threading.Lock()
+        self.wlock = threading.Lock()
+        self.sock: Optional[socket.socket] = None
+        self.outstanding: set = set()   # backend ids, under self.lock
+
+    def load_score(self) -> float:
+        return self.inflight + _QUEUE_WEIGHT * self.queue_fraction
+
+
+class ServingRouter:
+    """Serving-protocol front-end over N replicas.
+
+    >>> router = ServingRouter(registry=reg.address).start()
+    >>> client = PredictClient(router.host, router.port)
+
+    ``registry`` (a ``(host, port)`` tuple) enables dynamic membership,
+    straggler flags and the ``/rollouts`` proxy; ``replicas`` pins a
+    static fleet (items ``(host, port)`` or ``(host, port,
+    health_port)``) for registry-less deployments — both may be given,
+    the registry view then overlays the static seed.
+    """
+
+    def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[Tuple[str, int]] = None,
+                 replicas: Optional[List[tuple]] = None,
+                 telemetry_port: Optional[int] = None,
+                 health_poll_s: Optional[float] = None,
+                 sync_s: Optional[float] = None,
+                 backlog: int = 64):
+        if registry is None and not replicas:
+            raise DMLCError("ServingRouter needs a registry address or "
+                            "a static replica list")
+        self.registry_addr = (None if registry is None
+                              else (str(registry[0]), int(registry[1])))
+        if health_poll_s is None:
+            health_poll_s = get_env("DMLC_ROUTER_HEALTH_INTERVAL", 0.5)
+        if sync_s is None:
+            sync_s = get_env("DMLC_ROUTER_SYNC_INTERVAL", 1.0)
+        self.health_poll_s = max(0.05, float(health_poll_s))
+        self.sync_s = max(0.05, float(sync_s))
+        self._retry = RetryPolicy.from_env("DMLC_ROUTER",
+                                           name="serving.router")
+        self._rlock = threading.Lock()      # guards _replicas map shape
+        self._replicas: Dict[str, _Replica] = {}
+        for item in replicas or []:
+            h, p = item[0], int(item[1])
+            hp = int(item[2]) if len(item) > 2 and item[2] is not None \
+                else None
+            key = f"{h}:{p}"
+            self._replicas[key] = _Replica(key, h, p, health_port=hp)
+        self._plock = threading.Lock()      # guards _pending + _next_bid
+        self._pending: Dict[int, _Pending] = {}
+        self._next_bid = 0
+        self._conns: Dict[int, _ClientConn] = {}
+        self._conn_lock = threading.Lock()
+        self._next_conn = 0
+        self._stopping = False
+        self._stop_ev = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._m_requests = metrics.counter("serving.router.requests")
+        self._m_retries = metrics.counter("serving.router.retries")
+        self._m_sheds = metrics.counter("serving.router.sheds")
+        self._m_inflight = metrics.gauge("serving.router.inflight")
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(backlog)
+        self.host, self.port = self._srv.getsockname()[:2]
+        if telemetry_port is None:
+            p = get_env("DMLC_ROUTER_METRICS_PORT", -1)
+            telemetry_port = p if p >= 0 else None
+        self.telemetry: Optional[TelemetryServer] = None
+        if telemetry_port is not None:
+            self.telemetry = TelemetryServer(
+                port=int(telemetry_port),
+                health_fn=self.health_doc,
+                fleet_fn=self.fleet_snapshot,
+                rollouts_fn=(self._rollouts_proxy
+                             if self.registry_addr else None))
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ServingRouter":
+        loops = [(self._accept_loop, "router-accept"),
+                 (self._health_loop, "router-health")]
+        if self.registry_addr is not None:
+            self.sync_replicas()           # first sync before serving
+            loops.append((self._sync_loop, "router-sync"))
+        for target, name in loops:
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.telemetry is not None:
+            self.telemetry.start()
+        log_info("serving router on %s:%d over %d replica(s)",
+                 self.host, self.port, len(self._replicas))
+        return self
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._stop_ev.set()
+        if self.telemetry is not None:
+            self.telemetry.stop()
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            for closer in (lambda: c.sock.shutdown(socket.SHUT_RDWR),
+                           c.sock.close):
+                try:
+                    closer()
+                except OSError:
+                    pass
+        with self._rlock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            self._kill_backend(rep)
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- membership ------------------------------------------------------
+    def sync_replicas(self) -> None:
+        """One registry round trip: overlay membership, health,
+        straggler and liveness flags onto the local replica map."""
+        listing = fleet_rpc(self.registry_addr, {"cmd": "list_replicas"},
+                            timeout=5.0)["replicas"]
+        seen = set()
+        with self._rlock:
+            for r in listing:
+                key = f"{r['host']}:{r['port']}"
+                seen.add(key)
+                rep = self._replicas.get(key)
+                if rep is None:
+                    rep = _Replica(key, r["host"], int(r["port"]),
+                                   health_port=r.get("health_port"),
+                                   model_id=r.get("model_id") or "default",
+                                   jobid=r.get("jobid"))
+                    self._replicas[key] = rep
+                    log_info("router: replica %s joined (model=%s)",
+                             key, rep.model_id)
+                rep.health_port = r.get("health_port", rep.health_port)
+                rep.model_id = r.get("model_id") or rep.model_id
+                rep.alive = bool(r.get("alive", True))
+                rep.straggler = bool(r.get("straggler", False))
+                # heartbeat-fed load facts; the direct /healthz poll
+                # overwrites these with fresher numbers when it can
+                rep.state = r.get("health", rep.state)
+                rep.queue_fraction = float(r.get("queue_fraction", 0.0))
+            gone = [k for k in self._replicas if k not in seen]
+            dropped = [self._replicas.pop(k) for k in gone]
+        for rep in dropped:
+            log_info("router: replica %s left the registry", rep.key)
+            self._kill_backend(rep)
+        metrics.gauge("serving.router.replicas").set(len(listing))
+
+    def _sync_loop(self) -> None:
+        down = False
+        while not self._stop_ev.wait(self.sync_s):
+            try:
+                self.sync_replicas()
+                down = False
+            except (OSError, DMLCError) as e:
+                if not down:    # one line per registry outage, not per tick
+                    down = True
+                    logger.warning("router: registry sync failed (%s) — "
+                                   "serving last-known fleet", e)
+
+    def _health_loop(self) -> None:
+        while not self._stop_ev.wait(self.health_poll_s):
+            with self._rlock:
+                reps = list(self._replicas.values())
+            for rep in reps:
+                if rep.health_port is None:
+                    continue
+                try:
+                    conn = http.client.HTTPConnection(
+                        rep.host, int(rep.health_port), timeout=2.0)
+                    try:
+                        conn.request("GET", "/healthz")
+                        doc = json.loads(conn.getresponse().read())
+                    finally:
+                        conn.close()
+                except (OSError, ValueError):
+                    continue    # liveness is the registry's call, not ours
+                if isinstance(doc, dict):
+                    rep.state = str(doc.get("status", rep.state))
+                    rep.queue_fraction = float(
+                        doc.get("queue_fraction", rep.queue_fraction))
+
+    # -- replica selection -----------------------------------------------
+    def _pick(self, model_id: str, tried: set) -> Optional[_Replica]:
+        """Least-loaded pick-2 over the filtered candidate set; degraded
+        replicas drain (chosen only when nothing is ``ok``)."""
+        with self._rlock:
+            reps = list(self._replicas.values())
+        ok: List[_Replica] = []
+        degraded: List[_Replica] = []
+        for rep in reps:
+            if (rep.key in tried or not rep.alive or rep.straggler
+                    or rep.model_id != model_id
+                    or rep.state == "overloaded"
+                    or rep.breaker.state == "open"):
+                continue
+            (ok if rep.state == "ok" else degraded).append(rep)
+        pool = ok or degraded
+        if not pool:
+            return None
+        if len(pool) == 1:
+            return pool[0]
+        a, b = random.sample(pool, 2)
+        return a if a.load_score() <= b.load_score() else b
+
+    # -- backend link ----------------------------------------------------
+    def _ensure_backend(self, rep: _Replica) -> socket.socket:
+        with rep.lock:
+            if rep.sock is not None:
+                return rep.sock
+            sock = socket.create_connection((rep.host, rep.port),
+                                            timeout=5.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            rep.sock = sock
+        # declare our model expectation; a mismatched replica answers
+        # BAD_REQUEST and drops the link, which surfaces as a failover
+        with rep.wlock:
+            sock.sendall(pack_hello(rep.model_id))
+        threading.Thread(target=self._backend_read_loop,
+                         args=(rep, sock),
+                         name=f"router-backend-{rep.key}",
+                         daemon=True).start()
+        return sock
+
+    def _kill_backend(self, rep: _Replica) -> None:
+        with rep.lock:
+            sock, rep.sock = rep.sock, None
+        if sock is not None:
+            for closer in (lambda: sock.shutdown(socket.SHUT_RDWR),
+                           sock.close):
+                try:
+                    closer()
+                except OSError:
+                    pass
+
+    def _backend_read_loop(self, rep: _Replica,
+                           sock: socket.socket) -> None:
+        try:
+            while True:
+                head = _recv_exact(sock, RSP_HEADER.size)
+                if head is None:
+                    raise DMLCError("replica closed the connection")
+                bid, status, n = RSP_HEADER.unpack(head)
+                payload = _recv_exact(sock, 4 * n if status == STATUS_OK
+                                      else n)
+                if payload is None:
+                    raise DMLCError("replica died mid-response")
+                if bid == HELLO_REQ_ID:
+                    raise DMLCError(
+                        "replica refused model hello: "
+                        + payload.decode("utf-8", "replace"))
+                self._on_backend_response(rep, bid, status, payload)
+        except (OSError, DMLCError) as e:
+            self._on_backend_lost(rep, sock, e)
+
+    def _on_backend_response(self, rep: _Replica, bid: int, status: int,
+                             payload: bytes) -> None:
+        with self._plock:
+            pend = self._pending.get(bid)
+        if pend is None:
+            return               # answered by an earlier failover path
+        # OVERLOADED and SHUTDOWN are idempotent rejects — the replica
+        # did no work — so a hedged resubmit to a different replica is
+        # safe; every other status is final and passes through verbatim
+        if (status in (STATUS_OVERLOADED, STATUS_SHUTDOWN)
+                and self._try_failover(pend, rep,
+                                       reason=STATUS_NAMES.get(status))):
+            return
+        with self._plock:
+            self._pending.pop(bid, None)
+        self._release(rep, bid)
+        if status == STATUS_OK:
+            rep.breaker.record_success()
+        elif status == STATUS_OVERLOADED:
+            self._m_sheds.add(1)
+        if pend.span is not None:
+            pend.span.end(status=STATUS_NAMES.get(status, str(status)),
+                          attempts=pend.attempts, replica=rep.key)
+        pend.client.respond(pend.client_req_id, status, payload)
+
+    def _on_backend_lost(self, rep: _Replica, sock: socket.socket,
+                         exc: BaseException) -> None:
+        with rep.lock:
+            if rep.sock is not sock:
+                stale = True     # a newer link owns the replica now
+            else:
+                stale = False
+                rep.sock = None
+            orphans = list(rep.outstanding)
+            rep.outstanding.clear()
+            rep.inflight = 0
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if stale and not orphans:
+            return
+        if not self._stopping:
+            rep.breaker.record_failure()
+            logger.warning("router: lost replica %s (%s) — refanning %d "
+                           "in-flight request(s)", rep.key, exc,
+                           len(orphans))
+        for bid in orphans:
+            with self._plock:
+                pend = self._pending.get(bid)
+            if pend is None:
+                continue
+            metrics.counter("serving.router.failovers").add(1)
+            if not self._try_failover(pend, rep, reason="conn_lost",
+                                      already_released=True):
+                with self._plock:
+                    self._pending.pop(bid, None)
+                self._respond_shed(pend, f"replica {rep.key} lost: {exc}")
+
+    # -- dispatch / retry ------------------------------------------------
+    def _release(self, rep: _Replica, bid: int) -> None:
+        with rep.lock:
+            rep.outstanding.discard(bid)
+            rep.inflight = max(0, rep.inflight - 1)
+
+    def _respond_shed(self, pend: _Pending, msg: str) -> None:
+        self._m_sheds.add(1)
+        if pend.span is not None:
+            pend.span.end(status="OVERLOADED", attempts=pend.attempts)
+        pend.client.respond(pend.client_req_id, STATUS_OVERLOADED,
+                            msg.encode("utf-8", "replace"))
+
+    def _try_failover(self, pend: _Pending, failed: _Replica, *,
+                      reason: Optional[str],
+                      already_released: bool = False) -> bool:
+        """Resubmit ``pend`` to a different replica if the budget and
+        the candidate set allow; True when the request found a new home
+        (or was re-queued), False when the caller must answer."""
+        if not already_released:
+            self._release(failed, pend.bid)
+        if pend.attempts >= self._retry.max_attempts:
+            return False
+        target = self._pick(pend.client.model_id, pend.tried)
+        if target is None:
+            return False
+        self._m_retries.add(1)
+        if pend.span is not None:
+            pend.span.event("failover", frm=failed.key, to=target.key,
+                            reason=reason)
+        return self._dispatch(pend, target)
+
+    def _dispatch(self, pend: _Pending, rep: _Replica) -> bool:
+        """Send ``pend`` to ``rep``; on transport failure walk the
+        remaining candidates.  True iff the frame reached some replica's
+        socket (the reader owns it from there)."""
+        while True:
+            pend.attempts += 1
+            pend.tried.add(rep.key)
+            pend.replica_key = rep.key
+            try:
+                rep.breaker.allow()
+                sock = self._ensure_backend(rep)
+                with rep.lock:
+                    rep.outstanding.add(pend.bid)
+                    rep.inflight += 1
+                frame = REQ_HEADER.pack(pend.bid, pend.trace_id,
+                                        pend.parent_span, pend.rows,
+                                        pend.nnz) + pend.tail
+                with rep.wlock:
+                    sock.sendall(frame)
+                return True
+            except (OSError, CircuitOpen) as e:
+                self._release(rep, pend.bid)
+                if not isinstance(e, CircuitOpen):
+                    rep.breaker.record_failure()
+                    self._kill_backend(rep)
+                nxt = None
+                if pend.attempts < self._retry.max_attempts:
+                    nxt = self._pick(pend.client.model_id, pend.tried)
+                if nxt is None:
+                    return False
+                self._m_retries.add(1)
+                rep = nxt
+
+    # -- frontend --------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                sock, _addr = self._srv.accept()
+            except OSError:
+                return
+            if self._stopping:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                cid = self._next_conn
+                self._next_conn += 1
+                conn = _ClientConn(cid, sock)
+                self._conns[cid] = conn
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name=f"router-conn-{cid}",
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: _ClientConn) -> None:
+        sock = conn.sock
+        try:
+            while True:
+                head = _recv_exact(sock, REQ_HEADER.size)
+                if head is None:
+                    return
+                req_id, trace_id, parent_span, rows, nnz = \
+                    REQ_HEADER.unpack(head)
+                if req_id == HELLO_REQ_ID:
+                    blob = _recv_exact(sock, nnz)
+                    if blob is None:
+                        return
+                    conn.model_id = blob.decode("utf-8",
+                                                "replace") or "default"
+                    continue
+                if rows == 0 or rows > _MAX_ROWS or nnz > _MAX_NNZ:
+                    conn.respond(req_id, STATUS_BAD_REQUEST,
+                                 f"bad header rows={rows} "
+                                 f"nnz={nnz}".encode())
+                    return
+                tail = _recv_exact(sock, 4 * (rows + 1) + 8 * nnz)
+                if tail is None:
+                    return
+                self._m_requests.add(1)
+                span = None
+                if trace_id:
+                    span = teltrace.start_span(
+                        "serving.router.request",
+                        parent=teltrace.TraceContext(trace_id,
+                                                     parent_span),
+                        req_id=req_id, rows=rows, model=conn.model_id)
+                with self._plock:
+                    bid = self._next_bid
+                    self._next_bid += 1
+                pend = _Pending(bid, conn, req_id, trace_id, parent_span,
+                                rows, nnz, tail, span)
+                # the replica-side span parents on the ROUTER span, so
+                # client → router → replica → engine chain in one trace
+                if span is not None:
+                    pend.trace_id = span.context.trace_id
+                    pend.parent_span = span.context.span_id
+                with self._plock:
+                    self._pending[bid] = pend
+                    self._m_inflight.set(len(self._pending))
+                target = self._pick(conn.model_id, pend.tried)
+                if target is None or not self._dispatch(pend, target):
+                    with self._plock:
+                        self._pending.pop(bid, None)
+                    self._respond_shed(
+                        pend, f"no replica available for model "
+                              f"{conn.model_id!r}")
+        except OSError:
+            pass
+        finally:
+            conn.alive = False
+            with self._conn_lock:
+                self._conns.pop(conn.cid, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- observability ---------------------------------------------------
+    def health_doc(self) -> Dict[str, Any]:
+        """Router ``/healthz``: ok while any replica is ``ok``, degraded
+        while anything usable remains, overloaded when the fleet is
+        gone."""
+        with self._rlock:
+            reps = list(self._replicas.values())
+        usable = [r for r in reps if r.alive and not r.straggler
+                  and r.breaker.state != "open"
+                  and r.state != "overloaded"]
+        if any(r.state == "ok" for r in usable):
+            status = "ok"
+        elif usable:
+            status = "degraded"
+        else:
+            status = "overloaded"
+        with self._plock:
+            inflight = len(self._pending)
+        return {"status": status, "replicas": len(reps),
+                "usable_replicas": len(usable), "inflight": inflight}
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """Router-local ``/fleet`` body — the balancer's live view (the
+        registry serves the authoritative one)."""
+        with self._rlock:
+            reps = list(self._replicas.values())
+        replicas = {}
+        for r in reps:
+            with r.lock:
+                inflight, connected = r.inflight, r.sock is not None
+            replicas[r.jobid] = {
+                "addr": r.key, "model_id": r.model_id, "health": r.state,
+                "alive": r.alive, "straggler": r.straggler,
+                "queue_fraction": round(r.queue_fraction, 4),
+                "inflight": inflight, "connected": connected,
+                "breaker": r.breaker.state,
+            }
+        return {"schema": "dmlc.serving.fleet/1", "ts": time.time(),
+                "router": f"{self.host}:{self.port}",
+                "replicas": replicas, "models": {}}
+
+    def _rollouts_proxy(self) -> Dict[str, Any]:
+        return fleet_rpc(self.registry_addr, {"cmd": "rollouts"},
+                         timeout=5.0)
+
+
+def router_main(argv=None) -> int:
+    """CLI: ``python -m dmlc_core_tpu.serving.fleet.router
+    registry=HOST:PORT [port=N] [host=0.0.0.0]`` — run a router against
+    a replica registry until interrupted."""
+    import sys
+    args = dict(a.split("=", 1) for a in (sys.argv[1:] if argv is None
+                                          else argv))
+    if "registry" not in args and "replicas" not in args:
+        print("usage: serving.fleet.router registry=HOST:PORT [port=0] "
+              "[host=0.0.0.0] | replicas=H:P,H:P,...", file=sys.stderr)
+        return 2
+    registry = None
+    if "registry" in args:
+        h, _, p = args["registry"].rpartition(":")
+        registry = (h, int(p))
+    replicas = None
+    if "replicas" in args:
+        replicas = []
+        for ep in args["replicas"].split(","):
+            h, _, p = ep.rpartition(":")
+            replicas.append((h, int(p)))
+    router = ServingRouter(host=args.get("host", "0.0.0.0"),
+                           port=int(args.get("port", "0")),
+                           registry=registry, replicas=replicas)
+    router.start()
+    print(f"routing on {router.host}:{router.port}", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(router_main())

@@ -17,6 +17,11 @@ Wire format (all little-endian)::
                status ≠ 0:     [utf-8 message × n]
     statuses:  0 OK, 1 OVERLOADED, 2 DEADLINE_EXCEEDED, 3 TOO_LARGE,
                4 SHUTDOWN, 5 BAD_REQUEST
+    hello:     a request frame with req_id == (1<<64)-1 is a model
+               declaration, not a request: rows == 0 and the payload is
+               nnz utf-8 bytes naming the model_id (see pack_hello) —
+               a replica serving a different model answers BAD_REQUEST
+               and drops the connection
 
 ``trace_id``/``parent_span`` carry the client's ``telemetry.trace``
 context (0 = untraced): a traced request grows a server-side span that
@@ -55,11 +60,28 @@ from .engine import InferenceEngine, RequestTooLarge
 
 __all__ = ["PredictionServer", "REQ_HEADER", "RSP_HEADER", "STATUS_OK",
            "STATUS_OVERLOADED", "STATUS_DEADLINE", "STATUS_TOO_LARGE",
-           "STATUS_SHUTDOWN", "STATUS_BAD_REQUEST", "STATUS_NAMES"]
+           "STATUS_SHUTDOWN", "STATUS_BAD_REQUEST", "STATUS_NAMES",
+           "HELLO_REQ_ID", "pack_hello"]
 
 REQ_HEADER = struct.Struct("<QQQII")    # req_id, trace_id, parent_span,
                                         # rows, nnz (trace ids 0 = untraced)
 RSP_HEADER = struct.Struct("<QBI")      # req_id, status, n
+
+#: reserved req_id announcing a HELLO preamble instead of a request: the
+#: header's ``nnz`` field counts the utf-8 model_id payload that follows
+#: (rows/trace fields are 0).  A server bound to a different model answers
+#: BAD_REQUEST and drops the connection, so a misrouted client fails on
+#: connect instead of scoring against the wrong checkpoint.  Real req_ids
+#: are small counters; (1<<64)-1 can never collide.
+HELLO_REQ_ID = (1 << 64) - 1
+_MAX_MODEL_ID = 4096
+
+
+def pack_hello(model_id: str) -> bytes:
+    """The model-declaration preamble frame (sent once per connection,
+    before the first request)."""
+    blob = model_id.encode("utf-8")[:_MAX_MODEL_ID]
+    return REQ_HEADER.pack(HELLO_REQ_ID, 0, 0, 0, len(blob)) + blob
 
 STATUS_OK = 0
 STATUS_OVERLOADED = 1
@@ -109,8 +131,12 @@ class PredictionServer:
                  max_delay_s: float = 0.002, max_queue: int = 256,
                  default_deadline_s: float = 1.0,
                  warmup: bool = True, backlog: int = 64,
-                 metrics_port: Optional[int] = None) -> None:
+                 metrics_port: Optional[int] = None,
+                 model_id: Optional[str] = None) -> None:
         self.engine = engine
+        # fleet identity: which checkpoint lineage this replica serves.
+        # "default" keeps single-replica deployments hello-free.
+        self.model_id = model_id or "default"
         if warmup:
             engine.warmup_all()
         self.batcher = MicroBatcher(
@@ -129,6 +155,9 @@ class PredictionServer:
         self._watcher: Optional[threading.Thread] = None
         self._watch_stop = threading.Event()
         self._m_conns = metrics.gauge("serving.server.connections")
+        self._inflight = 0             # submitted, not yet answered
+        self._inflight_lock = threading.Lock()
+        self._m_inflight = metrics.gauge("serving.server.inflight")
         # queue-depth fraction above which health degrades before the hard
         # admission limit kicks in — load balancers drain "degraded"
         # replicas early instead of discovering "overloaded" via sheds
@@ -142,8 +171,22 @@ class PredictionServer:
             metrics_port = p if p >= 0 else None
         self.telemetry: Optional[TelemetryServer] = None
         if metrics_port is not None:
+            # the full health DOC (status + queue fraction + inflight),
+            # not just the status word — the router weights replicas off
+            # this body without needing a second endpoint
             self.telemetry = TelemetryServer(
-                port=int(metrics_port), health_fn=lambda: self.health)
+                port=int(metrics_port), health_fn=self.health_doc)
+        # fleet membership: DMLC_ROUTER_REGISTRY=host:port opts this
+        # replica into a ReplicaRegistry (registration + heartbeats via
+        # an in-process ReplicaAgent; lazily imported — single-replica
+        # deployments never load the fleet package)
+        self._agent = None
+        reg = str(get_env("DMLC_ROUTER_REGISTRY", ""))
+        if reg:
+            from .fleet.registry import ReplicaAgent
+            h, _, p = reg.rpartition(":")
+            self._agent = ReplicaAgent(self, (h, int(p)),
+                                       model_id=self.model_id)
         # observability companions (each an exact no-op when its env is
         # unset): flight recorder arms on DMLC_FLIGHT_DIR; the SLO
         # monitor compiles DMLC_SLO_SPEC and starts on server start
@@ -160,6 +203,8 @@ class PredictionServer:
             self.telemetry.start()
         if self.slo_monitor is not None:
             self.slo_monitor.start()
+        if self._agent is not None:
+            self._agent.start()
         log_info("serving: listening on %s:%d (%d buckets, queue=%d)",
                  self.host, self.port, len(self.engine.ladder),
                  self.batcher.max_queue)
@@ -170,6 +215,8 @@ class PredictionServer:
         requests get their answers), then drop connections."""
         self._stopping = True
         self._watch_stop.set()
+        if self._agent is not None:
+            self._agent.stop()     # deregister before the port vanishes
         if self.slo_monitor is not None:
             self.slo_monitor.stop()
         if self.telemetry is not None:
@@ -292,6 +339,20 @@ class PredictionServer:
         metrics.gauge("serving.server.health").set(level)
         return state
 
+    def health_doc(self) -> Dict[str, object]:
+        """The ``/healthz`` JSON body: the :attr:`health` status word
+        (bit-compatible — ``status`` keeps its exact values and HTTP
+        code mapping) plus the live load facts a balancer weights on:
+        queue-depth fraction of ``max_queue`` and the in-flight count."""
+        depth = self.batcher.queue_depth
+        cap = max(1, self.batcher.max_queue)
+        with self._inflight_lock:
+            inflight = self._inflight
+        return {"status": self.health, "model_id": self.model_id,
+                "queue_depth": depth,
+                "queue_fraction": round(depth / cap, 4),
+                "inflight": inflight}
+
     # -- hot reload ------------------------------------------------------
     def reload_from_checkpoint(self, directory: str,
                                step: Optional[int] = None) -> int:
@@ -377,6 +438,9 @@ class PredictionServer:
 
         def on_done(req_id: int, fut,
                     span: Optional[teltrace.Span]) -> None:
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._m_inflight.set(self._inflight)
             exc = fut.exception()
             if exc is None:
                 scores = np.ascontiguousarray(fut.result(),
@@ -400,6 +464,24 @@ class PredictionServer:
                     return
                 req_id, trace_id, parent_span, rows, nnz = \
                     REQ_HEADER.unpack(head)
+                if req_id == HELLO_REQ_ID:
+                    # model-declaration preamble (see pack_hello): checked
+                    # before the rows==0 guard — its header carries rows=0
+                    # and the payload is nnz raw utf-8 bytes, not CSR
+                    if nnz > _MAX_MODEL_ID:
+                        respond(req_id, STATUS_BAD_REQUEST,
+                                b"oversized hello")
+                        return
+                    blob = _recv_exact(conn, nnz)
+                    if blob is None:
+                        return
+                    wanted = blob.decode("utf-8", "replace") or "default"
+                    if wanted != self.model_id:
+                        respond(req_id, STATUS_BAD_REQUEST,
+                                f"model {wanted!r} not served here "
+                                f"(this is {self.model_id!r})".encode())
+                        return         # wrong fleet — drop the conn
+                    continue
                 # traced requests (non-zero trace_id in the header) get a
                 # server span parented on the client's wire context; the
                 # span object travels with the request and is ended from
@@ -437,10 +519,19 @@ class PredictionServer:
                         span.end(status="OVERLOADED", injected=True)
                     respond(req_id, STATUS_OVERLOADED, str(e).encode())
                     continue
-                fut = self.batcher.submit(ids, vals,
-                                          row_ptr.astype(np.int64),
-                                          trace_ctx=(span.context
-                                                     if span else None))
+                with self._inflight_lock:
+                    self._inflight += 1
+                    self._m_inflight.set(self._inflight)
+                try:
+                    fut = self.batcher.submit(ids, vals,
+                                              row_ptr.astype(np.int64),
+                                              trace_ctx=(span.context
+                                                         if span else None))
+                except BaseException:
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                        self._m_inflight.set(self._inflight)
+                    raise
                 fut.add_done_callback(
                     lambda f, rid=req_id, sp=span: on_done(rid, f, sp))
         except OSError as e:
@@ -460,8 +551,9 @@ def serve_main(argv=None) -> int:
         print("usage: serving.server ckpt_dir=DIR features=N [model=fm] "
               "[dim=16] [task=binary] [port=0] [host=0.0.0.0] "
               "[watch_s=10] [max_delay_ms=2] [max_queue=256] "
-              "[ragged=0|1]   (env DMLC_SERVE_RAGGED=1 is the default "
-              "for ragged=)",
+              "[model_id=default] [ragged=0|1]   (env "
+              "DMLC_SERVE_RAGGED=1 is the default for ragged=; env "
+              "DMLC_ROUTER_REGISTRY=H:P joins a replica fleet)",
               file=sys.stderr)
         return 2
     import os
@@ -487,7 +579,8 @@ def serve_main(argv=None) -> int:
         engine, host=args.get("host", "0.0.0.0"),
         port=int(args.get("port", "0")),
         max_delay_s=float(args.get("max_delay_ms", "2")) / 1e3,
-        max_queue=int(args.get("max_queue", "256")))
+        max_queue=int(args.get("max_queue", "256")),
+        model_id=args.get("model_id"))
     srv.watch_checkpoints(args["ckpt_dir"],
                           interval_s=float(args.get("watch_s", "10")))
     srv.start()

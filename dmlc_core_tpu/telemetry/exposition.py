@@ -177,25 +177,59 @@ def render_fleet_board(doc: Dict[str, Any], html: bool = False) -> str:
     ``html=True`` the same text is wrapped in a minimal self-refreshing
     page — no JS, no CSS framework, nothing to vendor.
     """
-    lines: List[str] = ["data-service fleet"]
+    replicas = doc.get("replicas", {}) or {}
+    lines: List[str] = ["serving fleet" if replicas
+                        else "data-service fleet"]
     workers = doc.get("workers", {}) or {}
-    rows = []
-    for jobid in sorted(workers):
-        w = workers[jobid]
-        rows.append([
-            jobid,
-            str(w.get("addr", "?")),
-            "DEAD" if not w.get("alive", True) else
-            ("straggler" if w.get("straggler") else "up"),
-            f"{w.get('heartbeat_age_s', 0.0):.1f}s",
-            f"{w.get('mb_s', 0.0):.1f}",
-            str(w.get("live_leases", 0)),
-            str(w.get("shards", 0)),
-        ])
-    lines.append("")
-    lines.extend(_text_table(
-        ["worker", "addr", "state", "hb_age", "MB/s", "leases", "shards"],
-        rows))
+    if workers or not replicas:
+        rows = []
+        for jobid in sorted(workers):
+            w = workers[jobid]
+            rows.append([
+                jobid,
+                str(w.get("addr", "?")),
+                "DEAD" if not w.get("alive", True) else
+                ("straggler" if w.get("straggler") else "up"),
+                f"{w.get('heartbeat_age_s', 0.0):.1f}s",
+                f"{w.get('mb_s', 0.0):.1f}",
+                str(w.get("live_leases", 0)),
+                str(w.get("shards", 0)),
+            ])
+        lines.append("")
+        lines.extend(_text_table(
+            ["worker", "addr", "state", "hb_age", "MB/s", "leases",
+             "shards"], rows))
+    if replicas:
+        # serving-fleet console (registry or router /fleet docs): one
+        # row per replica, health word + the balancer's load facts
+        rows = []
+        for jobid in sorted(replicas):
+            r = replicas[jobid]
+            hb = r.get("heartbeat_age_s")
+            rows.append([
+                jobid,
+                str(r.get("model_id", "?")),
+                str(r.get("addr", "?")),
+                "DEAD" if not r.get("alive", True) else
+                ("straggler" if r.get("straggler")
+                 else str(r.get("health", "?"))),
+                f"{hb:.1f}s" if isinstance(hb, (int, float)) else "-",
+                f"{r.get('queue_fraction', 0.0):.2f}",
+                str(r.get("inflight", 0)),
+                str(r.get("step", "-")),
+            ])
+        lines.append("")
+        lines.extend(_text_table(
+            ["replica", "model", "addr", "state", "hb_age", "q_frac",
+             "inflight", "step"], rows))
+        models = doc.get("models", {}) or {}
+        if models:
+            lines.append("")
+            lines.extend(_text_table(
+                ["model", "stable_ckpt", "step", "replicas"],
+                [[m, str(d.get("ckpt_dir", "-")), str(d.get("step", "-")),
+                  str(len(d.get("replicas", [])))]
+                 for m, d in sorted(models.items())]))
     consumers = doc.get("consumers", {}) or {}
     if consumers:
         lines.append("")
@@ -228,9 +262,10 @@ class TelemetryServer:
     ``/stragglers`` (tracker only — cross-rank straggler board JSON),
     ``/profile?seconds=N`` (collapsed-stack sampling profile of this
     process), and — when the hosting process injects them — ``/leases``
-    (dispatcher lease-lifecycle ledger) and ``/fleet`` (dispatcher
-    worker-fleet console; ``?format=text|html`` renders the status
-    board instead of JSON).
+    (dispatcher lease-lifecycle ledger), ``/fleet`` (dispatcher worker
+    or serving replica console; ``?format=text|html`` renders the
+    status board instead of JSON) and ``/rollouts`` (serving-fleet
+    canary rollout ledger).
 
     All content callbacks are injectable so the same class serves a
     process-local registry (serving server, standalone exporter) or the
@@ -247,6 +282,7 @@ class TelemetryServer:
                  leases_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  fleet_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  profile_fn: Optional[Callable[[float], str]] = None,
+                 rollouts_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  ) -> None:
         if metrics_fn is None:
             from ..utils.metrics import metrics as _registry
@@ -267,6 +303,7 @@ class TelemetryServer:
         self._leases_fn = leases_fn
         self._fleet_fn = fleet_fn
         self._profile_fn = profile_fn
+        self._rollouts_fn = rollouts_fn
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -333,10 +370,17 @@ class TelemetryServer:
                         self._send(200, "text/plain; version=0.0.4; "
                                         "charset=utf-8", body)
                     elif path == "/healthz":
+                        # a health_fn may return the bare status word or
+                        # a full JSON doc with a "status" key (serving
+                        # replicas add queue_fraction/inflight so load
+                        # balancers weight off this one endpoint)
                         status = outer._health_fn()
-                        code = _HEALTH_HTTP.get(status, 200)
+                        doc = (status if isinstance(status, dict)
+                               else {"status": status})
+                        code = _HEALTH_HTTP.get(str(doc.get("status")),
+                                                200)
                         self._send(code, "application/json",
-                                   json.dumps({"status": status})
+                                   json.dumps(doc, default=str)
                                    .encode("utf-8"))
                     elif path == "/spans":
                         self._send(200, "application/json",
@@ -392,6 +436,18 @@ class TelemetryServer:
                                 self._send(200, "application/json",
                                            json.dumps(doc, default=str)
                                            .encode("utf-8"))
+                    elif path == "/rollouts":
+                        if outer._rollouts_fn is None:
+                            # only a replica registry (or a router
+                            # proxying one) owns a rollout ledger
+                            self._send(404, "text/plain",
+                                       b"no rollout ledger here "
+                                       b"(registry/router endpoint)\n")
+                        else:
+                            self._send(200, "application/json",
+                                       json.dumps(outer._rollouts_fn(),
+                                                  default=str)
+                                       .encode("utf-8"))
                     elif path == "/profile":
                         try:
                             seconds = float(query.get("seconds", "1"))
@@ -416,7 +472,8 @@ class TelemetryServer:
             label for label, fn in (
                 (" /stragglers", self._stragglers_fn),
                 (" /leases", self._leases_fn),
-                (" /fleet", self._fleet_fn)) if fn is not None)
+                (" /fleet", self._fleet_fn),
+                (" /rollouts", self._rollouts_fn)) if fn is not None)
         log_info("telemetry exporter listening on %s:%d "
                  "(/metrics /healthz /spans /flight /profile%s)",
                  self._requested[0], self.port, extra)

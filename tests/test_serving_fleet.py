@@ -1,0 +1,453 @@
+"""Replicated serving fleet: registry membership/liveness, router
+correctness + pick-2 semantics, router-less client failover, shed
+masking under injected admission faults, the rolling-restart chaos
+drill, and the canary rollout promote/auto-rollback loop — all on CPU
+with real TCP on loopback."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+from dmlc_core_tpu.models import SparseLogReg  # noqa: E402
+from dmlc_core_tpu.serving import (  # noqa: E402
+    BucketLadder, InferenceEngine, PredictClient, PredictionServer,
+    ReplicaAgent, ReplicaRegistry, ServingRouter, fleet_rpc, run_load)
+from dmlc_core_tpu.telemetry import flight as telflight  # noqa: E402
+from dmlc_core_tpu.utils import (CheckpointManager, clear_faults,  # noqa: E402
+                                 inject_faults)
+from dmlc_core_tpu.utils.metrics import metrics  # noqa: E402
+
+F = 5000
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _counter(name):
+    return metrics.counter(name).value
+
+
+def _wait_for(cond, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _engine(w_scale=1.0):
+    model = SparseLogReg(num_features=F)
+    params = {"w": jnp.full((F,), w_scale, jnp.float32),
+              "b": jnp.float32(0.0)}
+    return InferenceEngine(model, params,
+                           buckets=BucketLadder([(16, 512)]))
+
+
+def _req(rng, rows=4, nnz_per_row=16):
+    counts = rng.integers(1, nnz_per_row + 1, size=rows)
+    ids = rng.integers(0, F, size=int(counts.sum())).astype(np.int32)
+    vals = rng.random(len(ids), dtype=np.float32)
+    row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+    return ids, vals, row_ptr
+
+
+def _ref_scores(w_scale, ids, vals, row_ptr):
+    return np.array([w_scale * float(vals[row_ptr[r]:row_ptr[r + 1]].sum())
+                     for r in range(len(row_ptr) - 1)])
+
+
+def _save_ckpt(directory, step, scale):
+    params = {"w": jnp.full((F,), scale, jnp.float32),
+              "b": jnp.float32(0.0)}
+    CheckpointManager(str(directory)).save(
+        step, {"params": params, "opt_state": {"count": jnp.int32(0)}},
+        meta={"model": "logreg"})
+
+
+def _fleet(n, *, model_ids=None, heartbeat_s=0.1, timeout_s=2.0,
+           telemetry_port=None, server_kw=None):
+    """registry + n (server, agent) pairs, heartbeating fast."""
+    reg = ReplicaRegistry(heartbeat_timeout_s=timeout_s,
+                          telemetry_port=telemetry_port).start()
+    pairs = []
+    for i in range(n):
+        mid = (model_ids or {}).get(i, "default") \
+            if isinstance(model_ids, dict) else \
+            (model_ids[i] if model_ids else "default")
+        srv = PredictionServer(_engine(), metrics_port=0,
+                               model_id=mid,
+                               **(server_kw or {})).start()
+        ag = ReplicaAgent(srv, reg.address, model_id=mid,
+                          interval_s=heartbeat_s).start()
+        pairs.append((srv, ag))
+    return reg, pairs
+
+
+def _teardown(reg, pairs, router=None, clients=()):
+    for c in clients:
+        c.close()
+    if router is not None:
+        router.stop()
+    for srv, ag in pairs:
+        ag.stop()
+        srv.stop()
+    reg.stop()
+
+
+# ---------------------------------------------------------------------------
+# registry control plane
+# ---------------------------------------------------------------------------
+
+class _StubReplica:
+    """Just enough of a PredictionServer for agent/registry unit tests."""
+
+    def __init__(self, host="127.0.0.1", port=1, model_id="default"):
+        self.host, self.port, self.model_id = host, port, model_id
+        self.engine = type("E", (), {"params_version": 1})()
+        self.telemetry = None
+        self.reloads = []
+
+    def health_doc(self):
+        return {"status": "ok", "queue_depth": 0,
+                "queue_fraction": 0.0, "inflight": 0}
+
+    def reload_from_checkpoint(self, directory, step=None):
+        self.reloads.append((directory, step))
+        return step or 0
+
+
+def test_registry_membership_multi_model_and_liveness():
+    with ReplicaRegistry(heartbeat_timeout_s=0.4) as reg:
+        reg.start()
+        a1 = ReplicaAgent(_StubReplica(port=1001, model_id="m1"),
+                          reg.address, interval_s=0.1).start()
+        a2 = ReplicaAgent(_StubReplica(port=1002, model_id="m2"),
+                          reg.address, interval_s=0.1).start()
+        assert _wait_for(lambda: len(reg.replica_records()) == 2)
+        # multi-model map: list_replicas filters by model
+        only_m1 = fleet_rpc(reg.address, {"cmd": "list_replicas",
+                                          "model_id": "m1"})["replicas"]
+        assert [r["jobid"] for r in only_m1] == ["replica-127.0.0.1:1001"]
+        models = fleet_rpc(reg.address, {"cmd": "models"})["models"]
+        assert set(models) == {"m1", "m2"}
+        # a heartbeat from an UNKNOWN jobid carrying an address is an
+        # auto-registration (registry-restart tolerance)
+        reply = fleet_rpc(reg.address, {
+            "cmd": "heartbeat", "jobid": "ghost", "host": "127.0.0.1",
+            "port": 1003, "model_id": "m1", "health": "ok"})
+        assert reply["ok"] and "ghost" in reg.replica_records()
+        # silence → dead: the stub "ghost" never beats again
+        assert _wait_for(
+            lambda: not reg.replica_records()["ghost"]["alive"],
+            timeout=5.0)
+        # the real agents keep beating and stay alive through the sweep
+        recs = reg.replica_records()
+        assert recs["replica-127.0.0.1:1001"]["alive"]
+        # deregister removes the record entirely
+        a2.stop()
+        assert _wait_for(
+            lambda: "replica-127.0.0.1:1002" not in reg.replica_records())
+        a1.stop()
+
+
+def test_registry_queues_directives_and_collects_acks():
+    with ReplicaRegistry(heartbeat_timeout_s=2.0) as reg:
+        reg.start()
+        stub = _StubReplica(port=1005)
+        ag = ReplicaAgent(stub, reg.address, interval_s=0.05).start()
+        assert _wait_for(lambda: len(reg.replica_records()) == 1)
+        reg.push_directive(ag.jobid, {"kind": "reload", "rollout_id": "x",
+                                      "ckpt_dir": "/tmp/ck", "step": 9})
+        # directive rides a heartbeat reply; the apply lands on the stub
+        assert _wait_for(lambda: stub.reloads == [("/tmp/ck", 9)])
+        ag.stop()
+
+
+# ---------------------------------------------------------------------------
+# router: correctness and selection
+# ---------------------------------------------------------------------------
+
+def test_router_scores_match_direct_and_spread_load():
+    reg, pairs = _fleet(2)
+    router = ServingRouter(registry=reg.address, sync_s=0.1,
+                           health_poll_s=0.1).start()
+    cli = PredictClient(router.host, router.port, model_id="default")
+    try:
+        rng = np.random.default_rng(0)
+        for _ in range(30):
+            ids, vals, row_ptr = _req(rng)
+            out = cli.predict(ids, vals, row_ptr, timeout=10.0)
+            np.testing.assert_allclose(
+                out, _ref_scores(1.0, ids, vals, row_ptr), rtol=1e-5)
+        board = router.fleet_snapshot()["replicas"]
+        assert len(board) == 2
+        # pick-2 over idle equals should touch both replicas eventually
+        assert sum(1 for r in board.values() if r["connected"]) >= 1
+    finally:
+        _teardown(reg, pairs, router, [cli])
+
+
+def test_router_rejects_unknown_model_requests():
+    reg, pairs = _fleet(1)     # serves "default" only
+    router = ServingRouter(registry=reg.address, sync_s=0.1).start()
+    cli = PredictClient(router.host, router.port, model_id="nope")
+    try:
+        from dmlc_core_tpu.serving import ServerOverloaded
+        rng = np.random.default_rng(1)
+        ids, vals, row_ptr = _req(rng)
+        with pytest.raises(ServerOverloaded):
+            # no replica serves "nope": the router sheds rather than
+            # scoring against the wrong checkpoint
+            cli.predict(ids, vals, row_ptr, timeout=3.0)
+    finally:
+        _teardown(reg, pairs, router, [cli])
+
+
+def test_pick2_filters_and_drains_degraded():
+    router = ServingRouter(replicas=[("127.0.0.1", 1), ("127.0.0.1", 2),
+                                     ("127.0.0.1", 3)])
+    try:
+        reps = router._replicas
+        a, b, c = (reps[f"127.0.0.1:{i}"] for i in (1, 2, 3))
+        # all ok → pick-2 returns the less loaded of a sampled pair
+        a.inflight, b.inflight, c.inflight = 5, 0, 5
+        picks = {router._pick("default", set()).key for _ in range(40)}
+        assert "127.0.0.1:2" in picks
+        # degraded replicas drain: never chosen while an ok one exists
+        b.state = "degraded"
+        for _ in range(20):
+            assert router._pick("default", {"127.0.0.1:3"}).key == \
+                "127.0.0.1:1"
+        # ... but remain the last resort when every ok replica is gone
+        a.state = "overloaded"
+        c.straggler = True
+        assert router._pick("default", set()).key == "127.0.0.1:2"
+        # dead/straggler/overloaded/tried all filter to nothing
+        b.alive = False
+        assert router._pick("default", set()) is None
+        # model filter: nothing serves "other"
+        b.alive, b.state, a.state, c.straggler = True, "ok", "ok", False
+        assert router._pick("other", set()) is None
+    finally:
+        router.stop()
+
+
+def test_router_masks_injected_admission_sheds(monkeypatch):
+    """An OVERLOADED answer from one replica is hedge-resubmitted to
+    another inside the router — the client never sees the shed."""
+    monkeypatch.setenv("DMLC_ROUTER_RETRIES", "4")
+    reg, pairs = _fleet(2)
+    router = ServingRouter(registry=reg.address, sync_s=0.1).start()
+    cli = PredictClient(router.host, router.port, model_id="default")
+    try:
+        rng = np.random.default_rng(2)
+        retries0 = _counter("serving.router.retries")
+        with inject_faults("serving.server.admit:error=1.0:times=1"):
+            ids, vals, row_ptr = _req(rng)
+            out = cli.predict(ids, vals, row_ptr, timeout=10.0)
+        np.testing.assert_allclose(
+            out, _ref_scores(1.0, ids, vals, row_ptr), rtol=1e-5)
+        assert _counter("serving.router.retries") - retries0 >= 1
+    finally:
+        _teardown(reg, pairs, router, [cli])
+
+
+# ---------------------------------------------------------------------------
+# router-less client failover
+# ---------------------------------------------------------------------------
+
+def test_client_endpoint_list_failover():
+    srv1 = PredictionServer(_engine()).start()
+    srv2 = PredictionServer(_engine()).start()
+    cli = PredictClient(srv1.host, srv1.port,
+                        endpoints=[(srv2.host, srv2.port)],
+                        model_id="default")
+    rng = np.random.default_rng(3)
+    try:
+        ids, vals, row_ptr = _req(rng)
+        ref = _ref_scores(1.0, ids, vals, row_ptr)
+        np.testing.assert_allclose(cli.predict(ids, vals, row_ptr,
+                                               timeout=10.0), ref,
+                                   rtol=1e-5)
+        f0 = _counter("serving.client.failovers")
+        srv1.stop()            # primary gone; the sweep lands on srv2
+        np.testing.assert_allclose(cli.predict(ids, vals, row_ptr,
+                                               timeout=15.0), ref,
+                                   rtol=1e-5)
+        assert _counter("serving.client.failovers") - f0 >= 1
+    finally:
+        cli.close()
+        srv2.stop()
+
+
+def test_hello_rejects_model_mismatch():
+    srv = PredictionServer(_engine(), model_id="m1").start()
+    try:
+        from dmlc_core_tpu.utils.logging import DMLCError
+        cli = PredictClient(srv.host, srv.port, model_id="m2",
+                            reconnect=False)
+        rng = np.random.default_rng(4)
+        ids, vals, row_ptr = _req(rng)
+        with pytest.raises(DMLCError):
+            cli.predict(ids, vals, row_ptr, timeout=5.0)
+        cli.close()
+        # matching hello works
+        ok = PredictClient(srv.host, srv.port, model_id="m1")
+        out = ok.predict(ids, vals, row_ptr, timeout=10.0)
+        np.testing.assert_allclose(out, _ref_scores(1.0, ids, vals,
+                                                    row_ptr), rtol=1e-5)
+        ok.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# rolling-restart chaos drill
+# ---------------------------------------------------------------------------
+
+def test_rolling_restart_zero_failed_requests(monkeypatch):
+    """Stop replicas one at a time under live load: the router refans
+    in-flight requests, no request fails, p99 stays bounded."""
+    monkeypatch.setenv("DMLC_ROUTER_RETRIES", "6")
+    reg, pairs = _fleet(3, heartbeat_s=0.1, timeout_s=1.0)
+    router = ServingRouter(registry=reg.address, sync_s=0.1,
+                           health_poll_s=0.1).start()
+    report = {}
+
+    def load():
+        report.update(run_load(
+            router.host, router.port, requests=600, concurrency=3,
+            pipeline_depth=4, rows_per_req=4, nnz_per_row=16,
+            features=F, timeout=60.0, model_id="default"))
+
+    t = threading.Thread(target=load, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.3)                  # load established
+        for i in range(3):
+            srv, ag = pairs[i]
+            ag.stop()
+            srv.stop()                   # drain + drop connections
+            time.sleep(0.3)
+            # restart: a fresh replica on a new port joins the fleet
+            srv2 = PredictionServer(_engine(), metrics_port=0).start()
+            ag2 = ReplicaAgent(srv2, reg.address,
+                               interval_s=0.1).start()
+            pairs[i] = (srv2, ag2)
+            assert _wait_for(
+                lambda: len([r for r in reg.replica_records().values()
+                             if r["alive"]]) >= 3, timeout=5.0)
+        t.join(timeout=120.0)
+        assert not t.is_alive(), "load generator wedged"
+        assert report["rejected"] == 0, report
+        assert report["ok"] + report["overload"] == 600, report
+        assert report["overload"] == 0, report    # retries masked drains
+        assert report["latency_ms"]["p99"] < 5000.0, report
+    finally:
+        _teardown(reg, pairs, router)
+
+
+# ---------------------------------------------------------------------------
+# canary rollout
+# ---------------------------------------------------------------------------
+
+def _rollouts_http(port):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5.0)
+    try:
+        conn.request("GET", "/rollouts")
+        rsp = conn.getresponse()
+        return rsp.status, json.loads(rsp.read())
+    finally:
+        conn.close()
+
+
+def test_canary_promote_on_pass_and_rollback_on_breach(tmp_path):
+    ck_v1 = tmp_path / "v1"
+    ck_v2 = tmp_path / "v2"
+    ck_v3 = tmp_path / "v3"
+    _save_ckpt(ck_v1, 1, 1.0)
+    _save_ckpt(ck_v2, 2, 5.0)
+    _save_ckpt(ck_v3, 3, 9.0)
+    reg, pairs = _fleet(2, heartbeat_s=0.1, telemetry_port=0)
+    try:
+        fleet_rpc(reg.address, {"cmd": "set_model", "model_id": "default",
+                                "ckpt_dir": str(ck_v1), "step": 1})
+        assert _wait_for(lambda: len(reg.replica_records()) == 2)
+        rng = np.random.default_rng(5)
+        ids, vals, row_ptr = _req(rng, rows=2)
+
+        def fleet_scale():
+            return sorted(round(float(
+                srv.engine.predict(ids, vals, row_ptr)[0]
+                / _ref_scores(1.0, ids, vals, row_ptr)[0]))
+                for srv, _ in pairs)
+
+        # --- promote on pass ------------------------------------------
+        staged = fleet_rpc(reg.address, {
+            "cmd": "stage_rollout", "model_id": "default",
+            "ckpt_dir": str(ck_v2), "step": 2, "fraction": 0.5,
+            "bake_s": 0.4})
+        assert len(staged["canaries"]) == 1
+        assert _wait_for(lambda: fleet_scale() == [5, 5], timeout=15.0), \
+            fleet_scale()
+        assert reg.stable_pointer("default")["ckpt_dir"] == str(ck_v2)
+        status, doc = _rollouts_http(reg.telemetry.port)
+        assert status == 200
+        assert [e["event"] for e in doc["events"]] == ["staged",
+                                                       "promoted"]
+
+        # --- auto-rollback on injected SLO breach ---------------------
+        canary_jobid = staged["canaries"][0]
+        canary_agent = next(ag for _, ag in pairs
+                            if ag.jobid == canary_jobid)
+        canary_agent.report_overrides = {"slo_breaches": 1}
+        staged2 = fleet_rpc(reg.address, {
+            "cmd": "stage_rollout", "model_id": "default",
+            "ckpt_dir": str(ck_v3), "step": 3, "fraction": 0.5,
+            "bake_s": 5.0})
+        assert staged2["canaries"] == [canary_jobid]
+        assert _wait_for(
+            lambda: any(e["event"] == "rolled_back"
+                        for e in reg.rollouts.snapshot()["events"]),
+            timeout=15.0)
+        canary_agent.report_overrides = {}
+        # the canary reloads the STABLE pointer (v2), not v3
+        assert _wait_for(lambda: fleet_scale() == [5, 5], timeout=15.0), \
+            fleet_scale()
+        assert reg.stable_pointer("default")["ckpt_dir"] == str(ck_v2)
+        # transitions visible in the ledger AND in a flight bundle
+        _, doc = _rollouts_http(reg.telemetry.port)
+        events = [e["event"] for e in doc["events"]]
+        assert events == ["staged", "promoted", "staged", "rolled_back"]
+        bundle = telflight.flight_recorder.bundle("test")
+        ledger = bundle["rollout_ledger"]
+        assert [e["event"] for e in ledger["events"]] == events
+    finally:
+        _teardown(reg, pairs)
+
+
+def test_rollout_rejects_double_stage_and_no_replicas():
+    with ReplicaRegistry(heartbeat_timeout_s=2.0) as reg:
+        reg.start()
+        out = reg.rollouts.stage("default", "/tmp/ck")
+        assert "error" in out            # no live replicas
+        stub = _StubReplica(port=1009)
+        ag = ReplicaAgent(stub, reg.address, interval_s=0.05).start()
+        assert _wait_for(lambda: len(reg.replica_records()) == 1)
+        first = reg.rollouts.stage("default", "/tmp/ck", bake_s=30.0)
+        assert "rollout_id" in first
+        second = reg.rollouts.stage("default", "/tmp/ck2")
+        assert "error" in second         # one in flight per model
+        ag.stop()
