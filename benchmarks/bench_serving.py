@@ -25,9 +25,15 @@ single-connection round-trip pair measuring the hop cost proper (the
 replicas (``shed_pct``), and a rolling restart of all three replicas
 under load (``rolling_restart_p99_ms``, zero failed requests).
 
+``--timeline`` swaps the sweep for the sampler-overhead pair (committed
+as BENCH_timeline_r{N}.json): back-to-back identical runs with the
+time-machine sampler off vs sampling the live registry at 4 Hz —
+``timeline_sampler_qps_overhead_pct`` is the acceptance number (< 1%
+QPS; ``sampler_budget_ok`` gates it in ``check_regression.py``).
+
 Usage: python benchmarks/bench_serving.py [out.json]
                                           [--telemetry-out PREFIX]
-                                          [--router]
+                                          [--router] [--timeline]
 Env:   DMLC_SERVE_REQUESTS (default 2000), DMLC_SERVE_FEATURES (2^16),
        DMLC_SERVE_MODEL (fm), DMLC_SERVE_DIM (16),
        DMLC_TELEMETRY_OUT (same as --telemetry-out)
@@ -241,6 +247,9 @@ def main() -> int:
     router_mode = "--router" in argv
     if router_mode:
         argv.remove("--router")
+    timeline_mode = "--timeline" in argv
+    if timeline_mode:
+        argv.remove("--timeline")
     telemetry_prefix = os.environ.get("DMLC_TELEMETRY_OUT")
     if "--telemetry-out" in argv:
         i = argv.index("--telemetry-out")
@@ -259,7 +268,8 @@ def main() -> int:
     params = model.init(jax.random.PRNGKey(0))
 
     report = {
-        "bench": "router" if router_mode else "serving",
+        "bench": ("router" if router_mode
+                  else "timeline" if timeline_mode else "serving"),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "backend": jax.default_backend(), "model": model_name,
         "features": features, "dim": dim, "requests": requests,
@@ -280,11 +290,18 @@ def main() -> int:
             log(f"wrote {argv[0]}")
         return 0
 
-    def scenario(name, *, max_queue=256, arm_flight=False, engine_kw=None,
-                 **load_kw):
+    def scenario(name, *, max_queue=256, arm_flight=False,
+                 arm_timeline=False, engine_kw=None, **load_kw):
         metrics.reset()
         monitor = None
+        sampler = None
         flight_dir = None
+        if arm_timeline:
+            # time-machine sampler over the live registry at 4x the
+            # default cadence — measuring the snapshot+extract cost the
+            # sampler adds per tick, amplified to show above noise
+            from dmlc_core_tpu.telemetry.timeseries import HistoryStore
+            sampler = HistoryStore().start(interval_s=0.25)
         if arm_flight:
             # full observability layer on: armed flight recorder + an SLO
             # monitor ticking fast (rule bound high enough to never fire —
@@ -311,6 +328,8 @@ def main() -> int:
             srv.stop()
             if monitor is not None:
                 monitor.stop()
+            if sampler is not None:
+                sampler.stop()
             if arm_flight:
                 from dmlc_core_tpu.telemetry import flight as _flight
                 _flight.flight_recorder.disarm()
@@ -342,6 +361,50 @@ def main() -> int:
             f"p50={rep['latency_ms']['p50']:.2f}ms "
             f"p99={rep['latency_ms']['p99']:.2f}ms ok={rep['ok']} "
             f"shed={rep['overload']}")
+
+    if timeline_mode:
+        # sampler overhead: alternated identical runs, time machine off
+        # vs sampling at 4 Hz; the acceptance bar is < 1% on QPS.  One
+        # run's qps swings ±5% with co-tenant load — far above the 1%
+        # signal — so each arm keeps its best of 3 (max over reps bounds
+        # one-sided noise; a real sampler cost would depress every rep)
+        reps = 3
+        for r in range(reps):
+            scenario(f"sampler_off_rep{r}", concurrency=1,
+                     pipeline_depth=32)
+            scenario(f"sampler_on_rep{r}", concurrency=1,
+                     pipeline_depth=32, arm_timeline=True)
+        for arm in ("sampler_off", "sampler_on"):
+            best = max((report["scenarios"].pop(f"{arm}_rep{r}")
+                        for r in range(reps)), key=lambda s: s["qps"])
+            report["scenarios"][arm] = best
+        off = report["scenarios"]["sampler_off"]
+        on = report["scenarios"]["sampler_on"]
+        off_qps, on_qps = off["qps"], on["qps"]
+        report["timeline_sampler_qps_overhead_pct"] = (
+            (off_qps - on_qps) / off_qps * 100.0 if off_qps > 0 else 0.0)
+        off_p50 = off["latency_ms"]["p50"]
+        on_p50 = on["latency_ms"]["p50"]
+        report["timeline_sampler_p50_overhead"] = (
+            (on_p50 - off_p50) / off_p50 if off_p50 > 0 else 0.0)
+        # the gate key: 1 while the sampler stays under 1% of QPS — a
+        # later round flipping to 0 is a 100% drop on a higher-better
+        # key, which check_regression fails
+        report["sampler_budget_ok"] = (
+            1.0 if report["timeline_sampler_qps_overhead_pct"] < 1.0
+            else 0.0)
+        log(f"timeline sampler overhead: qps "
+            f"{off_qps:.0f} -> {on_qps:.0f} "
+            f"({report['timeline_sampler_qps_overhead_pct']:+.2f}%), p50 "
+            f"{off_p50:.3f} -> {on_p50:.3f}ms "
+            f"({report['timeline_sampler_p50_overhead'] * 100:+.2f}%)")
+        blob = json.dumps(report, indent=2)
+        print(blob)
+        if argv:
+            with open(argv[0], "w") as f:
+                f.write(blob + "\n")
+            log(f"wrote {argv[0]}")
+        return 0
 
     scenario("single", concurrency=1, pipeline_depth=1)
     scenario("pipelined", concurrency=1, pipeline_depth=32)

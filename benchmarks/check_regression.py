@@ -22,10 +22,17 @@ new bench is the baseline, not a regression).  Tiny absolute values are
 ignored (``--min-abs``, default 1e-9) — a 0.0001ms → 0.0002ms "100%
 regression" is measurement noise, not signal.
 
+``--emit-history`` additionally appends one JSON line per gated family
+to ``PROGRESS.jsonl`` (newest round, direction-classified headline
+metrics, pass/regressed status), so the bench trajectory is
+machine-readable — the telemetry time machine for the benches
+themselves.
+
 Usage::
 
     python benchmarks/check_regression.py [--dir REPO]
-        [--threshold 0.1] [--min-abs 1e-9] [--family serving] [-v]
+        [--threshold 0.1] [--min-abs 1e-9] [--family serving]
+        [--emit-history] [-v]
 """
 
 from __future__ import annotations
@@ -131,6 +138,22 @@ def compare(prev_path: str, new_path: str, threshold: float,
     return regressions
 
 
+def history_line(fam: str, rnd: int, path: str, status: str,
+                 min_abs: float) -> Dict[str, Any]:
+    """One ``PROGRESS.jsonl`` record: the round's direction-classified
+    headline metrics (keys the gate has an opinion about — the rest is
+    config echo, not trajectory).  Registry/console echoes
+    (``.registry.`` / ``.router_counters.``) are excluded: they are
+    runtime-dependent counters, not headline numbers."""
+    flat = _flatten(json.load(open(path)))
+    metrics = {k: v for k, v in sorted(flat.items())
+               if _direction(k) is not None and abs(v) >= min_abs
+               and ".registry." not in k and ".router_counters." not in k}
+    return {"schema": "dmlc.bench.progress/1", "family": fam,
+            "round": rnd, "artifact": os.path.basename(path),
+            "status": status, "metrics": metrics}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="gate the newest BENCH_*.json against the prior round")
@@ -144,6 +167,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="ignore values smaller than this (noise floor)")
     ap.add_argument("--family", default=None,
                     help="check one family only (e.g. serving)")
+    ap.add_argument("--emit-history", action="store_true",
+                    help="append each gated family's headline metrics as "
+                         "a JSON line to PROGRESS.jsonl")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -152,9 +178,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"check_regression: no BENCH_*.json under {args.dir}")
         return 0
     failed = False
+    history: List[Dict[str, Any]] = []
     for fam, rounds in sorted(families.items()):
         if len(rounds) < 2:
             print(f"{fam}: r{rounds[-1][0]:02d} only — baseline, pass")
+            history.append(history_line(fam, rounds[-1][0], rounds[-1][1],
+                                        "baseline", args.min_abs))
             continue
         (pr, prev_path), (nr, new_path) = rounds[-2], rounds[-1]
         regs = compare(prev_path, new_path, args.threshold, args.min_abs)
@@ -175,6 +204,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 for key in sorted(set(prev) & set(new)):
                     if _direction(key) is not None and abs(prev[key]) > 0:
                         print(f"    {key}: {prev[key]:g} → {new[key]:g}")
+        history.append(history_line(fam, nr, new_path,
+                                    "regressed" if regs else "pass",
+                                    args.min_abs))
+    if args.emit_history:
+        out = os.path.join(args.dir, "PROGRESS.jsonl")
+        with open(out, "a", encoding="utf-8") as f:
+            for line in history:
+                f.write(json.dumps(line, sort_keys=True) + "\n")
+        print(f"check_regression: appended {len(history)} history "
+              f"line(s) to {out}")
     return 1 if failed else 0
 
 

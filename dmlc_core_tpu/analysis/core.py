@@ -124,6 +124,8 @@ class LintContext:
         self.metric_sites: Dict[str, Set[str]] = {}
         #: literal span name → sorted set of repo-relative files
         self.span_sites: Dict[str, Set[str]] = {}
+        #: literal HTTP endpoint path → sorted set of repo-relative files
+        self.endpoint_sites: Dict[str, Set[str]] = {}
         #: modules visited this run (rel paths) — finalize-time scoping
         self.modules: List[str] = []
         #: True when a whole directory was linted — cross-file checks
@@ -138,6 +140,9 @@ class LintContext:
 
     def note_span(self, name: str, rel: str) -> None:
         self.span_sites.setdefault(name, set()).add(rel)
+
+    def note_endpoint(self, path: str, rel: str) -> None:
+        self.endpoint_sites.setdefault(path, set()).add(rel)
 
 
 class LintRule:
@@ -171,8 +176,9 @@ def lint_rule(name: str, description: str = ""):
 
 def _load_builtin_rules() -> None:
     # import for registration side effects; idempotent via the registry
-    from . import (rules_env, rules_io, rules_jit,  # noqa: F401
-                   rules_locks, rules_metrics, rules_spans, rules_threads)
+    from . import (rules_endpoints, rules_env, rules_io,  # noqa: F401
+                   rules_jit, rules_locks, rules_metrics, rules_spans,
+                   rules_threads)
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterable[str]:

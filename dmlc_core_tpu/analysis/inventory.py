@@ -2,26 +2,72 @@
 
 ``docs/inventory.json`` is generated from the lint run's collected
 vocabulary (every ``DMLC_*`` env key reaching an env-read call, every
-literal metric name, every literal span name) and committed, so a PR
-that adds or retires a knob shows the change as a reviewable diff — the same shape as the
-``BENCH_*.json`` trajectory that ``check_regression.py`` gates.
+literal metric name, every literal span name, every HTTP endpoint
+registered on a ``TelemetryServer``) and committed, so a PR that adds
+or retires a knob shows the change as a reviewable diff — the same
+shape as the ``BENCH_*.json`` trajectory that ``check_regression.py``
+gates.
 
 ``env-discipline``'s finalize pass fails the lint when code and
 inventory disagree, which forces the regeneration (and therefore the
 diff) to ride the PR that caused it.
+
+The ``help`` map (metric name → one-line meaning, parsed from the
+literal rows of the ``docs/observability.md`` metric catalog) is the
+source the Prometheus exporter reads at render time for ``# HELP``
+lines — docs and wire text cannot drift because they are the same
+string.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any, Dict
 
 from .core import LintContext
 
-SCHEMA = "dmlc.lint.inventory/1"
+SCHEMA = "dmlc.lint.inventory/2"
 
-__all__ = ["SCHEMA", "build", "write", "load"]
+__all__ = ["SCHEMA", "build", "write", "load", "doc_help"]
+
+#: a literal (brace-expandable, non-wildcard) catalog token
+_DOC_TOKEN = re.compile(r"`([a-z][a-z0-9_{}<>,./]*)`")
+
+
+def doc_help(docs_dir: str) -> Dict[str, str]:
+    """Metric name → meaning, from ``docs/observability.md``'s metric
+    catalog (tables whose header has a ``Type`` column).  Braced rows
+    (``a.{b,c}``) expand to one entry per name; ``<wildcard>`` rows are
+    skipped — a family whose name is dynamic has no single HELP line."""
+    from .rules_metrics import _expand_braces
+    path = os.path.join(docs_dir, "observability.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = f.read()
+    except OSError:
+        return {}
+    out: Dict[str, str] = {}
+    in_table = False
+    for line in doc.splitlines():
+        if not line.lstrip().startswith("|"):
+            in_table = False
+            continue
+        cells = line.split("|")
+        if any(c.strip() == "Type" for c in cells):
+            in_table = True
+            continue
+        if not in_table or len(cells) < 4:
+            continue
+        meaning = cells[3].strip()
+        if not meaning or set(meaning) <= {"-", ":", " "}:
+            continue
+        for m in _DOC_TOKEN.finditer(cells[1]):
+            for name in _expand_braces(m.group(1)):
+                if "<" not in name:
+                    out[name] = meaning
+    return out
 
 
 def build(ctx: LintContext) -> Dict[str, Any]:
@@ -34,6 +80,9 @@ def build(ctx: LintContext) -> Dict[str, Any]:
                     for k, v in sorted(ctx.metric_sites.items())},
         "spans": {k: sorted(v)
                   for k, v in sorted(ctx.span_sites.items())},
+        "endpoints": {k: sorted(v)
+                      for k, v in sorted(ctx.endpoint_sites.items())},
+        "help": doc_help(ctx.docs_dir),
     }
 
 

@@ -1,0 +1,123 @@
+"""endpoint-vocabulary: TelemetryServer HTTP paths match the docs table.
+
+Motivating bug class (PR 14 time machine): the exporter grew from five
+hardcoded paths to a route table (``@_endpoint("/timeline")`` in
+``telemetry/exposition.py``), and endpoint paths are operator-facing
+vocabulary exactly like metric and span names — dashboards, runbooks,
+and the e2e tests all ``curl`` them by literal path — yet nothing
+stopped a PR from mounting ``/analyze`` without a row in the
+``docs/observability.md`` endpoint table, or from leaving a stale
+``/oldpath`` row behind a rename.  Mirrors ``span-vocabulary``, both
+directions:
+
+* every **literal** path passed to ``_endpoint()`` must match the
+  endpoint grammar (``/lowercase``, single segment — the exporter is a
+  flat namespace by design);
+* every such path must have a row in the endpoint table of
+  ``docs/observability.md`` (the table whose header column is
+  ``Endpoint``);
+* every documented endpoint must still be registered in code (stale
+  doc rows fail too).
+
+Dynamically-built paths are skipped per-site, same as metrics/spans.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Set
+
+from .core import Finding, LintContext, LintRule, ParsedModule, lint_rule, \
+    str_const
+
+_ENDPOINT_FUNCS = {"_endpoint"}
+_GRAMMAR = re.compile(r"^/[a-z][a-z0-9_]*$")
+#: doc-table token: a backticked absolute path, optionally followed by
+#: a query-string example (`/timeline?metric=` documents `/timeline`)
+_DOC_TOKEN = re.compile(r"`(/[a-z][a-z0-9_]*)(?:\?[^`]*)?`")
+
+
+@lint_rule("endpoint-vocabulary",
+           description="TelemetryServer endpoint paths follow the flat "
+                       "/lowercase grammar and are documented in the "
+                       "docs/observability.md endpoint table (both ways)")
+class EndpointVocabularyRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = (fn.attr if isinstance(fn, ast.Attribute)
+                      else fn.id if isinstance(fn, ast.Name) else None)
+            if callee not in _ENDPOINT_FUNCS:
+                continue
+            path = str_const(node.args[0]) if node.args else None
+            if path is None:        # dynamic path — out of scope
+                continue
+            ctx.note_endpoint(path, mod.rel)
+            if not _GRAMMAR.match(path):
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno, node.col_offset,
+                    f"endpoint path {path!r} violates the endpoint "
+                    f"grammar (flat /lowercase segment)"))
+        return out
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not getattr(ctx, "full_run", False):
+            return []
+        doc_path = os.path.join(ctx.docs_dir, "observability.md")
+        rel = os.path.relpath(doc_path, ctx.repo_root)
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc = f.read()
+        except OSError:
+            return [Finding(self.name, rel, 0, 0,
+                            "docs/observability.md unreadable — the "
+                            "endpoint vocabulary has no contract to check "
+                            "against")]
+        documented = _doc_endpoint_vocabulary(doc)
+        code_paths = set(ctx.endpoint_sites)
+        out: List[Finding] = []
+        for path in sorted(code_paths - documented):
+            sites = ", ".join(sorted(ctx.endpoint_sites[path])[:3])
+            out.append(Finding(
+                self.name, rel, 0, 0,
+                f"endpoint {path!r} ({sites}) has no row in the "
+                f"docs/observability.md endpoint table — document it"))
+        for path in sorted(documented - code_paths):
+            out.append(Finding(
+                self.name, rel, 0, 0,
+                f"documented endpoint {path!r} is not registered on any "
+                f"TelemetryServer — delete the stale doc row (or restore "
+                f"the endpoint)"))
+        return out
+
+
+def _doc_endpoint_vocabulary(doc: str) -> Set[str]:
+    """Endpoint-table rows → set of documented paths.
+
+    A row counts when it sits in a markdown table whose header has an
+    ``Endpoint`` column and its first cell carries a backticked absolute
+    path (query-string examples like ``/timeline?metric=`` contribute
+    their path part via the token regex stopping at ``?``).
+    """
+    documented: Set[str] = set()
+    in_table = False
+    for line in doc.splitlines():
+        if not line.lstrip().startswith("|"):
+            in_table = False
+            continue
+        cells = line.split("|")
+        if any(c.strip() == "Endpoint" for c in cells):
+            in_table = True
+            continue
+        if not in_table or len(cells) < 3:
+            continue
+        for m in _DOC_TOKEN.finditer(cells[1]):
+            documented.add(m.group(1))
+    return documented

@@ -37,9 +37,10 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..telemetry.aggregate import render_fleet
+from ..telemetry.aggregate import ResetGuard, merge_states, render_fleet
 from ..telemetry.anomaly import StragglerBoard
 from ..telemetry.exposition import TelemetryServer
+from ..telemetry.timeseries import HistoryStore
 from ..utils import DMLCError, check, get_env, get_logger, log_info
 from ..utils.metrics import metrics
 
@@ -237,11 +238,19 @@ class RabitTracker:
         # rank-tagged state feeds the board, /metrics carries per-rank
         # straggler_z / straggler_suspect gauges, /stragglers the JSON
         self.straggler_board = StragglerBoard()
+        # restarted workers must not drive merged fleet counters
+        # backwards: re-base at the ingestion point
+        self._reset_guard = ResetGuard()
+        # fleet timeline: sample the merged view (rank-tagged pushed
+        # histories fold into one queryable /timeline)
+        self.history = HistoryStore(
+            snapshot_fn=lambda: merge_states(self.telemetry_states()))
         self.telemetry: Optional[TelemetryServer] = None
         if telemetry_port is not None:
             self.telemetry = TelemetryServer(
                 port=int(telemetry_port), metrics_fn=self._render_fleet,
-                stragglers_fn=self.straggler_board.snapshot)
+                stragglers_fn=self.straggler_board.snapshot,
+                timeline_fn=self.history.timeline)
 
     # -- public control --
     def start(self) -> None:
@@ -254,6 +263,7 @@ class RabitTracker:
             self._monitor.start()
         if self.telemetry is not None:
             self.telemetry.start()
+            self.history.start()
             log_info("tracker fleet metrics at http://%s:%d/metrics",
                      self.host_ip, self.telemetry.port)
         log_info("tracker started at %s:%d for %d workers",
@@ -287,6 +297,7 @@ class RabitTracker:
     def stop(self) -> None:
         self._stop = True
         self._monitor_stop.set()
+        self.history.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
         try:
@@ -338,8 +349,10 @@ class RabitTracker:
                 # (each push is a full snapshot, not a delta)
                 state = msg.get("state")
                 if isinstance(state, dict):
+                    rank = str(msg.get("rank"))
+                    state = self._reset_guard.fold(rank, state)
                     with self._lock:
-                        self._telemetry_states[str(msg.get("rank"))] = state
+                        self._telemetry_states[rank] = state
                     # outside the tracker lock: the board has its own
                     self.straggler_board.update(msg.get("rank"), state)
             elif cmd == "heartbeat":

@@ -49,9 +49,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from ...parallel.tracker import LivenessBoard, recv_json, send_json
 from ...telemetry import flight as flight_mod
 from ...telemetry import trace as teltrace
-from ...telemetry.aggregate import state_to_snapshot
+from ...telemetry.aggregate import ResetGuard, merge_states, state_to_snapshot
 from ...telemetry.anomaly import StragglerBoard
 from ...telemetry.exposition import TelemetryServer
+from ...telemetry.timeseries import HistoryStore
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
 from ...utils.parameter import get_env
@@ -173,12 +174,20 @@ class Dispatcher:
         if telemetry_port is None:
             p = get_env("DMLC_DISPATCHER_METRICS_PORT", -1)
             telemetry_port = p if p >= 0 else None
+        # restarted workers re-push counters from zero; re-base at the
+        # ingestion point so the merged fleet view stays monotonic
+        self._reset_guard = ResetGuard()
+        # fleet timeline: the merged heartbeat-pushed states, sampled
+        # into tiered rings and served at /timeline
+        self.history = HistoryStore(
+            snapshot_fn=lambda: merge_states(self.worker_states()))
         self.telemetry: Optional[TelemetryServer] = None
         if telemetry_port is not None:
             self.telemetry = TelemetryServer(
                 port=int(telemetry_port),
                 leases_fn=self.ledger_snapshot,
-                fleet_fn=self.fleet_snapshot)
+                fleet_fn=self.fleet_snapshot,
+                timeline_fn=self.history.timeline)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -193,6 +202,7 @@ class Dispatcher:
             self._threads.append(t)
         if self.telemetry is not None:
             self.telemetry.start()
+            self.history.start()
         # incident bundles dumped in this process carry the lease ledger
         # — a churn postmortem reads transitions, not log archaeology
         flight_mod.register_contributor("lease_ledger", self.ledger_snapshot)
@@ -204,6 +214,7 @@ class Dispatcher:
     def stop(self) -> None:
         self._stop_ev.set()
         flight_mod.unregister_contributor("lease_ledger")
+        self.history.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
         # shutdown() before close(): close() alone does not wake a thread
@@ -427,6 +438,7 @@ class Dispatcher:
                 # metric push riding the heartbeat: last write wins (each
                 # push is a full registry state, not a delta); the same
                 # pushes feed cross-worker straggler detection
+                state = self._reset_guard.fold(jobid, state)
                 with self._lock:
                     self._worker_states[jobid] = state
                 self.straggler_board.update(jobid, state)

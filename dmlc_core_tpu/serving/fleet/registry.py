@@ -43,6 +43,7 @@ from ...telemetry import flight as flight_mod
 from ...telemetry import trace as teltrace
 from ...telemetry.anomaly import StragglerBoard
 from ...telemetry.exposition import TelemetryServer
+from ...telemetry.timeseries import HistoryStore
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
 from ...utils.parameter import get_env
@@ -120,12 +121,18 @@ class ReplicaRegistry:
         self.host, self.port = self._srv.getsockname()[:2]
         from .rollout import RolloutManager
         self.rollouts = RolloutManager(self)
+        # fleet timeline: the registry's own counters plus synthetic
+        # fleet-level gauges derived from heartbeat reports, so
+        # /timeline answers "how did alive-count / aggregate inflight /
+        # worst queue pressure move" without scraping every replica
+        self.history = HistoryStore(snapshot_fn=self._history_snapshot)
         self.telemetry: Optional[TelemetryServer] = None
         if telemetry_port is not None:
             self.telemetry = TelemetryServer(
                 port=int(telemetry_port),
                 fleet_fn=self.fleet_snapshot,
-                rollouts_fn=self.rollouts.snapshot)
+                rollouts_fn=self.rollouts.snapshot,
+                timeline_fn=self.history.timeline)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -141,6 +148,7 @@ class ReplicaRegistry:
         self.rollouts.start()
         if self.telemetry is not None:
             self.telemetry.start()
+            self.history.start()
         # incident bundles dumped in this process carry the rollout
         # ledger — a bad-canary postmortem reads transitions directly
         flight_mod.register_contributor("rollout_ledger",
@@ -152,6 +160,7 @@ class ReplicaRegistry:
     def stop(self) -> None:
         self._stop_ev.set()
         flight_mod.unregister_contributor("rollout_ledger")
+        self.history.stop()
         self.rollouts.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
@@ -231,6 +240,27 @@ class ReplicaRegistry:
         return {"schema": "dmlc.serving.fleet/1", "ts": time.time(),
                 "heartbeat_timeout_s": self.heartbeat_timeout_s,
                 "replicas": replicas, "models": self.models_snapshot()}
+
+    def _history_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """What the fleet timeline samples: the registry's own registry
+        plus snapshot-form gauges rolled up from replica heartbeats."""
+        records = self.replica_records()
+        alive = [r for r in records.values() if r.get("alive")]
+        rollup = {
+            "fleet.replicas.alive": float(len(alive)),
+            "fleet.replicas.total": float(len(records)),
+            "fleet.inflight.total": float(sum(
+                r.get("inflight") or 0 for r in alive)),
+            "fleet.qps.total": float(sum(r.get("qps") or 0.0
+                                         for r in alive)),
+            "fleet.queue_fraction.max": float(max(
+                (r.get("queue_fraction") or 0.0 for r in alive),
+                default=0.0)),
+        }
+        snap = dict(metrics.snapshot())
+        for name, v in rollup.items():
+            snap[name] = {"type": "gauge", "value": v}
+        return snap
 
     # -- rollout plumbing ------------------------------------------------
     def push_directive(self, jobid: str, directive: dict) -> None:

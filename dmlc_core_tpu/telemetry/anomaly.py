@@ -451,6 +451,7 @@ class SloMonitor:
             fl.flight_recorder.note_snapshot(registry=self.registry)
         fired = [b for b in (rule.check(snapshot) for rule in self.rules)
                  if b is not None]
+        fired.extend(self._extra_checks(snapshot))
         self.registry.gauge("slo.active_breaches").set(len(fired))
         if fired:
             self.breaches = fired
@@ -467,6 +468,13 @@ class SloMonitor:
                 fl.dump_incident("slo_breach", registry=self.registry,
                                  breaches=fired)
         return fired
+
+    def _extra_checks(self, snapshot: Dict[str, Any]
+                      ) -> List[Dict[str, Any]]:
+        """Hook for subclasses adding non-snapshot checks (the burn-rate
+        monitor in :mod:`~dmlc_core_tpu.telemetry.slo` evaluates its
+        rules against the history store here)."""
+        return []
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -510,6 +518,15 @@ def maybe_monitor_from_env(registry: Optional[MetricsRegistry] = None,
     if (_env_monitor is not None and _env_monitor.spec == spec
             and _env_monitor._thread is not None):
         return _env_monitor
-    mon = SloMonitor(parse_slo_spec(spec), registry=registry, spec=spec)
+    # route through the superset grammar: clauses with budget= become
+    # burn-rate rules over the history store (telemetry.slo), plain
+    # clauses behave exactly as before
+    from . import slo as _slo
+    plain, burn = _slo.parse_slo_spec(spec)
+    if burn:
+        mon: SloMonitor = _slo.BurnRateMonitor(plain, burn,
+                                               registry=registry, spec=spec)
+    else:
+        mon = SloMonitor(plain, registry=registry, spec=spec)
     _env_monitor = mon
     return mon.start() if autostart else mon

@@ -25,6 +25,7 @@ tracker mounts one for the fleet view, and
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import urllib.parse
@@ -42,6 +43,50 @@ _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 #: health states a health_fn may return, with their HTTP mapping
 _HEALTH_HTTP = {"ok": 200, "degraded": 200, "overloaded": 503}
+
+#: route table: URL path → TelemetryServer handler method name.  Every
+#: endpoint is declared through :func:`_endpoint` so the set is one
+#: greppable table — the dmlclint ``endpoint-vocabulary`` rule checks
+#: these literals against the docs/observability.md endpoint table.
+_ROUTES: Dict[str, str] = {}
+
+
+def _endpoint(path: str):
+    """Register a ``TelemetryServer`` method as the handler for ``path``
+    (handlers return ``(status, content_type, body)``)."""
+
+    def deco(fn):
+        _ROUTES[path] = fn.__name__
+        return fn
+
+    return deco
+
+
+#: metric-name → one-line help text, lazily loaded from the committed
+#: ``docs/inventory.json`` catalog (``# HELP`` sourcing); missing or
+#: unreadable inventory degrades to no HELP lines, never an error
+_HELP_CACHE: Optional[Dict[str, str]] = None
+
+
+def _help_catalog() -> Dict[str, str]:
+    global _HELP_CACHE
+    if _HELP_CACHE is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "..", "docs", "inventory.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            helps = doc.get("help", {})
+            _HELP_CACHE = {k: str(v) for k, v in helps.items()
+                           if isinstance(v, str)}
+        except (OSError, ValueError):
+            _HELP_CACHE = {}
+    return _HELP_CACHE
+
+
+def _escape_help(text: str) -> str:
+    """Text-format 0.0.4 HELP escaping: backslash and newline only."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _sanitize(name: str) -> str:
@@ -129,15 +174,22 @@ def _family_samples(name: str, snap: Dict[str, Any],
 
 def render_series(series: Sequence[Tuple[Optional[Dict[str, str]],
                                          Dict[str, Dict[str, Any]]]],
-                  prefix: str = "dmlc") -> str:
+                  prefix: str = "dmlc",
+                  help_map: Optional[Dict[str, str]] = None) -> str:
     """Render labeled snapshots into one exposition page.
 
     ``series`` is ``[(labels_or_None, snapshot), ...]``; samples of the
     same family from different label sets share a single ``# TYPE``
-    header (duplicated headers are invalid exposition format).
+    header (duplicated headers are invalid exposition format).  Each
+    family whose source metric has a row in the ``docs/inventory.json``
+    help catalog gets a ``# HELP`` line (``help_map`` overrides the
+    catalog; pass ``{}`` to disable).
     """
+    if help_map is None:
+        help_map = _help_catalog()
     families: Dict[str, Tuple[str, List[str]]] = {}
     order: List[str] = []
+    sources: Dict[str, str] = {}      # family → source metric name
     for labels, snapshot in series:
         for name in sorted(snapshot):
             for fam, ptype, lines in _family_samples(
@@ -145,10 +197,14 @@ def render_series(series: Sequence[Tuple[Optional[Dict[str, str]],
                 if fam not in families:
                     families[fam] = (ptype, [])
                     order.append(fam)
+                    sources[fam] = name
                 families[fam][1].extend(lines)
     out: List[str] = []
     for fam in order:
         ptype, lines = families[fam]
+        help_text = help_map.get(sources.get(fam, ""))
+        if help_text:
+            out.append(f"# HELP {fam} {_escape_help(help_text)}")
         out.append(f"# TYPE {fam} {ptype}")
         out.extend(lines)
     return "\n".join(out) + ("\n" if out else "")
@@ -156,9 +212,11 @@ def render_series(series: Sequence[Tuple[Optional[Dict[str, str]],
 
 def render_prometheus(snapshot: Dict[str, Dict[str, Any]],
                       labels: Optional[Dict[str, str]] = None,
-                      prefix: str = "dmlc") -> str:
+                      prefix: str = "dmlc",
+                      help_map: Optional[Dict[str, str]] = None) -> str:
     """Prometheus text format 0.0.4 for one registry snapshot."""
-    return render_series([(labels, snapshot)], prefix=prefix)
+    return render_series([(labels, snapshot)], prefix=prefix,
+                         help_map=help_map)
 
 
 def _text_table(headers: List[str], rows: List[List[str]]) -> List[str]:
@@ -261,7 +319,11 @@ class TelemetryServer:
     span records as JSON), ``/flight`` (on-demand incident bundle),
     ``/stragglers`` (tracker only — cross-rank straggler board JSON),
     ``/profile?seconds=N`` (collapsed-stack sampling profile of this
-    process), and — when the hosting process injects them — ``/leases``
+    process), ``/timeline?metric=&since=&format=json|text`` (the
+    time-machine history store — process-local by default, the merged
+    fleet store on the tracker/dispatcher), ``/analyze?top=N``
+    (critical-path breakdown of the slowest traces in the span ring),
+    and — when the hosting process injects them — ``/leases``
     (dispatcher lease-lifecycle ledger), ``/fleet`` (dispatcher worker
     or serving replica console; ``?format=text|html`` renders the
     status board instead of JSON) and ``/rollouts`` (serving-fleet
@@ -283,6 +345,11 @@ class TelemetryServer:
                  fleet_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  profile_fn: Optional[Callable[[float], str]] = None,
                  rollouts_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 timeline_fn: Optional[Callable[[Optional[str],
+                                                 Optional[float]],
+                                                Dict[str, Any]]] = None,
+                 analyze_fn: Optional[Callable[[int],
+                                               Dict[str, Any]]] = None,
                  ) -> None:
         if metrics_fn is None:
             from ..utils.metrics import metrics as _registry
@@ -295,6 +362,8 @@ class TelemetryServer:
             flight_fn = self._default_flight
         if profile_fn is None:
             profile_fn = self._default_profile
+        if analyze_fn is None:
+            analyze_fn = self._default_analyze
         self._metrics_fn = metrics_fn
         self._health_fn = health_fn
         self._spans_fn = spans_fn
@@ -304,6 +373,10 @@ class TelemetryServer:
         self._fleet_fn = fleet_fn
         self._profile_fn = profile_fn
         self._rollouts_fn = rollouts_fn
+        # None → the process-global history store, resolved (and its
+        # sampler started, DMLC_TIMELINE permitting) at start()
+        self._timeline_fn = timeline_fn
+        self._analyze_fn = analyze_fn
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -329,10 +402,24 @@ class TelemetryServer:
     @staticmethod
     def _default_health() -> str:
         """Standalone exporters report the serving health gauge when the
-        process runs a server (0 ok / 1 degraded / 2 overloaded), else ok."""
+        process runs a server (0 ok / 1 degraded / 2 overloaded); a
+        process with no server still degrades on live SLO breaches
+        (``slo.active_breaches`` > 0 — the burn-rate engine's handle on
+        ``/healthz``), else ok."""
         from ..utils.metrics import metrics as _registry
         v = _registry.gauge("serving.server.health").value
-        return {0: "ok", 1: "degraded", 2: "overloaded"}.get(int(v), "ok")
+        status = {0: "ok", 1: "degraded", 2: "overloaded"}.get(int(v), "ok")
+        if status == "ok" and \
+                _registry.gauge("slo.active_breaches").value > 0:
+            return "degraded"
+        return status
+
+    @staticmethod
+    def _default_analyze(top: int) -> Dict[str, Any]:
+        """``GET /analyze?top=N``: critical-path breakdown of the N
+        slowest traces in this process's span ring."""
+        from . import critical_path as _critical_path
+        return _critical_path.analyze(top=top)
 
     @property
     def port(self) -> int:
@@ -341,9 +428,125 @@ class TelemetryServer:
             return self._httpd.server_address[1]
         return self._requested[1]
 
+    # -- endpoint handlers -------------------------------------------------
+    # Each returns (status, content_type, body-str); registration via
+    # @_endpoint keeps the route vocabulary a single greppable table.
+
+    @staticmethod
+    def _json(doc: Any, code: int = 200) -> Tuple[int, str, str]:
+        return code, "application/json", json.dumps(doc, default=str)
+
+    @_endpoint("/metrics")
+    def _ep_metrics(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                self._metrics_fn())
+
+    @_endpoint("/healthz")
+    def _ep_healthz(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        # a health_fn may return the bare status word or a full JSON doc
+        # with a "status" key (serving replicas add queue_fraction/
+        # inflight so load balancers weight off this one endpoint)
+        status = self._health_fn()
+        doc = status if isinstance(status, dict) else {"status": status}
+        return self._json(doc, _HEALTH_HTTP.get(str(doc.get("status")), 200))
+
+    @_endpoint("/spans")
+    def _ep_spans(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        return self._json({"spans": self._spans_fn()})
+
+    @_endpoint("/flight")
+    def _ep_flight(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        return self._json(self._flight_fn())
+
+    @_endpoint("/stragglers")
+    def _ep_stragglers(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        if self._stragglers_fn is None:
+            # worker exporters have no cross-rank view — only the
+            # tracker mounts a straggler board
+            return (404, "text/plain",
+                    "no straggler board here (tracker-only endpoint)\n")
+        return self._json(self._stragglers_fn())
+
+    @_endpoint("/leases")
+    def _ep_leases(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        if self._leases_fn is None:
+            # only the data-service dispatcher owns a lease table
+            return (404, "text/plain",
+                    "no lease ledger here (dispatcher-only endpoint)\n")
+        return self._json(self._leases_fn())
+
+    @_endpoint("/fleet")
+    def _ep_fleet(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        if self._fleet_fn is None:
+            return (404, "text/plain",
+                    "no fleet console here (dispatcher-only endpoint)\n")
+        doc = self._fleet_fn()
+        fmt = query.get("format", "json")
+        if fmt == "html":
+            return (200, "text/html; charset=utf-8",
+                    render_fleet_board(doc, html=True))
+        if fmt == "text":
+            return 200, "text/plain; charset=utf-8", render_fleet_board(doc)
+        return self._json(doc)
+
+    @_endpoint("/rollouts")
+    def _ep_rollouts(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        if self._rollouts_fn is None:
+            # only a replica registry (or a router proxying one) owns a
+            # rollout ledger
+            return (404, "text/plain",
+                    "no rollout ledger here (registry/router endpoint)\n")
+        return self._json(self._rollouts_fn())
+
+    @_endpoint("/profile")
+    def _ep_profile(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        try:
+            seconds = float(query.get("seconds", "1"))
+        except ValueError:
+            seconds = 1.0
+        return 200, "text/plain; charset=utf-8", self._profile_fn(seconds)
+
+    @_endpoint("/timeline")
+    def _ep_timeline(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        from . import timeseries as _timeseries
+        fn = self._timeline_fn or _timeseries.history.timeline
+        metric = query.get("metric") or None
+        since: Optional[float] = None
+        raw_since = query.get("since")
+        if raw_since:
+            from .slo import parse_duration
+            since = parse_duration(raw_since)   # "300", "5m", "90s" all ok
+        doc = fn(metric, since)
+        if query.get("format") == "text":
+            return (200, "text/plain; charset=utf-8",
+                    _timeseries.render_timeline_text(doc))
+        return self._json(doc)
+
+    @_endpoint("/analyze")
+    def _ep_analyze(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        try:
+            top = int(query.get("top", "5"))
+        except ValueError:
+            top = 5
+        doc = self._analyze_fn(top)
+        if query.get("format") == "text":
+            from . import critical_path as _critical_path
+            return (200, "text/plain; charset=utf-8",
+                    _critical_path.render_text(doc))
+        return self._json(doc)
+
     def start(self) -> "TelemetryServer":
         if self._httpd is not None:
             return self
+        # default /timeline serves the process-global history store;
+        # mounting an exporter is the "observability on" gesture, so it
+        # also starts the sampler (DMLC_TIMELINE=0 opts out).  Hosts
+        # that inject a fleet store (tracker/dispatcher) own its
+        # lifecycle themselves.
+        if self._timeline_fn is None:
+            from . import timeseries as _timeseries
+            _timeseries.maybe_start_sampler()
+            self._timeline_fn = _timeseries.history.timeline
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -364,103 +567,17 @@ class TelemetryServer:
                 path, _, rawq = self.path.partition("?")
                 query = {k: vs[-1] for k, vs
                          in urllib.parse.parse_qs(rawq).items()}
+                handler = _ROUTES.get(path)
                 try:
-                    if path == "/metrics":
-                        body = outer._metrics_fn().encode("utf-8")
-                        self._send(200, "text/plain; version=0.0.4; "
-                                        "charset=utf-8", body)
-                    elif path == "/healthz":
-                        # a health_fn may return the bare status word or
-                        # a full JSON doc with a "status" key (serving
-                        # replicas add queue_fraction/inflight so load
-                        # balancers weight off this one endpoint)
-                        status = outer._health_fn()
-                        doc = (status if isinstance(status, dict)
-                               else {"status": status})
-                        code = _HEALTH_HTTP.get(str(doc.get("status")),
-                                                200)
-                        self._send(code, "application/json",
-                                   json.dumps(doc, default=str)
-                                   .encode("utf-8"))
-                    elif path == "/spans":
-                        self._send(200, "application/json",
-                                   json.dumps({"spans": outer._spans_fn()})
-                                   .encode("utf-8"))
-                    elif path == "/flight":
-                        self._send(200, "application/json",
-                                   json.dumps(outer._flight_fn(),
-                                              default=str)
-                                   .encode("utf-8"))
-                    elif path == "/stragglers":
-                        if outer._stragglers_fn is None:
-                            # worker exporters have no cross-rank view —
-                            # only the tracker mounts a straggler board
-                            self._send(404, "text/plain",
-                                       b"no straggler board here "
-                                       b"(tracker-only endpoint)\n")
-                        else:
-                            self._send(200, "application/json",
-                                       json.dumps(outer._stragglers_fn(),
-                                                  default=str)
-                                       .encode("utf-8"))
-                    elif path == "/leases":
-                        if outer._leases_fn is None:
-                            # only the data-service dispatcher owns a
-                            # lease table; everyone else 404s
-                            self._send(404, "text/plain",
-                                       b"no lease ledger here "
-                                       b"(dispatcher-only endpoint)\n")
-                        else:
-                            self._send(200, "application/json",
-                                       json.dumps(outer._leases_fn(),
-                                                  default=str)
-                                       .encode("utf-8"))
-                    elif path == "/fleet":
-                        if outer._fleet_fn is None:
-                            self._send(404, "text/plain",
-                                       b"no fleet console here "
-                                       b"(dispatcher-only endpoint)\n")
-                        else:
-                            doc = outer._fleet_fn()
-                            fmt = query.get("format", "json")
-                            if fmt == "html":
-                                self._send(200, "text/html; charset=utf-8",
-                                           render_fleet_board(doc, html=True)
-                                           .encode("utf-8"))
-                            elif fmt == "text":
-                                self._send(200,
-                                           "text/plain; charset=utf-8",
-                                           render_fleet_board(doc)
-                                           .encode("utf-8"))
-                            else:
-                                self._send(200, "application/json",
-                                           json.dumps(doc, default=str)
-                                           .encode("utf-8"))
-                    elif path == "/rollouts":
-                        if outer._rollouts_fn is None:
-                            # only a replica registry (or a router
-                            # proxying one) owns a rollout ledger
-                            self._send(404, "text/plain",
-                                       b"no rollout ledger here "
-                                       b"(registry/router endpoint)\n")
-                        else:
-                            self._send(200, "application/json",
-                                       json.dumps(outer._rollouts_fn(),
-                                                  default=str)
-                                       .encode("utf-8"))
-                    elif path == "/profile":
-                        try:
-                            seconds = float(query.get("seconds", "1"))
-                        except ValueError:
-                            seconds = 1.0
-                        body = outer._profile_fn(seconds)
-                        self._send(200, "text/plain; charset=utf-8",
-                                   body.encode("utf-8"))
+                    if handler is None:
+                        code, ctype, body = 404, "text/plain", "not found\n"
                     else:
-                        self._send(404, "text/plain", b"not found\n")
+                        code, ctype, body = getattr(outer, handler)(query)
                 except Exception as e:   # scrape must never kill the server
-                    self._send(500, "text/plain",
-                               f"exporter error: {e}\n".encode("utf-8"))
+                    code, ctype, body = (500, "text/plain",
+                                         f"exporter error: {e}\n")
+                self._send(code, ctype, body.encode("utf-8")
+                           if isinstance(body, str) else body)
 
         self._httpd = ThreadingHTTPServer(self._requested, Handler)
         self._httpd.daemon_threads = True
@@ -468,15 +585,9 @@ class TelemetryServer:
             target=self._httpd.serve_forever, name="dmlc-telemetry",
             daemon=True)
         self._thread.start()
-        extra = "".join(
-            label for label, fn in (
-                (" /stragglers", self._stragglers_fn),
-                (" /leases", self._leases_fn),
-                (" /fleet", self._fleet_fn),
-                (" /rollouts", self._rollouts_fn)) if fn is not None)
-        log_info("telemetry exporter listening on %s:%d "
-                 "(/metrics /healthz /spans /flight /profile%s)",
-                 self._requested[0], self.port, extra)
+        log_info("telemetry exporter listening on %s:%d (%s)",
+                 self._requested[0], self.port,
+                 " ".join(sorted(_ROUTES)))
         return self
 
     def stop(self) -> None:

@@ -196,6 +196,24 @@ class FlightRecorder:
             sections["profile_collapsed"] = _profiling.incident_profile()
         except Exception as e:
             sections["profile_collapsed"] = f"<profiler failed: {e}>"
+        # the before/after the snapshot can't give: the surrounding
+        # timeline slice and where the slow requests actually spent
+        # their time (both skipped when empty — a bundle from a process
+        # with no sampler or no spans stays lean)
+        try:
+            from . import timeseries as _timeseries
+            tl = _timeseries.history.snapshot_doc()
+            if tl.get("series"):
+                sections["timeline"] = tl
+        except Exception as e:
+            sections["timeline"] = f"<timeline failed: {e}>"
+        try:
+            from . import critical_path as _critical_path
+            breakdown = _critical_path.incident_breakdown()
+            if breakdown:
+                sections["critical_path"] = breakdown
+        except Exception as e:
+            sections["critical_path"] = f"<critical path failed: {e}>"
         return {
             **sections,
             "schema": INCIDENT_SCHEMA,
@@ -249,6 +267,12 @@ class FlightRecorder:
             prof = doc.get("profile_collapsed")
             if isinstance(prof, str) and prof:
                 doc["files"]["profile"] = "profile.txt"
+            tl = doc.get("timeline")
+            if isinstance(tl, dict) and tl.get("series"):
+                doc["files"]["timeline"] = "timeline.json"
+            cpath = doc.get("critical_path")
+            if isinstance(cpath, str) and cpath:
+                doc["files"]["critical_path"] = "critical_path.txt"
             # tmp + rename per file: a crash mid-dump (likely — this IS
             # the crash path) must not leave a half-written bundle that
             # post-mortem tooling then chokes on
@@ -268,6 +292,12 @@ class FlightRecorder:
                 # collapsed stacks as their own file: flamegraph.pl and
                 # speedscope read the format directly, no JSON unwrapping
                 _put("profile.txt", lambda f: f.write(prof + "\n"))
+            if isinstance(tl, dict) and tl.get("series"):
+                _put("timeline.json",
+                     lambda f: json.dump(tl, f, indent=2, sort_keys=True,
+                                         default=str))
+            if isinstance(cpath, str) and cpath:
+                _put("critical_path.txt", lambda f: f.write(cpath))
         except OSError as e:
             # the black box must never become the crash: report and move on
             log_warning("flight recorder dump to %s failed: %s", path, e)

@@ -69,14 +69,22 @@ def test_incident_bundle_schema_golden(tmp_path):
     rec._min_interval = 0.0
     rec.note("fault_injected", site="x.y")
     metrics.counter("drill.work").add(1)
+    # two passes of the time-machine sampler bracket the second counter
+    # bump so the bundle's timeline slice deterministically has series
+    # (counter → rate needs a baseline sample plus a delta)
+    from dmlc_core_tpu.telemetry import timeseries
+    timeseries.history.sample_once()
     rec.note_snapshot()
     metrics.counter("drill.work").add(3)
     with teltrace.span("drill.step"):
         pass
+    timeseries.history.sample_once()
     path = rec.arm(str(tmp_path)).dump("unit_test", why="golden")
     assert path is not None and os.path.isdir(path)
-    assert sorted(os.listdir(path)) == ["incident.json", "log_tail.txt",
-                                        "profile.txt", "trace.json"]
+    assert sorted(os.listdir(path)) == ["critical_path.txt",
+                                        "incident.json", "log_tail.txt",
+                                        "profile.txt", "timeline.json",
+                                        "trace.json"]
     doc = json.load(open(os.path.join(path, "incident.json")))
     for key in ("schema", "reason", "detail", "ts", "pid", "host", "rank",
                 "slo_spec", "fault_spec", "metrics", "metrics_delta",
@@ -91,6 +99,13 @@ def test_incident_bundle_schema_golden(tmp_path):
     assert doc["metrics_delta"]["deltas"]["drill.work"] == 3
     # the incident carries the stacks that were running when it fired
     assert doc["files"]["profile"] == "profile.txt"
+    # the time-machine evidence rides every bundle with data to show
+    assert doc["files"]["timeline"] == "timeline.json"
+    assert doc["files"]["critical_path"] == "critical_path.txt"
+    tl = json.load(open(os.path.join(path, "timeline.json")))
+    assert "drill.work.rate" in tl["series"]
+    cp = open(os.path.join(path, "critical_path.txt")).read()
+    assert "drill.step" in cp
     prof = open(os.path.join(path, "profile.txt")).read()
     assert prof.strip(), "collapsed-stack profile must be non-empty"
     _assert_chrome_trace_valid(

@@ -186,6 +186,70 @@ def test_span_doc_two_way_check(tmp_path):
     assert len(findings) == 2
 
 
+# -- endpoint-vocabulary ----------------------------------------------------
+
+ENDPOINT_DOC = """\
+## Endpoints
+
+| Endpoint | Where | Meaning |
+|---|---|---|
+| `/metrics` | everywhere | Prometheus text |
+| `/timeline?metric=&since=` | everywhere | history store |
+| `/stale` | nowhere | retired long ago |
+
+| Name | Type | Meaning |
+|---|---|---|
+| `app.latency_s` | histogram | must not leak into the endpoint table |
+"""
+
+
+def test_endpoint_grammar(tmp_path):
+    findings, _, ctx = _lint_snippet(tmp_path, """\
+        _ROUTES = {}
+        def _endpoint(path):
+            def deco(fn):
+                _ROUTES[path] = fn.__name__
+                return fn
+            return deco
+        @_endpoint("/metrics")
+        def a(q): pass
+        @_endpoint("/BadPath")
+        def b(q): pass
+        @_endpoint("/two/segments")
+        def c(q): pass
+    """, rules=["endpoint-vocabulary"])
+    msgs = [f.message for f in findings]
+    assert any("/BadPath" in m for m in msgs)
+    assert any("/two/segments" in m for m in msgs)
+    assert not any("'/metrics'" in m for m in msgs)
+    assert "/metrics" in ctx.endpoint_sites   # noted for the inventory
+
+
+def test_endpoint_doc_two_way_check(tmp_path):
+    pkg = _fake_repo(tmp_path, ENDPOINT_DOC, """\
+        def _endpoint(path):
+            def deco(fn):
+                return fn
+            return deco
+        @_endpoint("/metrics")
+        def a(q): pass
+        @_endpoint("/timeline")
+        def t(q): pass
+        @_endpoint("/undocumented")
+        def u(q): pass
+    """)
+    findings, _, _ = lint_paths([str(pkg)], rules=["endpoint-vocabulary"],
+                                repo_root=str(tmp_path))
+    msgs = [f.message for f in findings]
+    # /undocumented missing a row; /stale documented but unregistered
+    assert any("/undocumented" in m for m in msgs)
+    assert any("/stale" in m for m in msgs)
+    # query-string doc rows cover their path; the metric table never
+    # leaks into the endpoint vocabulary
+    assert not any("'/metrics'" in m or "'/timeline'" in m for m in msgs)
+    assert len(findings) == 2
+
+
 # -- lock-discipline --------------------------------------------------------
 
 def test_lock_mixed_guard_flagged(tmp_path):
@@ -406,8 +470,8 @@ def test_cli_lists_all_builtin_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in ("env-discipline", "metric-vocabulary", "span-vocabulary",
-                 "lock-discipline", "atomic-write", "retrace-hazard",
-                 "thread-hygiene"):
+                 "endpoint-vocabulary", "lock-discipline", "atomic-write",
+                 "retrace-hazard", "thread-hygiene"):
         assert rule in out
 
 

@@ -1,0 +1,701 @@
+"""Telemetry time machine (PR 14): the tiered history store, burn-rate
+SLO engine, critical-path analytics, counter-reset guards at the fleet
+ingestion points, the ``/timeline`` + ``/analyze`` endpoints over real
+sockets, Prometheus exposition conformance, and the e2e chaos drill
+that ties the whole plane together — all CPU, all stdlib wire."""
+
+import json
+import math
+import os
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from dmlc_core_tpu.telemetry import aggregate, critical_path, exposition, slo
+from dmlc_core_tpu.telemetry import timeseries as ts
+from dmlc_core_tpu.telemetry import trace as teltrace
+from dmlc_core_tpu.telemetry.anomaly import SloSpecError
+from dmlc_core_tpu.utils.metrics import MetricsRegistry, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: fixed synthetic epoch, multiple of every tier step used below, so
+#: downsample bucket edges land exactly on T0 + k*step
+T0 = 1_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    teltrace.recorder.clear()
+    yield
+    teltrace.recorder.clear()
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _gauge_store(vals, tiers):
+    """A store over one mutable gauge — the minimal deterministic source."""
+    return ts.HistoryStore(
+        snapshot_fn=lambda: {"g": {"type": "gauge", "value": vals["v"]}},
+        tiers=tiers)
+
+
+# ---------------------------------------------------------------------------
+# history store: tiers, flattening, rates, resets
+# ---------------------------------------------------------------------------
+
+def test_parse_tiers():
+    assert ts.parse_tiers("1x300,10x360") == [(1.0, 300), (10.0, 360)]
+    assert ts.parse_tiers(" 0.5x10 ") == [(0.5, 10)]
+    for bad in ("5", "", "0x10", "1xz", "10x5,1x300"):
+        with pytest.raises(ts.TierSpecError):
+            ts.parse_tiers(bad)
+
+
+def test_tier_boundary_downsampling():
+    """Tier 0 keeps raw samples; tier 1 closes each bucket at
+    bucket_id*step with the bucket mean — and the still-open bucket is
+    not visible until it closes."""
+    vals = {"v": 0.0}
+    store = _gauge_store(vals, tiers=[(1.0, 5), (10.0, 4)])
+    for i in range(25):
+        vals["v"] = float(i)
+        store.sample_once(now=T0 + i)
+    # tier 0: raw ring holds the last 5 samples
+    tier0 = store.query("g", since=4.0, now=T0 + 24)
+    assert tier0 == [(T0 + 20.0, 20.0), (T0 + 21.0, 21.0),
+                     (T0 + 22.0, 22.0), (T0 + 23.0, 23.0),
+                     (T0 + 24.0, 24.0)]
+    # tier 1: buckets [T0,T0+10) and [T0+10,T0+20) closed as their
+    # means, stamped at the bucket START; [T0+20,..) is still open
+    tier1 = store.query("g", since=30.0, now=T0 + 24)
+    assert tier1 == [(T0, 4.5), (T0 + 10.0, 14.5)]
+
+
+def test_query_picks_finest_covering_tier():
+    vals = {"v": 1.0}
+    store = _gauge_store(vals, tiers=[(1.0, 5), (10.0, 4)])
+    for i in range(25):
+        store.sample_once(now=T0 + i)
+    # since=4 fits in tier 0 (1s*5); since=20 does not → tier 1 (10s*4)
+    assert len(store.query("g", since=4.0, now=T0 + 24)) == 5
+    t1 = store.query("g", since=20.0, now=T0 + 24)
+    assert [p[0] for p in t1] == [T0 + 10.0]   # cutoff T0+4 < bucket start
+    # no since → coarsest tier, whole ring
+    assert store.query("g") == [(T0, 1.0), (T0 + 10.0, 1.0)]
+
+
+def test_counter_rate_and_reset_rebaseline():
+    vals = {"v": 0.0}
+    store = ts.HistoryStore(
+        snapshot_fn=lambda: {"reqs": {"type": "counter", "value": vals["v"]}},
+        tiers=[(1.0, 60)])
+    base = metrics.counter("telemetry.counter_resets").value
+    vals["v"] = 10.0
+    store.sample_once(now=T0)            # first sample: baseline only
+    assert store.query("reqs.rate") == []
+    vals["v"] = 20.0
+    store.sample_once(now=T0 + 2)        # +10 over 2s
+    assert store.query("reqs.rate") == [(T0 + 2, 5.0)]
+    vals["v"] = 3.0                      # restart: counter went backwards
+    store.sample_once(now=T0 + 3)
+    pts = store.query("reqs.rate")
+    assert pts[-1] == (T0 + 3, 3.0)      # re-baselined at 0, not -17/s
+    assert metrics.counter("telemetry.counter_resets").value == base + 1
+
+
+def test_flattened_series_per_metric_type():
+    reg = MetricsRegistry()
+    reg.gauge("q.depth").set(7.0)
+    h = reg.histogram("lat_s")
+    for i in range(100):
+        h.observe(0.01 + i * 0.001)
+    st = reg.stage("step")
+    with st.time():
+        pass
+    store = ts.HistoryStore(snapshot_fn=reg.snapshot, tiers=[(1.0, 60)])
+    store.sample_once(now=T0)
+    with st.time():
+        time.sleep(0.001)
+    store.sample_once(now=T0 + 1)
+    names = set(store.series_names())
+    assert {"q.depth", "lat_s.p50", "lat_s.p99", "lat_s.rate",
+            "step.mean_s", "step.rate"} <= names
+    assert store.query("q.depth")[-1][1] == 7.0
+    assert store.query("step.rate")[-1][1] == 1.0   # one new call over 1s
+    assert store.query("step.mean_s")[-1][1] > 0.0
+
+
+def test_max_series_overflow_dropped_and_counted():
+    snap = {f"g{i}": {"type": "gauge", "value": 1.0} for i in range(4)}
+    store = ts.HistoryStore(snapshot_fn=lambda: snap,
+                            tiers=[(1.0, 10)], max_series=2)
+    base = metrics.counter("telemetry.timeline.dropped_series").value
+    store.sample_once(now=T0)
+    store.sample_once(now=T0 + 1)        # drops counted once per series
+    assert len(store.series_names()) == 2
+    assert metrics.counter(
+        "telemetry.timeline.dropped_series").value == base + 2
+
+
+def test_timeline_doc_and_text_render():
+    vals = {"v": 0.0}
+    store = _gauge_store(vals, tiers=[(1.0, 30)])
+    for i in range(5):
+        vals["v"] = float(i)
+        store.sample_once(now=time.time() - 5 + i)
+    index = store.timeline()
+    assert index["schema"] == ts.TIMELINE_SCHEMA
+    assert index["series"] == ["g"] and index["series_count"] == 1
+    assert "  g" in ts.render_timeline_text(index)
+    doc = store.timeline("g", since=60.0)
+    pts = doc["series"]["g"]["tiers"][0]["points"]
+    assert [v for _t, v in pts] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    text = ts.render_timeline_text(doc)
+    assert text.startswith("g: last=4 min=0 max=4 n=5 [")
+    assert ts.render_timeline_text({"series": {}}).startswith(
+        "timeline: no matching series")
+
+
+def test_sampler_thread_lifecycle():
+    vals = {"v": 1.0}
+    store = _gauge_store(vals, tiers=[(1.0, 30)])
+    store.start(interval_s=0.02)
+    assert store.running
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not store.query("g"):
+        time.sleep(0.01)
+    store.stop()
+    assert not store.running
+    assert store.query("g")
+
+
+# ---------------------------------------------------------------------------
+# counter-reset guard at the fleet ingestion point
+# ---------------------------------------------------------------------------
+
+def test_reset_guard_rebases_monotonic_fields():
+    reg = MetricsRegistry()
+    guard = aggregate.ResetGuard(registry=reg)
+    s = guard.fold("0", {"c": {"type": "counter", "value": 10.0},
+                         "g": {"type": "gauge", "value": 5.0}})
+    assert s["c"]["value"] == 10.0 and s["g"]["value"] == 5.0
+    # restart: the worker's counter fell — the fleet total must not
+    s = guard.fold("0", {"c": {"type": "counter", "value": 3.0},
+                         "g": {"type": "gauge", "value": 1.0}})
+    assert s["c"]["value"] == 13.0       # banked 10 + new 3
+    assert s["g"]["value"] == 1.0        # gauges are not monotonic
+    assert reg.counter("telemetry.counter_resets").value == 1
+    # another rank is an independent baseline
+    s = guard.fold("1", {"c": {"type": "counter", "value": 2.0}})
+    assert s["c"]["value"] == 2.0
+    assert reg.counter("telemetry.counter_resets").value == 1
+
+
+def test_reset_guard_stage_multifield_and_forget():
+    reg = MetricsRegistry()
+    guard = aggregate.ResetGuard(registry=reg)
+    guard.fold("w", {"st": {"type": "stage", "count": 5,
+                            "total_sec": 2.0, "mean_sec": 0.4}})
+    s = guard.fold("w", {"st": {"type": "stage", "count": 2,
+                                "total_sec": 0.5, "mean_sec": 0.25}})
+    assert s["st"]["count"] == 7.0 and s["st"]["total_sec"] == 2.5
+    assert reg.counter("telemetry.counter_resets").value == 1  # once/metric
+    # forget(): a recycled rank id starts fresh — lower is not a reset
+    guard.forget("w")
+    s = guard.fold("w", {"st": {"type": "stage", "count": 1,
+                                "total_sec": 0.1, "mean_sec": 0.1}})
+    assert s["st"]["count"] == 1
+    assert reg.counter("telemetry.counter_resets").value == 1
+
+
+# ---------------------------------------------------------------------------
+# burn-rate SLO engine
+# ---------------------------------------------------------------------------
+
+def test_parse_duration():
+    assert slo.parse_duration("250ms") == pytest.approx(0.25)
+    assert slo.parse_duration("30s") == 30.0
+    assert slo.parse_duration("5m") == 300.0
+    assert slo.parse_duration("1h") == 3600.0
+    assert slo.parse_duration("12") == 12.0
+    with pytest.raises(SloSpecError):
+        slo.parse_duration("soon")
+
+
+def test_parse_slo_spec_superset_grammar():
+    plain, burn = slo.parse_slo_spec(
+        "a.lat_s:field=p99:max=50ms,"
+        "b.q:min=1:budget=0.02:fast=30s/14:slow=5m/6")
+    assert len(plain) == 1 and len(burn) == 1
+    assert plain[0].metric == "a.lat_s"      # old grammar parses unchanged
+    r = burn[0]
+    assert (r.metric, r.min_v, r.budget) == ("b.q", 1.0, 0.02)
+    assert (r.fast_w, r.fast_r) == (30.0, 14.0)
+    assert (r.slow_w, r.slow_r) == (300.0, 6.0)
+    assert "budget=0.02" in r.name
+    for bad in ("a:max=1:fast=30s/14",        # burn window without budget
+                "a:max=1:budget=2",           # budget outside (0, 1]
+                "a:max=1:budget=x",
+                "a:max=1:budget=0.1:fast=30s",   # window is not W/R
+                "a:max=1:budget=0.1:slow=30s/0",
+                "a:budget=0.1",               # neither max nor min
+                "a:max=1:bogus=2"):
+        with pytest.raises(SloSpecError):
+            slo.parse_slo_spec(bad)
+
+
+def _fed_store(values, now, step=1.0):
+    """A store over one gauge fed with ``values`` ending at ``now``."""
+    vals = {"v": 0.0}
+    store = ts.HistoryStore(
+        snapshot_fn=lambda: {"lat": {"type": "gauge", "value": vals["v"]}},
+        tiers=[(step, 600)])
+    t0 = now - (len(values) - 1) * step
+    for i, v in enumerate(values):
+        vals["v"] = v
+        store.sample_once(now=t0 + i * step)
+    return store
+
+
+def test_burn_rate_fast_window_fires():
+    now = time.time()
+    rule = slo.BurnRateRule("lat", None, max_v=0.1, min_v=None, budget=0.1,
+                            fast=(10.0, 5.0), slow=(60.0, 4.0))
+    store = _fed_store([0.01] * 50 + [1.0] * 11, now)
+    b = rule.check(store, now=now)
+    assert b is not None and b["severity"] == "fast"
+    assert b["burn_rate"] >= 5.0 and b["value"] == 1.0
+    assert b["window_s"] == 10.0 and b["samples"] >= 10
+
+
+def test_burn_rate_still_burning_gate_suppresses_fast():
+    """A fast burn whose latest sample recovered must not page — but a
+    sustained slow burn fires with no such gate."""
+    now = time.time()
+    rule = slo.BurnRateRule("lat", None, max_v=0.1, min_v=None, budget=0.1,
+                            fast=(10.0, 5.0), slow=(60.0, 4.0))
+    store = _fed_store([0.01] * 50 + [1.0] * 10 + [0.01], now)
+    assert rule.check(store, now=now) is None
+    # slow: half the hour-window bad → burn 5 ≥ 4, latest sample good
+    store = _fed_store([1.0] * 30 + [0.01] * 31, now)
+    b = rule.check(store, now=now)
+    assert b is not None and b["severity"] == "slow"
+
+
+def test_burn_rate_empty_window_and_under_budget():
+    now = time.time()
+    rule = slo.BurnRateRule("lat", None, max_v=0.1, min_v=None, budget=0.5,
+                            fast=(10.0, 5.0), slow=(60.0, 4.0))
+    assert rule.check(ts.HistoryStore(snapshot_fn=dict), now=now) is None
+    store = _fed_store([0.01] * 40 + [1.0], now)   # one bad sample
+    assert rule.check(store, now=now) is None
+
+
+def test_burn_rate_series_resolution():
+    store = ts.HistoryStore(
+        snapshot_fn=lambda: {"m": {"type": "histogram", "count": 3,
+                                   "p50": 0.1, "p99": 0.5, "mean": 0.2}},
+        tiers=[(1.0, 10)])
+    store.sample_once(now=T0)
+    r = slo.BurnRateRule("m", "p99", 1.0, None, budget=0.1)
+    assert r._series_name(store) == "m.p99"
+    r = slo.BurnRateRule("m", None, 1.0, None, budget=0.1)
+    assert r._series_name(store) == "m.p99"      # flattened field wins
+    r = slo.BurnRateRule("other", None, 1.0, None, budget=0.1)
+    assert r._series_name(store) == "other"      # gauge fallback
+    r = slo.BurnRateRule("m", "value", 1.0, None, budget=0.1)
+    assert r._series_name(store) == "m"
+
+
+def test_burn_rate_monitor_evaluate_once():
+    now = time.time()
+    reg = MetricsRegistry()
+    store = _fed_store([1.0] * 30, now)
+    plain, burn = slo.parse_slo_spec("lat:max=0.1:budget=0.1:fast=10s/5")
+    mon = slo.BurnRateMonitor(plain, burn, history=store, registry=reg)
+    fired = mon.evaluate_once()
+    assert len(fired) == 1 and fired[0]["severity"] == "fast"
+    assert reg.gauge("slo.active_breaches").value == 1
+    assert reg.counter("slo.breaches").value == 1
+    # recovery clears the active-breach gauge on the next pass
+    mon.history = _fed_store([0.01] * 30, now)
+    assert mon.evaluate_once() == []
+    assert reg.gauge("slo.active_breaches").value == 0
+
+
+# ---------------------------------------------------------------------------
+# critical-path analytics
+# ---------------------------------------------------------------------------
+
+def _rec(name, tid, sid, parent, ts_us, dur_us):
+    return {"kind": "span", "name": name, "trace_id": tid, "span_id": sid,
+            "parent_id": parent, "ts_us": ts_us, "dur_us": dur_us}
+
+
+def test_critical_path_is_a_complete_accounting():
+    recs = [_rec("root", "t1", "r", None, 0, 100),
+            _rec("a", "t1", "a", "r", 10, 30),
+            _rec("b", "t1", "b", "r", 50, 40)]
+    (root,) = critical_path.assemble(recs)["t1"]
+    path = critical_path.critical_path(root)
+    # chronological: root gap, a, root gap, b, root tail — self times
+    # sum exactly to the root duration
+    assert path == [("root", 10), ("a", 30), ("root", 10),
+                    ("b", 40), ("root", 10)]
+    assert sum(us for _n, us in path) == 100
+
+
+def test_evicted_parent_roots_its_subtree():
+    recs = [_rec("orphan", "t2", "x", "evicted-id", 5, 50),
+            _rec("child", "t2", "y", "x", 10, 20)]
+    roots = critical_path.assemble(recs)["t2"]
+    assert [n.name for n in roots] == ["orphan"]
+    assert [c.name for c in roots[0].children] == ["child"]
+
+
+def test_analyze_top_n_and_self_time_aggregation():
+    recs = [_rec("slow", "t1", "r1", None, 0, 1000),
+            _rec("inner", "t1", "i1", "r1", 100, 800),
+            _rec("fast", "t2", "r2", None, 0, 10)]
+    doc = critical_path.analyze(top=1, records=recs)
+    assert doc["schema"] == critical_path.ANALYZE_SCHEMA
+    assert doc["traces_seen"] == 2
+    assert [t["root"] for t in doc["top"]] == ["slow"]
+    assert doc["self_time_us"] == {"inner": 800, "slow": 200}
+    text = critical_path.render_text(doc)
+    assert "self time by span:" in text and "inner" in text
+    # top is clamped, never a crash
+    assert critical_path.analyze(top=0, records=recs)["top"]
+
+
+def test_incident_breakdown_empty_without_spans():
+    assert critical_path.incident_breakdown() == ""
+
+
+# ---------------------------------------------------------------------------
+# endpoints over real sockets
+# ---------------------------------------------------------------------------
+
+def test_timeline_and_analyze_endpoints_http():
+    vals = {"v": 0.0}
+    store = _gauge_store(vals, tiers=[(1.0, 30), (10.0, 6)])
+    t0 = time.time() - 24
+    for i in range(25):
+        vals["v"] = float(i % 7)
+        store.sample_once(now=t0 + i)
+    srv = exposition.TelemetryServer(port=0, host="127.0.0.1",
+                                     timeline_fn=store.timeline).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(f"{base}/timeline")
+        doc = json.loads(body)
+        assert code == 200 and doc["schema"] == ts.TIMELINE_SCHEMA
+        assert "g" in doc["series"]
+        code, body = _get(f"{base}/timeline?metric=g&since=20")
+        doc = json.loads(body)
+        tiers = doc["series"]["g"]["tiers"]
+        assert len(tiers) == 2 and tiers[0]["points"]
+        code, body = _get(f"{base}/timeline?metric=g&format=text")
+        assert code == 200 and body.startswith("g: last=")
+        code, body = _get(f"{base}/timeline?metric=nope")
+        assert json.loads(body)["series"] == {}
+        # /analyze over the live span ring
+        with teltrace.span("req"):
+            with teltrace.span("stepA"):
+                time.sleep(0.002)
+        code, body = _get(f"{base}/analyze?top=3")
+        doc = json.loads(body)
+        assert code == 200
+        assert doc["schema"] == critical_path.ANALYZE_SCHEMA
+        assert doc["top"] and doc["top"][0]["root"] == "req"
+        code, body = _get(f"{base}/analyze?format=text")
+        assert "self time by span:" in body
+        code, _body = _get(f"{base}/definitely_not_a_route")
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_tracker_fleet_timeline_merges_across_ranks():
+    """Rank-tagged pushes over real sockets fold into one queryable
+    fleet timeline, both tiers, and a restarted worker re-bases instead
+    of driving the merged counters backwards."""
+    from dmlc_core_tpu.parallel.tracker import RabitTracker, send_json
+
+    t = RabitTracker(num_workers=2, host_ip="127.0.0.1", telemetry_port=0)
+    t.start()
+    try:
+        assert t.telemetry is not None
+
+        def push(rank, value):
+            reg = MetricsRegistry()
+            reg.counter("reqs").add(value)
+            s = socket.create_connection((t.host_ip, t.port), timeout=5)
+            try:
+                send_json(s, {"cmd": "telemetry", "jobid": f"j{rank}",
+                              "rank": rank, "state": reg.state()})
+            finally:
+                s.close()
+
+        def wait_for(pred):
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                st = t.telemetry_states()
+                if pred(st):
+                    return
+                time.sleep(0.02)
+            raise AssertionError(f"tracker states never converged: "
+                                 f"{t.telemetry_states()}")
+
+        def folded(st, rank):
+            return st.get(rank, {}).get("reqs", {}).get("value")
+
+        resets = metrics.counter("telemetry.counter_resets").value
+        # bucket-aligned synthetic clock in the recent past, so the
+        # coarse tier closes a bucket inside the query window
+        t0 = math.floor((time.time() - 20) / 10.0) * 10.0
+        push(0, 10)
+        push(1, 30)
+        wait_for(lambda st: folded(st, "0") == 10 and folded(st, "1") == 30)
+        t.history.sample_once(now=t0)          # merged 40: baseline
+        push(0, 25)
+        push(1, 5)          # rank 1 restarted: 30 → 5 re-bases to 35
+        wait_for(lambda st: folded(st, "0") == 25 and folded(st, "1") == 35)
+        assert metrics.counter(
+            "telemetry.counter_resets").value == resets + 1
+        t.history.sample_once(now=t0 + 1)      # merged 60: +20 over 1s
+        t.history.sample_once(now=t0 + 11)     # closes the 10s bucket
+        assert t.history.query("reqs.rate", since=300.0)[0] == (t0 + 1, 20.0)
+        code, body = _get(f"http://127.0.0.1:{t.telemetry.port}"
+                          f"/timeline?metric=reqs&since=60")
+        assert code == 200
+        doc = json.loads(body)
+        tiers = doc["series"]["reqs.rate"]["tiers"]
+        assert [t0 + 1, 20.0] in tiers[0]["points"]     # fine tier
+        assert tiers[1]["points"] == [[t0, 20.0]]       # closed 10s bucket
+    finally:
+        t.stop()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance + HELP catalog
+# ---------------------------------------------------------------------------
+
+def _conformance(page):
+    """Every sample line sits under its family's single # TYPE header;
+    counter-typed families carry the _total/_count suffix."""
+    families = {}
+    current = None
+    for ln in page.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            _h, _t, fam, typ = ln.split(" ")
+            assert fam not in families, f"duplicate TYPE for {fam}"
+            families[fam] = typ
+            current = fam
+        elif ln.startswith("# HELP "):
+            continue
+        else:
+            name = ln.split("{")[0].split(" ")[0]
+            assert current is not None, f"sample before any TYPE: {ln}"
+            assert name in (current, f"{current}_sum",
+                            f"{current}_count"), ln
+    for fam, typ in families.items():
+        if typ == "counter":
+            assert fam.endswith(("_total", "_count")), \
+                f"counter family {fam} lacks a counter suffix"
+    return families
+
+
+def test_prometheus_conformance_golden():
+    reg = MetricsRegistry()
+    reg.counter("telemetry.counter_resets").add(2)
+    reg.gauge("slo.active_breaches").set(1)
+    h = reg.histogram("x.lat_s")
+    for i in range(10):
+        h.observe(i / 100)
+    reg.throughput("x.bytes").add(100)
+    with reg.stage("x.step").time():
+        pass
+    page = exposition.render_prometheus(reg.snapshot())
+    families = _conformance(page)
+    assert families["dmlc_telemetry_counter_resets_total"] == "counter"
+    assert families["dmlc_x_lat_s"] == "summary"
+    assert families["dmlc_x_step_seconds_total"] == "counter"
+    assert families["dmlc_x_step_count"] == "counter"
+    # the live process registry renders conformant too
+    _conformance(exposition.render_prometheus(metrics.snapshot()))
+
+
+def test_help_lines_source_from_doc_catalog():
+    """# HELP text, the committed inventory, and the docs metric catalog
+    are the same strings — the two-way contract of the satellite."""
+    from dmlc_core_tpu.analysis.inventory import doc_help, load
+
+    inv = load(os.path.join(REPO, "docs", "inventory.json"))
+    helps = inv["help"]
+    assert helps == doc_help(os.path.join(REPO, "docs"))
+    assert "telemetry.counter_resets" in helps
+    assert "slo.active_breaches" in helps
+    reg = MetricsRegistry()
+    reg.counter("telemetry.counter_resets").add(1)
+    reg.gauge("slo.active_breaches").set(0)
+    page = exposition.render_prometheus(reg.snapshot(), help_map=helps)
+    esc = exposition._escape_help
+    assert (f"# HELP dmlc_telemetry_counter_resets_total "
+            f"{esc(helps['telemetry.counter_resets'])}") in page
+    assert (f"# HELP dmlc_slo_active_breaches "
+            f"{esc(helps['slo.active_breaches'])}") in page
+    # HELP precedes TYPE for the family (text-format convention)
+    lines = page.splitlines()
+    for i, ln in enumerate(lines):
+        if ln.startswith("# HELP "):
+            assert lines[i + 1].startswith("# TYPE " + ln.split(" ")[2])
+    # help_map={} disables HELP emission entirely
+    assert "# HELP" not in exposition.render_prometheus(reg.snapshot(),
+                                                        help_map={})
+
+
+def test_inventory_endpoints_match_route_table():
+    """The committed inventory's endpoint set IS the exposition route
+    table — the greppable contract the endpoint-vocabulary rule gates."""
+    from dmlc_core_tpu.analysis.inventory import load
+
+    inv = load(os.path.join(REPO, "docs", "inventory.json"))
+    assert set(inv["endpoints"]) == set(exposition._ROUTES)
+
+
+# ---------------------------------------------------------------------------
+# bench trajectory history (check_regression --emit-history)
+# ---------------------------------------------------------------------------
+
+def _load_check_regression():
+    import importlib.util
+    path = os.path.join(REPO, "benchmarks", "check_regression.py")
+    spec = importlib.util.spec_from_file_location("_cr_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_regression_emit_history(tmp_path):
+    cr = _load_check_regression()
+    (tmp_path / "BENCH_demo_r01.json").write_text(json.dumps(
+        {"qps": 100.0, "latency_ms": {"p50": 2.0}, "note": 3.0}))
+    (tmp_path / "BENCH_demo_r02.json").write_text(json.dumps(
+        {"qps": 120.0, "latency_ms": {"p50": 1.5}}))
+    assert cr.main(["--dir", str(tmp_path), "--emit-history"]) == 0
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "PROGRESS.jsonl").read_text().splitlines()]
+    assert len(lines) == 1
+    rec = lines[0]
+    assert rec["schema"] == "dmlc.bench.progress/1"
+    assert (rec["family"], rec["round"], rec["status"]) == ("demo", 2,
+                                                            "pass")
+    assert rec["metrics"] == {"qps": 120.0, "latency_ms.p50": 1.5}
+    # a regressed round still gates exit 1 AND is recorded as regressed
+    (tmp_path / "BENCH_demo_r03.json").write_text(json.dumps(
+        {"qps": 60.0, "latency_ms": {"p50": 1.5}}))
+    assert cr.main(["--dir", str(tmp_path), "--emit-history"]) == 1
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "PROGRESS.jsonl").read_text().splitlines()]
+    assert lines[-1]["status"] == "regressed" and lines[-1]["round"] == 3
+    # without the flag, nothing is appended
+    n = len(lines)
+    assert cr.main(["--dir", str(tmp_path)]) == 1
+    assert len((tmp_path / "PROGRESS.jsonl").read_text()
+               .splitlines()) == n
+
+
+def test_committed_progress_history_is_valid():
+    # PROGRESS.jsonl is append-only and heterogeneous: bench-trajectory
+    # records carry the schema key, other telemetry lines don't
+    path = os.path.join(REPO, "PROGRESS.jsonl")
+    lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+    bench = [r for r in lines if r.get("schema") == "dmlc.bench.progress/1"]
+    assert bench, "no bench-trajectory records in PROGRESS.jsonl"
+    assert all({"family", "round", "artifact", "status",
+                "metrics"} <= set(r) for r in bench)
+    fams = {r["family"] for r in bench}
+    assert "timeline" in fams       # this PR's sampler-overhead family
+
+
+# ---------------------------------------------------------------------------
+# e2e chaos drill: fault → burn alert → degraded health → evidence bundle
+# ---------------------------------------------------------------------------
+
+def test_e2e_chaos_drill_latency_to_bundle(tmp_path, monkeypatch):
+    from dmlc_core_tpu.telemetry import flight
+    from dmlc_core_tpu.utils import clear_faults, fault_point, inject_faults
+
+    # own the sampler cadence: drive the store by hand, no daemon thread
+    monkeypatch.setenv("DMLC_TIMELINE", "0")
+    store = ts.HistoryStore(tiers=[(1.0, 120), (10.0, 60)])
+    monkeypatch.setattr(ts, "history", store)
+    metrics.gauge("serving.server.health").set(0)
+    flight.flight_recorder.arm(str(tmp_path))
+    try:
+        hist = metrics.histogram("drill.lat_s")
+        with inject_faults("drill.step:latency=20ms"):
+            for _ in range(6):
+                with teltrace.span("drill.request"):
+                    start = time.perf_counter()
+                    with teltrace.span("drill.step"):
+                        fault_point("drill.step")
+                    hist.observe(time.perf_counter() - start)
+        # sample the breach into both tiers: bucket-aligned synthetic
+        # clock ending ~now, far enough back to close two 10s buckets
+        base = math.floor((time.time() - 26) / 10.0) * 10.0
+        for i in range(26):
+            store.sample_once(now=base + i)
+        plain, burn = slo.parse_slo_spec(
+            "drill.lat_s:field=p99:max=5ms:budget=0.01:fast=20s/2:slow=2m/2")
+        mon = slo.BurnRateMonitor(plain, burn)
+        fired = mon.evaluate_once()
+        assert fired and fired[0]["severity"] == "fast"
+        assert fired[0]["series"] == "drill.lat_s.p99"
+        assert metrics.gauge("slo.active_breaches").value >= 1
+
+        srv = exposition.TelemetryServer(port=0, host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            code, body = _get(f"{url}/healthz")
+            assert code == 200
+            assert json.loads(body)["status"] == "degraded"
+            # the breach is visible on /timeline at BOTH tiers
+            code, body = _get(f"{url}/timeline?metric=drill.lat_s&since=2m")
+            doc = json.loads(body)
+            tiers = doc["series"]["drill.lat_s.p99"]["tiers"]
+            assert tiers[0]["points"] and tiers[1]["points"]
+            assert tiers[0]["points"][-1][1] > 0.005
+            assert tiers[1]["points"][-1][1] > 0.005
+        finally:
+            srv.stop()
+
+        # the breach dumped a bundle carrying the timeline slice and
+        # the critical-path breakdown
+        bundles = sorted(tmp_path.glob("incident-*"))
+        assert bundles, "SLO breach must dump a flight bundle"
+        bundle = bundles[-1]
+        incident = json.loads((bundle / "incident.json").read_text())
+        assert incident["files"]["timeline"] == "timeline.json"
+        assert incident["files"]["critical_path"] == "critical_path.txt"
+        tl = json.loads((bundle / "timeline.json").read_text())
+        assert tl["schema"] == ts.TIMELINE_SCHEMA
+        assert "drill.lat_s.p99" in tl["series"]
+        cp = (bundle / "critical_path.txt").read_text()
+        assert cp.strip() and "drill.step" in cp
+    finally:
+        flight.flight_recorder.disarm()
+        clear_faults()
+        metrics.gauge("slo.active_breaches").set(0)
