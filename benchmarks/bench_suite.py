@@ -1366,6 +1366,111 @@ def bench_ingest_fleet() -> dict:
     return r
 
 
+def _colocated_rate(mode: str, epochs: int = 1) -> Tuple[float, dict]:
+    """One dispatcher + ONE worker subprocess on this host, one consumer;
+    measure MB/s of the LAST epoch under a transport mode:
+
+    * ``tcp``    — lanes disabled (`DMLC_TRANSPORT_LANE=0`), the seed's
+      per-connection TCP path;
+    * ``uds``    — default negotiation: colocated consumer dials the
+      worker's UNIX lane, payload still streamed;
+    * ``fdpass`` — UNIX lane + a page-cache-backed shard: epoch 1 builds
+      the cache, epoch 2 ships one SCM_RIGHTS descriptor per shard.
+    """
+    import subprocess
+    import sys as _sys
+    from dmlc_core_tpu.pipeline.data_service import (DataServiceLoader,
+                                                     Dispatcher)
+    from dmlc_core_tpu.utils.metrics import metrics as _metrics
+
+    path = "/tmp/bench_suite.libsvm"
+    _gen_libsvm(path)
+    size_mb = os.path.getsize(path) / MB
+    overrides = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    if mode == "tcp":
+        overrides["DMLC_TRANSPORT_LANE"] = "0"
+    spec = {"uri": f"file://{path}", "fmt": "libsvm", "num_parts": 1,
+            "batch_rows": 4096, "nnz_cap": 131072}
+    if mode == "fdpass":
+        spec["cache"] = f"/tmp/bench_colocated_{os.getpid()}.pages"
+        epochs = max(2, epochs)
+    old_env = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    disp = Dispatcher(lease_ttl_s=600.0, heartbeat_timeout_s=120.0)
+    disp.start()
+    worker = subprocess.Popen(
+        [_sys.executable, "-m",
+         "dmlc_core_tpu.pipeline.data_service.worker",
+         f"127.0.0.1:{disp.port}"],
+        env={**os.environ, **overrides},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    extras = {}
+    try:
+        deadline = time.monotonic() + 120
+        while len(disp.workers_alive()) < 1:
+            if time.monotonic() > deadline:
+                raise RuntimeError("colocated worker never registered")
+            time.sleep(0.25)
+        z0 = _metrics.counter("transport.bytes_zero_copy").value
+        u0 = _metrics.counter("transport.lane.uds").value
+        rate = 0.0
+        loader = DataServiceLoader((disp.host, disp.port), spec,
+                                   connect_timeout=120.0, emit="host")
+        try:
+            for _ in range(epochs):
+                frames = 0
+                t0 = time.perf_counter()
+                for _kind, buf, _meta, _rows in loader:
+                    frames += 1
+                    loader.recycle(buf)
+                dt = time.perf_counter() - t0
+                if frames == 0:
+                    raise RuntimeError("colocated epoch had no frames")
+                rate = size_mb / dt
+        finally:
+            loader.close()
+        extras["uds_dials"] = int(
+            _metrics.counter("transport.lane.uds").value - u0)
+        extras["zero_copy_bytes"] = int(
+            _metrics.counter("transport.bytes_zero_copy").value - z0)
+        return rate, extras
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        worker.kill()
+        disp.stop()
+        if mode == "fdpass":
+            for suffix in ("", ".meta.json"):
+                try:
+                    os.remove(spec["cache"] + suffix)
+                except OSError:
+                    pass
+
+
+def bench_ingest_colocated() -> dict:
+    """Transport-lane comparison (ISSUE 15): same host, same dataset, one
+    worker feeding one consumer over (a) per-connection TCP, (b) the
+    negotiated UNIX-domain lane, (c) the lane with SCM_RIGHTS fd-passing
+    of the packed-page cache.  The lane must not lose to TCP; fd-passing
+    removes the payload bytes from the wire entirely."""
+    import bench
+    tcp, _ = _colocated_rate("tcp")
+    uds, uex = _colocated_rate("uds")
+    fdp, fex = _colocated_rate("fdpass")
+    return {"metric": "ingest_colocated_uds_mb_s", "value": round(uds, 1),
+            "unit": "MB/s",
+            "tcp_mb_s": round(tcp, 1), "uds_mb_s": round(uds, 1),
+            "fdpass_mb_s": round(fdp, 1),
+            "uds_vs_tcp_speedup": round(uds / max(tcp, 1e-9), 2),
+            "fdpass_vs_tcp_speedup": round(fdp / max(tcp, 1e-9), 2),
+            "uds_dials": uex["uds_dials"],
+            "fdpass_zero_copy_bytes": fex["zero_copy_bytes"],
+            "host_cores": bench.host_cores()}
+
+
 def bench_stream() -> dict:
     """Raw SeekStream read throughput at several buffer sizes (reference
     `test/stream_read_test.cc:16-43` instrumentation) — isolates the L3
@@ -1647,11 +1752,12 @@ def bench_elastic_reshard() -> dict:
              for i in range(nleaves)}
     nbytes = sum(a.nbytes for a in state.values())
 
-    def cohort(tmp: str, mode: str):
+    def cohort(tmp: str, mode: str, extra_env=None):
         tracker = RabitTracker(num_workers=world, host_ip="127.0.0.1")
         tracker.start()
         envd = tracker.worker_envs()
-        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                   **(extra_env or {}))
         procs = [subprocess.Popen(
             [sys.executable, "-c", _RESHARD_CHILD,
              envd["DMLC_TRACKER_URI"], str(envd["DMLC_TRACKER_PORT"]),
@@ -1678,6 +1784,13 @@ def bench_elastic_reshard() -> dict:
             reload_wall, _ = cohort(tmp, "reload")
             reshard_wall, (bytes_moved, from_peers, from_ckpt) = cohort(
                 tmp, "reshard")
+            # schedule comparison (ISSUE 15): the same recovery with the
+            # round planner disabled — one unbounded blast of fetches,
+            # the seed's behavior — against the planned default above
+            oneshot_wall, _ = cohort(
+                tmp, "reshard",
+                extra_env={"DMLC_RESHARD_PER_HOLDER": "0",
+                           "DMLC_RESHARD_MAX_BYTES": str(1 << 40)})
     finally:
         # --telemetry-out parity: fold whatever rank dumps made it to
         # disk (even from a cohort that died mid-run) into one merged
@@ -1705,6 +1818,9 @@ def bench_elastic_reshard() -> dict:
             "ckpt_reload_wall_s": round(reload_wall, 4),
             "reshard_vs_reload_speedup": round(reload_wall
                                                / max(reshard_wall, 1e-9), 2),
+            "oneshot_wall_s": round(oneshot_wall, 4),
+            "planned_vs_oneshot_speedup": round(
+                oneshot_wall / max(reshard_wall, 1e-9), 2),
             "bytes_moved": int(bytes_moved),
             "leaves_from_peers": int(from_peers),
             "leaves_from_checkpoint": int(from_ckpt)}
@@ -1867,6 +1983,8 @@ ALL = {
     "remote_ingest": (bench_remote_ingest, "remote_ingest_2workers"),
     "ingest_scale": (bench_ingest_scale, "ingest_worker_scaling"),
     "ingest_fleet": (bench_ingest_fleet, "ingest_fleet_mb_s"),
+    "ingest_colocated": (bench_ingest_colocated,
+                         "ingest_colocated_uds_mb_s"),
     "csv": (bench_csv, "csv_parse_rowblocks"),
     "cache": (bench_cache_build, "cache_build_replay"),
     "recordio": (bench_recordio, "recordio_partitioned_read"),
@@ -1904,7 +2022,7 @@ CPU_MESH = {"allreduce_mesh8", "sp_mesh8"}
 #  control plane; the per-batch pooled gather is a CPU-jitted kernel.
 HOST_ONLY = {"stream", "csv", "recordio", "cache", "higgs", "ingest_cached",
              "ingest_ragged", "ingest_autotune", "elastic_reshard",
-             "ingest_fleet", "embed_shard"}
+             "ingest_fleet", "ingest_colocated", "embed_shard"}
 # superseded in the default order (ingest_scale measures workers_2 too);
 # still runnable by explicit name
 DEFAULT_SKIP = {"remote_ingest"}

@@ -178,7 +178,7 @@ def _load_builtin_rules() -> None:
     # import for registration side effects; idempotent via the registry
     from . import (rules_endpoints, rules_env, rules_io,  # noqa: F401
                    rules_jit, rules_locks, rules_metrics, rules_spans,
-                   rules_threads)
+                   rules_threads, rules_transport)
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterable[str]:

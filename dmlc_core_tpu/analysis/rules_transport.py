@@ -1,0 +1,72 @@
+"""transport-discipline: wire I/O goes through :mod:`..transport`.
+
+Motivating change: the PR 15 transport overhaul.  Every byte that
+crosses a socket now has one choke point — ``transport.frames.send_all``
+(EINTR-safe, and the place vectored sends / compression / lane metrics
+hang off) — and every control-plane object that crosses a socket has one
+serializer, ``transport.frames.pack_obj``.  A raw ``sock.sendall`` or
+``pickle.dumps`` scattered elsewhere silently bypasses frame coalescing,
+wire-compression negotiation, and the ``transport.*`` telemetry, and
+re-opens the cross-version pickle drift this PR just fenced in.
+
+Heuristic:
+
+* flagged: any call whose dotted name ends in ``.sendall`` (socket
+  writes) or equals ``pickle.dumps`` — in any module without a
+  ``transport`` path segment;
+* clean: the :mod:`..transport` package itself (the sanctioned home of
+  both), and call sites that route through ``send_all``/``pack_obj``.
+
+Genuine non-wire uses of ``pickle.dumps`` (e.g. hashing an object's
+bytes) carry a ``# dmlclint: disable=transport-discipline`` with the
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, call_name,
+                   lint_rule)
+
+_PICKLERS = {"pickle.dumps", "cPickle.dumps"}
+
+
+def _in_transport(rel: str) -> bool:
+    parts = rel.replace("\\", "/").split("/")
+    return "transport" in parts
+
+
+@lint_rule("transport-discipline",
+           description="socket writes use transport.send_all and wire "
+                       "pickling uses transport.pack_obj — no raw "
+                       "sendall/pickle.dumps outside transport/")
+class TransportDisciplineRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        if _in_transport(mod.rel):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name:
+                continue
+            if name == "sendall" or name.endswith(".sendall"):
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno, node.col_offset,
+                    f"{name}(...) writes to the socket directly — route "
+                    f"it through transport.frames.send_all (EINTR-safe, "
+                    f"metered) or a FrameWriter, or suppress with a "
+                    f"justification"))
+            elif name in _PICKLERS:
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno, node.col_offset,
+                    f"{name}(...) serializes outside the transport choke "
+                    f"point — use transport.frames.pack_obj so wire "
+                    f"pickling stays in one audited place, or suppress "
+                    f"with a justification"))
+        return out

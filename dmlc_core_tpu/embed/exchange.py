@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..transport.frames import send_all
 from ..utils import DMLCError
 from ..utils.metrics import metrics
 from ..utils.parameter import env_int
@@ -75,9 +76,9 @@ def _recv_array(sock: socket.socket, shape: Tuple[int, ...],
 def _send_msg(sock: socket.socket, header: Dict,
               payloads: Tuple[np.ndarray, ...] = ()) -> None:
     meta = json.dumps(header).encode()
-    sock.sendall(_MAGIC + struct.pack("<I", len(meta)) + meta)
+    send_all(sock, _MAGIC + struct.pack("<I", len(meta)) + meta)
     for arr in payloads:
-        sock.sendall(memoryview(np.ascontiguousarray(arr)).cast("B"))
+        send_all(sock, memoryview(np.ascontiguousarray(arr)).cast("B"))
 
 
 def _recv_msg(sock: socket.socket) -> Dict:

@@ -336,6 +336,37 @@ class ElasticJaxMesh:
                  "(process %d/%d%s)", gen, self._coordinator(gen),
                  self.process_id, self.num_processes,
                  "" if data_plane else ", control plane only")
+        overlap = (reshard_on and data_plane and
+                   parse_lenient_bool("DMLC_RESHARD_OVERLAP") is not False)
+        reshard_box: dict = {}
+        reshard_thread = None
+        if overlap:
+            # redistribute rides the rabit control plane ONLY (brokered
+            # TCP through the tracker — never the jax backend), so its
+            # fetch rounds can run concurrently with
+            # jax.distributed.initialize and the coordination-service
+            # rendezvous hides behind the bulk transfers.  The cohort is
+            # already agreed (barriers above), so reborn/remapped ranks
+            # participate exactly as in the sequential path.  Only this
+            # thread touches ctx collectives until the join below.
+            import threading
+
+            def _run_redistribute() -> None:
+                try:
+                    reshard_box["out"] = _reshard.redistribute(
+                        self.ctx, snap, plan=handle.plan,
+                        checkpoint=handle.resolve_checkpoint(),
+                        checkpoint_step=handle.checkpoint_step,
+                        template=handle.resolve_template(),
+                        generation=gen)
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    reshard_box["err"] = e
+
+            reshard_thread = threading.Thread(
+                target=_run_redistribute, name="reshard-overlap",
+                daemon=True)
+            reshard_thread.start()
+            metrics.counter("elastic.reshard_overlaps").add(1)
         if data_plane:
             # short heartbeat/shutdown budgets (env-tunable): a dead peer
             # must be detected in seconds, and teardown of a broken
@@ -362,14 +393,21 @@ class ElasticJaxMesh:
         self.generation = gen
         self._dirty = False
         if reshard_on:
-            # redistribute AFTER the new generation is up so reborn and
-            # remapped ranks participate; peers → leaf-granular checkpoint
-            # → cohort-wide error (see reshard.redistribute)
-            restored, stats = _reshard.redistribute(
-                self.ctx, snap, plan=handle.plan,
-                checkpoint=handle.resolve_checkpoint(),
-                checkpoint_step=handle.checkpoint_step,
-                template=handle.resolve_template(), generation=gen)
+            if reshard_thread is not None:
+                reshard_thread.join()
+                if "err" in reshard_box:
+                    raise reshard_box["err"]
+                restored, stats = reshard_box["out"]
+            else:
+                # sequential path (DMLC_RESHARD_OVERLAP=0, or control
+                # plane only): redistribute after the new generation is
+                # up; peers → leaf-granular checkpoint → cohort-wide
+                # error (see reshard.redistribute)
+                restored, stats = _reshard.redistribute(
+                    self.ctx, snap, plan=handle.plan,
+                    checkpoint=handle.resolve_checkpoint(),
+                    checkpoint_step=handle.checkpoint_step,
+                    template=handle.resolve_template(), generation=gen)
             self._last_reshard = (restored, stats)
             if restored is not None and handle.set_state is not None:
                 handle.set_state(restored)

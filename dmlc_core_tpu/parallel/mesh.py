@@ -28,7 +28,7 @@ from ..utils import DMLCError, check
 
 __all__ = ["make_mesh", "parse_mesh_spec", "process_mesh_info",
            "data_parallel_mesh", "row_partition", "remap_rows",
-           "row_owners"]
+           "remap_deltas", "row_owners"]
 
 
 def parse_mesh_spec(spec: str) -> Dict[str, int]:
@@ -132,6 +132,37 @@ def remap_rows(n_rows: int, old_parts: int, new_parts: int
             if lo < hi:
                 feeds.append((old_rank, lo, hi))
         plan.append(feeds)
+    return plan
+
+
+def remap_deltas(n_rows: int, old_parts: int, new_parts: int
+                 ) -> List[List[Tuple[int, int, int]]]:
+    """Like :func:`remap_rows`, minus what each new rank already holds:
+    for each NEW rank, only the ``(old_rank, start, stop)`` ranges it must
+    FETCH — rows inside its own old range (when ``new_rank < old_parts``)
+    are dropped.  This is the input the reshard round planner wants: the
+    wire transfers, not the full feed map, so a resize that mostly keeps
+    rows in place plans mostly-empty rounds instead of re-shipping the
+    whole table."""
+    old = row_partition(n_rows, old_parts)
+    plan: List[List[Tuple[int, int, int]]] = []
+    for new_rank, feeds in enumerate(remap_rows(n_rows, old_parts,
+                                                new_parts)):
+        own_s, own_e = (old[new_rank] if new_rank < old_parts
+                        else (0, 0))
+        deltas: List[Tuple[int, int, int]] = []
+        for old_rank, lo, hi in feeds:
+            if old_rank == new_rank:
+                continue                      # already resident
+            # clip away any overlap with rows this rank already holds
+            if own_s < own_e and lo < own_e and hi > own_s:
+                if lo < own_s:
+                    deltas.append((old_rank, lo, own_s))
+                if hi > own_e:
+                    deltas.append((old_rank, own_e, hi))
+            else:
+                deltas.append((old_rank, lo, hi))
+        plan.append(deltas)
     return plan
 
 

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..utils import DMLCError, check, get_env, log_info, log_warning
 from ..utils.logging import set_log_context
+from ..transport.frames import pack_obj, send_all, unpack_obj
 from .tracker import recv_json, send_json
 
 __all__ = ["RabitContext"]
@@ -55,7 +56,7 @@ _CTRL_RANK = -2  # listener handshake sentinel: tracker control message
 
 
 def _send_blob(sock: socket.socket, payload: bytes, seq: int) -> None:
-    sock.sendall(struct.pack("<qQ", seq, len(payload)) + payload)
+    send_all(sock, struct.pack("<qQ", seq, len(payload)) + payload)
 
 
 def _recv_blob(sock: socket.socket, seq: int) -> bytes:
@@ -373,7 +374,7 @@ class RabitContext:
                 # directions behave the same
                 sock.settimeout(self.peer_recv_timeout)
                 _enable_keepalive(sock)
-                sock.sendall(struct.pack("<qq", self.rank, gen))
+                send_all(sock, struct.pack("<qq", self.rank, gen))
                 return sock
             except OSError as e:
                 last_err = e
@@ -474,7 +475,7 @@ class RabitContext:
         seq = self._seq
 
         def attempt() -> bytes:
-            payload = pickle.dumps(obj) if self.rank == root else b""
+            payload = pack_obj(obj) if self.rank == root else b""
             for child in self.children:
                 contrib = _recv_blob(self._sock_to(child), seq)
                 if contrib and not payload:
@@ -490,7 +491,7 @@ class RabitContext:
         self._seq = seq + 1
         if not payload:
             raise DMLCError(f"broadcast: no payload reached rank {self.rank}")
-        return pickle.loads(payload)
+        return unpack_obj(payload)
 
     def allgather(self, x: np.ndarray) -> np.ndarray:
         """Gather per-rank arrays to all (via allreduce of a one-hot stack)."""
@@ -515,7 +516,7 @@ class RabitContext:
         worker resumes in lock-step with survivors (rabit's ``CheckPoint``;
         state recovery itself is local-disk here — the reference's
         peer-to-peer ring recovery is downstream rabit, SURVEY §5)."""
-        payload = pickle.dumps({"seq": self._seq, "state": state,
+        payload = pack_obj({"seq": self._seq, "state": state,
                                 "version": getattr(self, "_version", 0) + 1})
         self._version = getattr(self, "_version", 0) + 1
         tmp = self._ckpt_path() + ".tmp"
@@ -529,7 +530,7 @@ class RabitContext:
         None when no checkpoint exists (fresh start)."""
         try:
             with open(self._ckpt_path(), "rb") as f:
-                saved = pickle.loads(f.read())
+                saved = unpack_obj(f.read())
         except (OSError, pickle.UnpicklingError):
             return None
         self._seq = saved["seq"]

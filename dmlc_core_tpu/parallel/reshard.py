@@ -58,6 +58,8 @@ import numpy as np
 
 from ..telemetry import flight as telflight
 from ..telemetry import trace as teltrace
+from ..transport import plan as transport_plan
+from ..transport.frames import send_all as _send_all
 from ..utils import DMLCError, log_info, log_warning
 from ..utils.checkpoint import (CheckpointManager, flatten_tree,
                                 unflatten_like)
@@ -81,6 +83,21 @@ def _rows(shape: Tuple[int, ...]) -> int:
 
 def _timeout_s() -> float:
     return float(env_int("DMLC_RESHARD_TIMEOUT_S", 60, minimum=1))
+
+
+def _apply_sock_buf(sock: socket.socket) -> None:
+    """Honor ``DMLC_SOCK_BUF_KB`` (lenient env_int, 0 = kernel default):
+    both directions sized, on the transfer server's listener (accepted
+    sockets inherit) and on every fetch dial — reshard moves tens of MB
+    per connection, where default buffers leave WAN bandwidth idle."""
+    kb = env_int("DMLC_SOCK_BUF_KB", 0, minimum=0)
+    if kb <= 0:
+        return
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, kb * 1024)
+        except OSError:
+            pass    # the kernel clamps or refuses; either is fine
 
 
 # ---------------------------------------------------------------------------
@@ -285,6 +302,7 @@ class _XferServer:
         self._snap = snap
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        _apply_sock_buf(self._sock)
         self._sock.bind(("", 0))
         self._sock.listen(16)
         self._sock.settimeout(0.2)
@@ -325,16 +343,16 @@ class _XferServer:
                         block = arr[start - s:stop - s]
                         break
                 if block is None:
-                    conn.sendall(b"\x00")
+                    _send_all(conn, b"\x00")
                     return
                 block = np.ascontiguousarray(block)
                 meta = json.dumps({"dtype": str(block.dtype),
                                    "shape": list(block.shape)}).encode()
-                conn.sendall(b"\x01" + struct.pack("<I", len(meta)) + meta
-                             + struct.pack("<Q", block.nbytes))
-                # sendall straight from the snapshot block's buffer — a
+                _send_all(conn, b"\x01" + struct.pack("<I", len(meta))
+                          + meta + struct.pack("<Q", block.nbytes))
+                # send straight from the snapshot block's buffer — a
                 # .tobytes() here would copy each served shard once more
-                conn.sendall(memoryview(block).cast("B"))
+                _send_all(conn, memoryview(block).cast("B"))
         except (OSError, ValueError, KeyError, DMLCError):
             pass        # a broken fetcher retries against another holder
 
@@ -366,9 +384,10 @@ def _fetch(addr: Tuple[str, int], path: str, start: int, stop: int
     timeout = _timeout_s()
     with socket.create_connection(addr, timeout=timeout) as s:
         s.settimeout(timeout)
+        _apply_sock_buf(s)
         req = json.dumps({"path": path, "start": start,
                           "stop": stop}).encode()
-        s.sendall(_MAGIC + struct.pack("<I", len(req)) + req)
+        _send_all(s, _MAGIC + struct.pack("<I", len(req)) + req)
         status = _recv_exact(s, 1)
         if status != b"\x01":
             raise DMLCError(f"peer {addr} does not hold {path!r} "
@@ -561,15 +580,54 @@ def redistribute(ctx, snap: Optional[HostSnapshot], *,
                 return idx, s, e, None
 
             if tasks:
+                # planned collective schedule (arxiv 2112.01075): group
+                # the fetches into holder-balanced rounds whose in-flight
+                # bytes stay under DMLC_RESHARD_MAX_BYTES — a reborn rank
+                # no longer pulls the whole state at once, and no single
+                # survivor serves every fetcher in the same instant.
+                # Deterministic planning; execution order cannot change
+                # the assembled result (results key on (idx, start)).
+                def _row_bytes(path: str) -> int:
+                    gshape, dt = schema[path]
+                    per = int(np.dtype(dt).itemsize)
+                    for d in gshape[1:]:
+                        per *= int(d)
+                    return per
+
+                budget = env_int("DMLC_RESHARD_MAX_BYTES",
+                                 _DEFAULT_BUDGET, minimum=0)
+                transfers = [
+                    transport_plan.Transfer(
+                        planned[idx][0], s, e, owner, alts,
+                        nbytes=max(1, e - s) * _row_bytes(planned[idx][0]),
+                        tag=task)
+                    for task in tasks
+                    for (idx, s, e, owner, alts) in (task,)]
+                rounds = transport_plan.plan_rounds(
+                    transfers, max_bytes=budget if budget > 0 else None,
+                    per_holder=env_int("DMLC_RESHARD_PER_HOLDER", 2,
+                                       minimum=0))
+                metrics.gauge("reshard.rounds").set(float(len(rounds)))
                 pool = min(len(tasks),
                            env_int("DMLC_RESHARD_FETCH_THREADS", 8,
                                    minimum=1))
+                results = []
                 if pool == 1:
-                    results = [run_fetch(t) for t in tasks]
+                    for rno, rnd in enumerate(rounds):
+                        teltrace.add_event(
+                            "reshard.round", round=rno, fetches=len(rnd),
+                            bytes=sum(t.nbytes for t in rnd))
+                        results.extend(run_fetch(t.tag) for t in rnd)
                 else:
                     from concurrent.futures import ThreadPoolExecutor
                     with ThreadPoolExecutor(pool) as ex:
-                        results = list(ex.map(run_fetch, tasks))
+                        for rno, rnd in enumerate(rounds):
+                            teltrace.add_event(
+                                "reshard.round", round=rno,
+                                fetches=len(rnd),
+                                bytes=sum(t.nbytes for t in rnd))
+                            results.extend(
+                                ex.map(run_fetch, [t.tag for t in rnd]))
                 for idx, s, e, got in results:
                     if got is None:
                         planned[idx][2].append((s, e))

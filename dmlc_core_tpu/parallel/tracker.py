@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..transport.frames import send_all
 from ..telemetry.aggregate import ResetGuard, merge_states, render_fleet
 from ..telemetry.anomaly import StragglerBoard
 from ..telemetry.exposition import TelemetryServer
@@ -88,7 +89,7 @@ def compute_ring(world: int) -> List[int]:
 
 def send_json(sock: socket.socket, obj: dict) -> None:
     data = (json.dumps(obj) + "\n").encode()
-    sock.sendall(data)
+    send_all(sock, data)
 
 
 def recv_json(sock_file) -> Optional[dict]:
@@ -480,7 +481,7 @@ class RabitTracker:
         for attempt in range(3):
             try:
                 with socket.create_connection(addr, timeout=10.0) as s:
-                    s.sendall(struct.pack("<q", -2))
+                    send_all(s, struct.pack("<q", -2))
                     send_json(s, reset)
                 return
             except OSError as e:

@@ -28,18 +28,23 @@ when **all** workers are lost with parts still owed.
 
 from __future__ import annotations
 
+import json
+import os
 import socket
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ...telemetry import trace as teltrace
+from ...transport import frames as _wire
+from ...transport import lane as _lane
 from ...utils import check
 from ...utils.faults import fault_point
 from ...utils.parameter import get_env
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
 from ...utils.retry import CircuitBreaker, CircuitOpen, RetryPolicy
+from .. import page_cache
 from ..device_loader import _BufPool, _fused_words_meta, _put_fused_buf
 from ..ingest_service import _FRAME, _NO_ROWS, _recv_exact
 from .dispatcher import dispatcher_rpc
@@ -91,6 +96,10 @@ class DataServiceLoader:
             retryable=lambda e: (isinstance(e, (OSError, DMLCError))
                                  and not isinstance(e, CircuitOpen)))
         self._breakers: Dict[str, CircuitBreaker] = {}
+        # jobids whose UNIX lane failed mid-stream: every later dial to
+        # them (including lease replays) rides TCP — a flapping lane
+        # must not cost a redial per frame
+        self._lane_down: set = set()
         # fleet-console feedback loop: rate-limited best-effort backlog
         # pushes to the dispatcher (<= 0 disables)
         self._stats_interval = float(
@@ -102,8 +111,8 @@ class DataServiceLoader:
     def _start_epoch(self) -> dict:
         ep = dispatcher_rpc(self.dispatcher,
                             {"cmd": "start_epoch", "key": self.key})
-        workers = dispatcher_rpc(self.dispatcher,
-                                 {"cmd": "list_workers"})["workers"]
+        listing = dispatcher_rpc(self.dispatcher, {"cmd": "list_workers"})
+        workers = listing["workers"]
         if not workers:
             raise DMLCError("data service: no live workers registered "
                             "with the dispatcher")
@@ -115,6 +124,8 @@ class DataServiceLoader:
             # exactly-once ledger: frames delivered per part, and the
             # parts whose shard-end accounting has closed
             "got": {}, "done": set(),
+            # zero-copy lane adverts (old dispatchers return none)
+            "lanes": listing.get("lanes") or {},
             # the consumer's ambient trace context, captured here so the
             # reader threads (fresh contextvars) can re-activate it —
             # this is the link that makes one trace span all three tiers
@@ -157,7 +168,7 @@ class DataServiceLoader:
                 with teltrace.activate(state.get("trace")), \
                         teltrace.span("data_service.client.stream",
                                       worker=jobid, epoch=state["epoch"]):
-                    breaker.call(self._stream_once, state, addr, cap)
+                    breaker.call(self._stream_once, state, jobid, addr, cap)
             finally:
                 self._publish_breaker_gauges()
 
@@ -192,20 +203,58 @@ class DataServiceLoader:
                 f"data_service.client.breaker_open.{jobid}").set(is_open)
         metrics.gauge("data_service.client.breakers_open").set(float(n_open))
 
-    def _stream_once(self, state: dict, addr: Tuple[str, int],
+    def _dial(self, state: dict, jobid: str, addr: Tuple[str, int]
+              ) -> Tuple[socket.socket, str]:
+        """Connect to a worker over the best lane: the advertised UNIX
+        socket when the host token matches (and the lane hasn't failed
+        for this jobid before), else TCP."""
+        li = state.get("lanes", {}).get(jobid)
+        if (li and _lane.lane_enabled() and jobid not in self._lane_down
+                and li.get("hostid") == _lane.host_token()
+                and os.path.exists(str(li.get("uds", "")))):
+            try:
+                sock = _lane.connect_lane(str(li["uds"]),
+                                          timeout=self.connect_timeout)
+                metrics.counter("transport.lane.uds").add(1)
+                return sock, "uds"
+            except OSError as e:
+                # dial failure is a lane failure: fall back now and for
+                # every later attempt against this jobid
+                self._lane_down.add(jobid)
+                metrics.counter("transport.lane_fallbacks").add(1)
+                log_info("data service: UNIX lane to %s failed (%r), "
+                         "using TCP", jobid, e)
+        sock = socket.create_connection(addr, timeout=self.connect_timeout)
+        sock.settimeout(self.connect_timeout)
+        metrics.counter("transport.lane.tcp").add(1)
+        return sock, "tcp"
+
+    def _stream_once(self, state: dict, jobid: str, addr: Tuple[str, int],
                      cap: int) -> None:
         """One connection to one worker: request the stream, then frames
         until stream-end.  Raises on a broken stream (after reporting the
-        in-flight lease so a survivor replays it promptly)."""
+        in-flight lease so a survivor replays it promptly).  A failure on
+        a UNIX lane additionally marks the lane down, so the retrying
+        redial lands on TCP — chaos-injected lane faults degrade, never
+        duplicate (the frame ledger is lane-agnostic)."""
         cv = state["cv"]
-        sock = socket.create_connection(addr, timeout=self.connect_timeout)
-        sock.settimeout(self.connect_timeout)
+        sock, lane = self._dial(state, jobid, addr)
         with cv:
             if state["stop"]:
                 sock.close()
                 return
             state["socks"].append(sock)
         cur: Optional[dict] = None      # in-flight shard on THIS stream
+        # SCM_RIGHTS stash: descriptors ride recvmsg ancillary data on
+        # fd-passing lanes, collected while reading ordinary headers
+        fds: List[int] = [] if lane == "uds" else None  # type: ignore
+        # one preallocated header buffer for the whole stream — the hot
+        # loop recv_into's it per frame instead of allocating each time
+        hdr_buf = bytearray(_FRAME.size)
+        hdr_view = memoryview(hdr_buf)
+        m_reuse = metrics.counter("transport.buffer_reuse")
+        decomp = None                   # negotiated decompressor
+        first = True
         try:
             with sock:
                 from ...parallel.tracker import send_json
@@ -213,15 +262,47 @@ class DataServiceLoader:
                 # wire's 'untraced' marker (the worker roots its own
                 # local trace in that case)
                 tid, sid = teltrace.wire_ids()
-                send_json(sock, {"key": self.key, "epoch": state["epoch"],
-                                 "trace_id": tid, "parent_span": sid})
+                send_json(sock, {
+                    "key": self.key, "epoch": state["epoch"],
+                    "trace_id": tid, "parent_span": sid,
+                    # negotiation offer; a legacy worker ignores this key
+                    # and streams the seed framing (no CTRL_TRANSPORT
+                    # reply), which the frame loop accepts as-is
+                    "transport": {
+                        "codecs": _wire.available_codecs(),
+                        "want": _wire.requested_codec(),
+                        "lane": lane,
+                        "fdpass": lane == "uds" and _lane.fd_passing_ok()}})
+                teltrace.add_event("transport.lane", lane=lane,
+                                   worker=jobid)
                 while True:
                     fault_point("data_service.recv")
-                    hdr = _recv_exact(sock, _FRAME.size)
-                    if hdr is None:
-                        raise DMLCError(
-                            f"data-service worker {addr} closed mid-stream")
-                    meta, words, rows = _FRAME.unpack(hdr)
+                    if lane == "uds":
+                        # chaos probe: a mid-epoch lane failure lands
+                        # here; the raised fault breaks THIS stream and
+                        # the redial falls back to TCP
+                        fault_point("transport.lane")
+                    _lane.recv_exact_into(sock, hdr_view, fds)
+                    if first:
+                        first = False
+                    else:
+                        m_reuse.add(1)
+                    meta, words, rows = _FRAME.unpack(hdr_buf)
+                    if words == _wire.CTRL_TRANSPORT:
+                        # negotiation reply (always the stream's first
+                        # frame when present): rows = JSON body length
+                        body = bytearray(int(rows))
+                        _lane.recv_exact_into(sock, memoryview(body), fds)
+                        neg = json.loads(bytes(body))
+                        if neg.get("compress"):
+                            codec = _wire.get_codec(str(neg["compress"]))
+                            if codec is None:
+                                raise DMLCError(
+                                    f"worker negotiated codec "
+                                    f"{neg['compress']!r} this consumer "
+                                    f"cannot decode")
+                            decomp = codec[1]
+                        continue
                     if words == 0:
                         return                       # worker's stream end
                     if words == CTRL_SHARD_BEGIN:
@@ -236,9 +317,19 @@ class DataServiceLoader:
                         raise DMLCError(
                             f"data-service worker {addr} sent a data "
                             f"frame outside a shard")
+                    if words == _wire.CTRL_FDPASS:
+                        self._accept_fd_shard(state, cur, sock, int(rows),
+                                              fds, cap)
+                        continue
                     self._accept_frame(state, cur, sock, meta, words,
-                                       rows, cap)
+                                       rows, cap, decomp=decomp, fds=fds)
         except BaseException:
+            stopped = False
+            with cv:
+                stopped = state["stop"]
+            if lane == "uds" and not stopped:
+                self._lane_down.add(jobid)
+                metrics.counter("transport.lane_fallbacks").add(1)
             if cur is not None:
                 # a survivor should replay this lease NOW, not after the
                 # TTL: report what we saw break (best-effort; the TTL
@@ -254,14 +345,19 @@ class DataServiceLoader:
                 except OSError:
                     pass
             raise
+        finally:
+            for fd in (fds or ()):      # unclaimed passed descriptors
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
 
     def _accept_frame(self, state: dict, cur: dict, sock, meta: int,
-                      words: int, rows: int, cap: int) -> None:
+                      words: int, rows: int, cap: int, *,
+                      decomp=None, fds: Optional[List[int]] = None) -> None:
         """Receive one data frame; deliver it exactly once.  Frames of a
         replayed shard that were already delivered under an earlier lease
         are received and dropped — determinism makes the drop safe."""
-        cv = state["cv"]
-        part = cur["part"]
         expected = _fused_words_meta(self.batch_rows, int(meta))
         if expected != words:
             raise DMLCError(
@@ -270,12 +366,78 @@ class DataServiceLoader:
                 f"{expected} — consumer and spec batch_rows differ")
         buf = self._pool.get(words)
         view = memoryview(buf)[:words].cast("B")
-        got = 0
-        while got < len(view):
-            r = sock.recv_into(view[got:], len(view) - got)
-            if not r:
-                raise DMLCError("data-service worker died mid-frame")
-            got += r
+        if decomp is not None:
+            # negotiated-compression framing: trailing clen u32; 0 means
+            # the frame shipped raw (incompressible)
+            clen_b = bytearray(_wire.CLEN.size)
+            _lane.recv_exact_into(sock, memoryview(clen_b), fds)
+            (clen,) = _wire.CLEN.unpack(clen_b)
+            if clen:
+                comp = bytearray(clen)
+                _lane.recv_exact_into(sock, memoryview(comp), fds)
+                raw = decomp(bytes(comp))
+                if len(raw) != len(view):
+                    raise DMLCError(
+                        f"compressed frame inflated to {len(raw)} bytes, "
+                        f"header said {len(view)}")
+                view[:] = raw
+            else:
+                _lane.recv_exact_into(sock, view, fds)
+        else:
+            _lane.recv_exact_into(sock, view, fds)
+        out = buf[:words] if len(buf) != words else buf
+        self._deliver(state, cur, out, meta,
+                      None if rows == _NO_ROWS else rows, cap, buf)
+
+    def _accept_fd_shard(self, state: dict, cur: dict, sock,
+                         manifest_len: int, fds: Optional[List[int]],
+                         cap: int) -> None:
+        """A shard delivered as a passed page-cache descriptor: map it,
+        validate the framing, and walk the pages through the SAME
+        exactly-once ledger as streamed frames (page order is the frame
+        order, so a replay over either lane dedups correctly).  The
+        payload bytes never crossed the socket — every delivered view
+        counts toward ``transport.bytes_zero_copy``."""
+        body = bytearray(manifest_len)
+        _lane.recv_exact_into(sock, memoryview(body), fds)
+        manifest = json.loads(bytes(body))
+        if not fds:
+            raise DMLCError("fd-passed shard arrived without a descriptor "
+                            "(ancillary data lost)")
+        fd = fds.pop(0)
+        try:
+            reader = page_cache.PageCacheReader(
+                str(manifest.get("path", "<fd>")),
+                expected_words=lambda m: _fused_words_meta(
+                    self.batch_rows, int(m)),
+                readahead=0, fileno=fd)
+        except (OSError, page_cache.PageCacheError) as e:
+            raise DMLCError(f"fd-passed page file rejected: {e}") from e
+        finally:
+            # the mmap holds its own reference; the raw fd is done either
+            # way (reject → the worker's stream breaks → lease replays)
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        m_zero = metrics.counter("transport.bytes_zero_copy")
+        try:
+            for meta, rows, view in reader.pages():
+                if self._deliver(state, cur, view, meta, rows, cap, view):
+                    m_zero.add(view.nbytes)
+        finally:
+            # tolerant close: delivered views keep the map alive until
+            # the consumer recycles them (the pool refuses the read-only
+            # buffers, so they simply drop when the trainer is done)
+            reader.close()
+
+    def _deliver(self, state: dict, cur: dict, out, meta: int,
+                 rows: Optional[int], cap: int, buf) -> bool:
+        """Ledger + backpressure + hand-off of one frame.  Returns True
+        iff the frame was queued (False: duplicate of a replayed lease,
+        or the epoch is stopping)."""
+        cv = state["cv"]
+        part = cur["part"]
         idx = cur["idx"]
         cur["idx"] += 1
         with cv:
@@ -283,18 +445,17 @@ class DataServiceLoader:
                 # replayed prefix of a re-granted lease: already delivered
                 self._pool.put(buf)
                 metrics.counter("data_service.client.dup_frames").add(1)
-                return
+                return False
             state["got"][part] = idx + 1
             while len(state["out"]) >= cap and not state["stop"]:
                 cv.wait(timeout=1.0)
             if state["stop"]:
                 self._pool.put(buf)
-                return
-            state["out"].append(
-                (buf[:words] if len(buf) != words else buf, meta,
-                 None if rows == _NO_ROWS else rows, buf))
+                return False
+            state["out"].append((out, int(meta), rows, buf))
             metrics.counter("data_service.client.frames").add(1)
             cv.notify_all()
+            return True
 
     def _close_shard(self, state: dict, part: int, total: int) -> None:
         cv = state["cv"]

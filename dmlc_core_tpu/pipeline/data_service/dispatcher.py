@@ -153,6 +153,9 @@ class Dispatcher:
         self._lock = threading.Lock()
         self._datasets: Dict[str, _Dataset] = {}
         self._workers: Dict[str, Tuple[str, int]] = {}  # jobid → data addr
+        # jobid → {"uds": path, "hostid": token}: zero-copy lane adverts
+        # from register_worker, echoed to consumers via list_workers
+        self._lanes: Dict[str, dict] = {}
         # lease-lifecycle ledger: every transition as a structured event
         # in a bounded ring — /leases serves it, the flight recorder
         # snapshots it into incident bundles
@@ -453,8 +456,14 @@ class Dispatcher:
                     "ts": time.monotonic()}
             return {"ok": True}
         if cmd == "list_workers":
-            return {"workers": {j: list(a) for j, a
-                                in self.workers_alive().items()}}
+            alive = self.workers_alive()
+            # "lanes" is a SEPARATE key so the {jobid: [host, port]}
+            # shape old clients parse is untouched (they ignore lanes)
+            with self._lock:
+                lanes = {j: dict(self._lanes[j]) for j in alive
+                         if j in self._lanes}
+            return {"workers": {j: list(a) for j, a in alive.items()},
+                    "lanes": lanes}
         if cmd == "register_dataset":
             return self._cmd_register_dataset(msg)
         if cmd == "start_epoch":
@@ -474,6 +483,11 @@ class Dispatcher:
         addr = (str(msg["host"]), int(msg["port"]))
         with self._lock:
             self._workers[jobid] = addr
+            if msg.get("uds"):
+                self._lanes[jobid] = {"uds": str(msg["uds"]),
+                                      "hostid": str(msg.get("hostid", ""))}
+            else:
+                self._lanes.pop(jobid, None)
         self._beat(jobid)
         log_info("dispatcher: worker %r registered at %s:%d", jobid, *addr)
         return {"ok": True}
@@ -482,6 +496,7 @@ class Dispatcher:
         jobid = str(msg["jobid"])
         with self._lock:
             self._workers.pop(jobid, None)
+            self._lanes.pop(jobid, None)
             self._worker_states.pop(jobid, None)
             self._last_beat.pop(jobid, None)
             # a clean departure re-queues whatever it still held — no need

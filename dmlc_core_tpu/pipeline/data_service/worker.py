@@ -35,11 +35,14 @@ from typing import Optional, Tuple
 
 from ...parallel.tracker import recv_json, send_json
 from ...telemetry import trace as teltrace
+from ...transport import frames as _wire
+from ...transport import lane as _lane
 from ...utils.faults import FaultInjected, fault_point
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
 from ...utils.parameter import get_env
 from ...utils.retry import RetryPolicy
+from .. import page_cache
 from ..ingest_service import _FRAME, _send_all, stream_epoch_frames
 from .dispatcher import dispatcher_rpc
 
@@ -100,6 +103,13 @@ class DataServiceWorker:
         self._srv.bind((host, port))
         self._srv.listen(16)
         self.host, self.port = self._srv.getsockname()[:2]
+        # zero-copy local lane: a second, UNIX-domain listener advertised
+        # at registration; colocated consumers (matching host token) dial
+        # it instead of TCP.  Bind failure is not an error — the worker
+        # simply stays TCP-only.
+        self._uds_srv = _lane.bind_lane(self.jobid)
+        self.uds_path = (_lane.lane_path(self.jobid)
+                         if self._uds_srv is not None else None)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -109,14 +119,23 @@ class DataServiceWorker:
     def start(self) -> "DataServiceWorker":
         # registration retries ride the standard policy: a worker racing
         # the dispatcher's bind must dial again, not die
+        reg = {"cmd": "register_worker", "jobid": self.jobid,
+               "host": self.host, "port": self.port}
+        if self.uds_path is not None:
+            # lane negotiation happens HERE, at registration: the
+            # dispatcher echoes these back under list_workers "lanes";
+            # old dispatchers ignore the extra keys (wire-compatible)
+            reg["uds"] = self.uds_path
+            reg["hostid"] = _lane.host_token()
         RetryPolicy(max_attempts=10, base_delay_s=0.1, max_delay_s=2.0,
                     retryable=lambda e: isinstance(e, OSError),
                     name="data_service.register").call(
-            dispatcher_rpc, self.dispatcher,
-            {"cmd": "register_worker", "jobid": self.jobid,
-             "host": self.host, "port": self.port})
-        for target, name in ((self._accept_loop, "dsw-accept"),
-                             (self._heartbeat_loop, "dsw-heartbeat")):
+            dispatcher_rpc, self.dispatcher, reg)
+        loops = [(self._accept_loop, "dsw-accept"),
+                 (self._heartbeat_loop, "dsw-heartbeat")]
+        if self._uds_srv is not None:
+            loops.append((self._accept_loop_uds, "dsw-accept-uds"))
+        for target, name in loops:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -139,14 +158,22 @@ class DataServiceWorker:
         """Hard death (chaos path): close everything, tell no one."""
         self._stop_ev.set()
         # shutdown() wakes the accept loop; close() alone leaves it blocked
-        try:
-            self._srv.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        for srv in (self._srv, self._uds_srv):
+            if srv is None:
+                continue
+            try:
+                srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                srv.close()
+            except OSError:
+                pass
+        if self.uds_path is not None:
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
         with self._conn_lock:
             conns, self._conns = list(self._conns), []
         for c in conns:
@@ -181,24 +208,46 @@ class DataServiceWorker:
 
     # -- data plane ------------------------------------------------------
     def _accept_loop(self) -> None:
+        self._accept_on(self._srv, uds=False)
+
+    def _accept_loop_uds(self) -> None:
+        self._accept_on(self._uds_srv, uds=True)
+
+    def _accept_on(self, srv: socket.socket, *, uds: bool) -> None:
         while not self._stop_ev.is_set():
             try:
-                conn, addr = self._srv.accept()
+                conn, addr = srv.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if not uds:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conn_lock:
                 self._conns.append(conn)
-            threading.Thread(target=self._serve_conn, args=(conn, addr),
-                             daemon=True).start()
+            threading.Thread(target=self._serve_conn,
+                             args=(conn, addr, uds), daemon=True).start()
 
-    def _serve_conn(self, conn: socket.socket, addr) -> None:
+    def _serve_conn(self, conn: socket.socket, addr,
+                    uds: bool = False) -> None:
         try:
             conn.settimeout(30.0)
             req = recv_json(conn.makefile("r"))
             if req is None:
                 return
             key = str(req["key"])
+            # transport negotiation: only a hello carrying a "transport"
+            # dict gets the CTRL_TRANSPORT reply — a legacy consumer sends
+            # none and is served the seed framing verbatim
+            tp = req.get("transport")
+            neg = None
+            if isinstance(tp, dict):
+                neg = _wire.negotiate_reply(
+                    tp, uds=uds, fdpass_ok=_lane.fd_passing_ok())
+                body = json.dumps(neg).encode()
+                writer = _wire.FrameWriter(conn, compress=neg["compress"])
+                writer.control(0, _wire.CTRL_TRANSPORT, len(body), body)
+                writer.flush()
+            else:
+                writer = _wire.FrameWriter(conn)
             # a traced consumer packs its ids into the stream request; a
             # zero/absent id means untraced → this span roots its own
             # local trace (never invents a cross-tier link)
@@ -206,8 +255,12 @@ class DataServiceWorker:
                                      req.get("parent_span"))
             with teltrace.activate(ctx), \
                     teltrace.span("data_service.serve_stream", key=key,
-                                  worker=self.jobid, peer=str(addr)) as sp:
-                sp.attrs["shards"] = self._serve_stream(conn, key)
+                                  worker=self.jobid, peer=str(addr),
+                                  lane="uds" if uds else "tcp",
+                                  compress=neg["compress"] if neg else None
+                                  ) as sp:
+                sp.attrs["shards"] = self._serve_stream(
+                    conn, key, writer, neg)
         except FaultInjected as e:
             # chaos schedule says this worker dies NOW: no lease cleanup,
             # no deregistration — the fleet must absorb a real crash
@@ -225,7 +278,9 @@ class DataServiceWorker:
                 if conn in self._conns:
                     self._conns.remove(conn)
 
-    def _serve_stream(self, conn: socket.socket, key: str) -> int:
+    def _serve_stream(self, conn: socket.socket, key: str,
+                      writer: _wire.FrameWriter,
+                      neg: Optional[dict] = None) -> int:
         """Pull leases for ``key`` until the dispatcher says the epoch is
         done; serve each over ``conn``.  Returns shards served."""
         shards = 0
@@ -234,7 +289,8 @@ class DataServiceWorker:
                 self.dispatcher,
                 {"cmd": "next_lease", "key": key, "jobid": self.jobid})
             if reply.get("status") == "done":
-                _send_all(conn, _FRAME.pack(0, 0, 0))   # stream end
+                writer.control(0, 0, 0)                 # stream end
+                writer.flush()
                 return shards
             lease = reply.get("lease")
             if lease is None:
@@ -242,12 +298,34 @@ class DataServiceWorker:
                 # re-granted lease can land here, poll again shortly
                 time.sleep(self.lease_poll_s)
                 continue
-            self._serve_shard(conn, key, lease)
+            self._serve_shard(conn, key, lease, writer, neg)
             shards += 1
         return shards
 
-    def _serve_shard(self, conn: socket.socket, key: str,
-                     lease: dict) -> None:
+    def _serve_fd_shard(self, conn: socket.socket, part: int,
+                        lease_epoch: int, page_file: str) -> int:
+        """Ship a whole shard as one ``SCM_RIGHTS``-passed page file:
+        begin/fdpass/end frames plus the descriptor ride a single
+        ``sendmsg``, payload bytes never touch the socket.  Returns the
+        page count (= the shard's frame count in the consumer ledger)."""
+        reader = page_cache.PageCacheReader(page_file, readahead=0)
+        npages = reader.npages
+        reader.close()
+        with open(page_file, "rb") as f:
+            manifest = json.dumps({"pages": npages,
+                                   "size": os.fstat(f.fileno()).st_size,
+                                   "path": page_file}).encode()
+            data = (_FRAME.pack(part, CTRL_SHARD_BEGIN, lease_epoch)
+                    + _FRAME.pack(part, _wire.CTRL_FDPASS, len(manifest))
+                    + manifest
+                    + _FRAME.pack(part, CTRL_SHARD_END, npages))
+            _lane.send_with_fds(conn, data, [f.fileno()])
+        metrics.counter("data_service.worker.fdpass_shards").add(1)
+        return npages
+
+    def _serve_shard(self, conn: socket.socket, key: str, lease: dict,
+                     writer: _wire.FrameWriter,
+                     neg: Optional[dict] = None) -> None:
         from ...data import create_parser
         from ..device_loader import DeviceLoader
         part = int(lease["part"])
@@ -274,12 +352,25 @@ class DataServiceWorker:
                     id_mod=int(spec.get("id_mod", 0)),
                     wire_compact=spec.get("wire_compact", "auto"),
                     emit="host", cache=spec.get("cache", "auto"))
-                _send_all(conn, _FRAME.pack(part, CTRL_SHARD_BEGIN,
-                                            lease_epoch))
-                frames, sent = stream_epoch_frames(conn, loader,
-                                                   batch_rows, eos=False)
-                _send_all(conn, _FRAME.pack(part, CTRL_SHARD_END, frames))
-                sp.attrs.update(frames=frames, bytes=sent)
+                # fd-passing lane: when negotiated AND a validated page
+                # cache backs this shard, the descriptor crosses instead
+                # of the bytes; otherwise fall through to streaming
+                page_file = (loader.cached_page_file()
+                             if neg and neg.get("fdpass") else None)
+                if page_file is not None:
+                    frames = self._serve_fd_shard(conn, part, lease_epoch,
+                                                  page_file)
+                    sent = 0
+                    sp.attrs.update(frames=frames, bytes=0, fdpass=True)
+                else:
+                    # shard-begin is QUEUED, not sent: it coalesces into
+                    # the same sendmsg as the first data frame
+                    writer.control(part, CTRL_SHARD_BEGIN, lease_epoch)
+                    frames, sent = stream_epoch_frames(
+                        conn, loader, batch_rows, eos=False, writer=writer)
+                    writer.control(part, CTRL_SHARD_END, frames)
+                    writer.flush()
+                    sp.attrs.update(frames=frames, bytes=sent)
             metrics.counter("data_service.worker.shards").add(1)
             metrics.throughput("data_service.worker.bytes").add(int(sent))
         except (OSError, ValueError, DMLCError) as e:

@@ -610,6 +610,27 @@ class DeviceLoader:
             csv=[self._src_attr("csv_label_col", -1),
                  self._src_attr("csv_delim", ",")])
 
+    def cached_page_file(self) -> Optional[str]:
+        """Path of a validated page file this loader would serve the next
+        epoch from, or None.  The data-service worker's fd-passing lane
+        asks this before streaming: when a valid cache exists, the file
+        descriptor itself can cross the UNIX socket (``SCM_RIGHTS``) and
+        the consumer maps the pages instead of receiving copies."""
+        if self._cache_path is None:
+            return None
+        fingerprint = self._cache_fingerprint()
+        if fingerprint is None:
+            return None
+        reader = page_cache.open_reader(
+            self._cache_path, fingerprint,
+            expected_words=lambda meta: _fused_words_meta(
+                self.batch_rows, int(meta)),
+            readahead=0)
+        if reader is None:
+            return None
+        reader.close()
+        return self._cache_path
+
     def _serve_cached(self, reader: page_cache.PageCacheReader) -> Iterator:
         """Epoch from the page file: mmap'd read-only fused views go
         straight to the transfer stage, no parse/pack at all.  The pool's

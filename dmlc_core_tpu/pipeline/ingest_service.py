@@ -46,17 +46,22 @@ from ..utils.logging import DMLCError, log_info, log_warning
 from ..utils.metrics import metrics
 from ..utils.parameter import env_int, get_env
 from ..utils.retry import RetryPolicy
+from ..transport import frames as _wire
+from ..transport.lane import recv_exact_into as _wire_recv
 from .device_loader import _BufPool, _fused_words_meta, _put_fused_buf
 
 __all__ = ["serve_ingest", "stream_epoch_frames", "RemoteIngestLoader",
            "ingest_worker_main"]
 
-_FRAME = struct.Struct("<QII")          # meta u64, words u32, rows u32
-_NO_ROWS = 0xFFFFFFFF                   # rows unknown (native packer path)
+# the frame header/sentinel are owned by the transport layer now; these
+# aliases keep the long-standing import surface (`ingest_service._FRAME`)
+# for the data-service client/worker and the tests
+_FRAME = _wire.FRAME                    # meta u64, words u32, rows u32
+_NO_ROWS = _wire.NO_ROWS                # rows unknown (native packer path)
 
 
 def _send_all(sock: socket.socket, data) -> None:
-    sock.sendall(data)
+    _wire.send_all(sock, data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -72,7 +77,9 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def stream_epoch_frames(conn: socket.socket, loader, batch_rows: int, *,
-                        stall=None, eos: bool = True) -> Tuple[int, int]:
+                        stall=None, eos: bool = True,
+                        writer: Optional[_wire.FrameWriter] = None
+                        ) -> Tuple[int, int]:
     """Send every fused frame ``loader`` yields over ``conn``; the framing
     half of :func:`serve_ingest`, shared with the data-service worker
     (:mod:`.data_service.worker`) so both roles put byte-identical frames
@@ -87,11 +94,16 @@ def stream_epoch_frames(conn: socket.socket, loader, batch_rows: int, *,
 
     ``eos=True`` appends the ``words=0`` end-of-stream frame after the
     loader exhausts; the data-service worker passes ``eos=False`` and
-    brackets each shard with its own control frames instead.  Returns
+    brackets each shard with its own control frames instead.  ``writer``
+    lets that worker thread its negotiated :class:`~.transport.frames.
+    FrameWriter` (compression, queued shard-begin controls) through;
+    without one a plain writer is built here, so header+payload still
+    leave in one vectored ``sendmsg`` per frame.  Returns
     ``(frames_sent, bytes_sent)``.
     """
     timeout = env_int("DMLC_INGEST_SEND_TIMEOUT", 300, minimum=0)
     conn.settimeout(timeout if timeout > 0 else None)
+    w = writer if writer is not None else _wire.FrameWriter(conn)
     frames = 0
     sent_bytes = 0
     t_frame = time.monotonic()
@@ -107,10 +119,9 @@ def stream_epoch_frames(conn: socket.socket, loader, batch_rows: int, *,
             # over-sized and their dead tail must not ride the very link
             # this feature exists to relieve
             words = _fused_words_meta(batch_rows, int(meta))
-            _send_all(conn, _FRAME.pack(
-                int(meta), words,
-                _NO_ROWS if rows is None else int(rows)))
-            _send_all(conn, memoryview(buf[:words]).cast("B"))
+            w.send_frame(int(meta), words,
+                         _NO_ROWS if rows is None else int(rows),
+                         memoryview(buf[:words]).cast("B"))
             loader.recycle(buf)
             sent_bytes += words * 4
             frames += 1
@@ -119,7 +130,8 @@ def stream_epoch_frames(conn: socket.socket, loader, batch_rows: int, *,
                 stall.observe(now - t_frame)
                 t_frame = now
         if eos:
-            _send_all(conn, _FRAME.pack(0, 0, 0))  # end of stream
+            w.control(0, 0, 0)  # end of stream
+            w.flush()
     except TimeoutError as e:
         metrics.counter("ingest.client_drops").add(1)
         log_warning("ingest: peer stopped draining (send timed out after "
@@ -316,16 +328,28 @@ class RemoteIngestLoader:
                     sock.close()
                     return
                 state["socks"].append(sock)
+            # one preallocated header buffer per connection: the hot loop
+            # recv_into's it every frame instead of allocating 16 bytes
+            # per frame (transport.buffer_reuse counts what that saves)
+            hdr_buf = bytearray(_FRAME.size)
+            hdr_view = memoryview(hdr_buf)
+            m_reuse = metrics.counter("transport.buffer_reuse")
+            first = True
             with sock:
                 while True:
                     # chaos probe: injected errors/latency land exactly
                     # where a flaky network would — per received frame
                     fault_point("ingest.recv")
-                    hdr = _recv_exact(sock, _FRAME.size)
-                    if hdr is None:
+                    try:
+                        _wire_recv(sock, hdr_view)
+                    except ConnectionError:
                         raise DMLCError(
                             f"ingest worker {addr} closed mid-stream")
-                    meta, words, rows = _FRAME.unpack(hdr)
+                    if first:
+                        first = False
+                    else:
+                        m_reuse.add(1)
+                    meta, words, rows = _FRAME.unpack(hdr_buf)
                     if words == 0:
                         return                     # worker's EOS
                     buf = self._pool.get(words)

@@ -236,13 +236,24 @@ class PageCacheReader:
 
     def __init__(self, path: str,
                  expected_words: Optional[Callable[[int], int]] = None,
-                 readahead: Optional[int] = None):
+                 readahead: Optional[int] = None, *,
+                 fileno: Optional[int] = None):
         self.path = path
-        with open(path, "rb") as f:
-            size = os.fstat(f.fileno()).st_size
+        if fileno is not None:
+            # cross-process view export (transport fd-passing): map the
+            # descriptor a peer handed us — no path lookup, the map owns
+            # its own reference so the caller may close the fd after
+            size = os.fstat(fileno).st_size
             if size < _HEAD.size + _FOOT.size:
                 raise PageCacheError(f"{path}: too small to be a page file")
-            self._mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            self._mm = mmap.mmap(fileno, 0, access=mmap.ACCESS_READ)
+        else:
+            with open(path, "rb") as f:
+                size = os.fstat(f.fileno()).st_size
+                if size < _HEAD.size + _FOOT.size:
+                    raise PageCacheError(
+                        f"{path}: too small to be a page file")
+                self._mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         try:
             self._validate(size, expected_words)
         except (struct.error, ValueError) as e:

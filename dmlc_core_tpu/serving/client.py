@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..telemetry import trace as teltrace
+from ..transport.frames import send_all
 from ..utils.logging import DMLCError
 from ..utils.metrics import Histogram, metrics
 from ..utils.parameter import get_env
@@ -146,7 +147,7 @@ class PredictClient:
             sock.settimeout(None)
             if self._model_id is not None:
                 try:
-                    sock.sendall(pack_hello(self._model_id))
+                    send_all(sock, pack_hello(self._model_id))
                 except OSError as e:
                     last_exc = e
                     try:
@@ -264,7 +265,7 @@ class PredictClient:
         try:
             with self._wlock:
                 for frame in frames:
-                    sock.sendall(frame)
+                    send_all(sock, frame)
         except OSError:
             # the connection died again mid-replay; the reader we just
             # started owns the next round — don't double-handle it here
@@ -316,7 +317,7 @@ class PredictClient:
             sock = self._sock
         try:
             with self._wlock:
-                sock.sendall(frame)
+                send_all(sock, frame)
         except OSError as e:
             # registration happened BEFORE this send, so whichever
             # reconnect the reader drives will replay the frame; only a

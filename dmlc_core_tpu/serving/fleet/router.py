@@ -56,6 +56,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ...telemetry import trace as teltrace
+from ...transport.frames import send_all
 from ...telemetry.exposition import TelemetryServer
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
@@ -95,8 +96,8 @@ class _ClientConn:
         n = len(payload) // 4 if status == STATUS_OK else len(payload)
         try:
             with self.wlock:
-                self.sock.sendall(RSP_HEADER.pack(req_id, status, n)
-                                  + payload)
+                send_all(self.sock, RSP_HEADER.pack(req_id, status, n)
+                         + payload)
         except OSError:
             self.alive = False   # reader thread owns the cleanup
 
@@ -388,7 +389,7 @@ class ServingRouter:
         # declare our model expectation; a mismatched replica answers
         # BAD_REQUEST and drops the link, which surfaces as a failover
         with rep.wlock:
-            sock.sendall(pack_hello(rep.model_id))
+            send_all(sock, pack_hello(rep.model_id))
         threading.Thread(target=self._backend_read_loop,
                          args=(rep, sock),
                          name=f"router-backend-{rep.key}",
@@ -535,7 +536,7 @@ class ServingRouter:
                                         pend.parent_span, pend.rows,
                                         pend.nnz) + pend.tail
                 with rep.wlock:
-                    sock.sendall(frame)
+                    send_all(sock, frame)
                 return True
             except (OSError, CircuitOpen) as e:
                 self._release(rep, pend.bid)

@@ -48,6 +48,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..telemetry import anomaly as telanomaly
+from ..transport.frames import send_all
 from ..telemetry import flight as telflight
 from ..telemetry import trace as teltrace
 from ..telemetry.exposition import TelemetryServer
@@ -431,8 +432,8 @@ class PredictionServer:
             n = len(payload) // 4 if status == STATUS_OK else len(payload)
             try:
                 with wlock:
-                    conn.sendall(RSP_HEADER.pack(req_id, status, n)
-                                 + payload)
+                    send_all(conn, RSP_HEADER.pack(req_id, status, n)
+                             + payload)
             except OSError:
                 pass                   # client gone; reader will notice
 

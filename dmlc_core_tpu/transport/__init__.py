@@ -1,0 +1,35 @@
+"""Shared wire-transport layer (ISSUE 15): every tier's socket code —
+the data service, the ingest service, the reshard path, serving — rides
+these primitives instead of ad-hoc ``sendall``/``pickle`` calls (the
+``transport-discipline`` lint rule enforces the boundary).
+
+Three capabilities live here:
+
+* :mod:`.frames` — vectored frame sends (:class:`FrameWriter` coalesces
+  header+payload into one ``sendmsg`` and batches small control frames),
+  opt-in wire compression (``DMLC_WIRE_COMPRESS``, negotiated in the
+  stream hello, off by default), and the sanctioned raw-send helpers.
+* :mod:`.lane` — zero-copy local lanes: UNIX-domain-socket negotiation
+  for colocated consumer/worker pairs, with ``SCM_RIGHTS`` fd-passing of
+  the page cache's mmap-backed page files where available.
+* :mod:`.plan` — the round-structured reshard transfer planner
+  (holder-balanced, in-flight bytes per round bounded by
+  ``DMLC_RESHARD_MAX_BYTES``).
+"""
+
+from .frames import (CTRL_FDPASS, CTRL_TRANSPORT, FRAME, NO_ROWS,
+                     FrameWriter, available_codecs, choose_codec,
+                     get_codec, negotiate_reply, pack_obj, requested_codec,
+                     send_all, unpack_obj)
+from .lane import (connect_lane, fd_passing_ok, host_token, lane_enabled,
+                   lane_path, recv_exact_into, send_with_fds)
+from .plan import Transfer, plan_rounds
+
+__all__ = [
+    "CTRL_FDPASS", "CTRL_TRANSPORT", "FRAME", "NO_ROWS", "FrameWriter",
+    "available_codecs", "choose_codec", "get_codec", "negotiate_reply",
+    "pack_obj", "requested_codec", "send_all", "unpack_obj",
+    "connect_lane", "fd_passing_ok", "host_token", "lane_enabled",
+    "lane_path", "recv_exact_into", "send_with_fds",
+    "Transfer", "plan_rounds",
+]

@@ -1,0 +1,148 @@
+"""Zero-copy local lanes: UNIX-domain-socket transport for colocated
+consumer/worker pairs, with ``SCM_RIGHTS`` fd-passing of page-cache
+files where the platform supports it.
+
+Negotiation is registration-time, not connect-time: a worker that can
+bind a UDS endpoint advertises ``{"uds": <path>, "hostid": <token>}``
+alongside its TCP address in ``register_worker``; the dispatcher echoes
+the lane map back from ``list_workers`` under a separate ``"lanes"``
+key (old dispatchers/clients ignore both — wire compatibility is free).
+A client dials the lane only when its own :func:`host_token` matches the
+worker's — hostname alone is not enough, two containers can share a
+hostname, so the token folds in the kernel boot id.
+
+fd-passing rides the lane: the worker attaches the page file's
+descriptor as ``SCM_RIGHTS`` ancillary data on the ``sendmsg`` carrying
+the :data:`~.frames.CTRL_FDPASS` header.  POSIX delivers ancillary data
+only with the ``recvmsg`` that reads the first byte of the segment it
+was attached to, so fd-expecting receivers must read *headers* via
+:func:`recv_exact_into` with an ``fd_out`` stash — a plain ``recv_into``
+would silently drop the descriptor.
+"""
+
+from __future__ import annotations
+
+import array
+import hashlib
+import os
+import socket
+import tempfile
+from typing import List, Optional
+
+from ..utils.parameter import parse_lenient_bool
+
+__all__ = ["HAVE_UNIX", "lane_enabled", "fd_passing_ok", "host_token",
+           "lane_path", "bind_lane", "connect_lane", "send_with_fds",
+           "recv_exact_into"]
+
+HAVE_UNIX = hasattr(socket, "AF_UNIX")
+_HAVE_SCM = (HAVE_UNIX and hasattr(socket.socket, "sendmsg")
+             and hasattr(socket, "SCM_RIGHTS"))
+_host_token_cache: Optional[str] = None
+
+
+def lane_enabled() -> bool:
+    """UDS lane negotiation gate: on by default where AF_UNIX exists,
+    ``DMLC_TRANSPORT_LANE=0`` forces every stream onto TCP."""
+    if not HAVE_UNIX:
+        return False
+    return parse_lenient_bool("DMLC_TRANSPORT_LANE") is not False
+
+
+def fd_passing_ok() -> bool:
+    """fd-passing gate: needs SCM_RIGHTS plumbing *and* the lane; the
+    ``DMLC_TRANSPORT_FDPASS=0`` kill switch degrades to copy mode."""
+    if not (_HAVE_SCM and lane_enabled()):
+        return False
+    return parse_lenient_bool("DMLC_TRANSPORT_FDPASS") is not False
+
+
+def host_token() -> str:
+    """Stable same-host identity: hostname + kernel boot id.  Two
+    processes with equal tokens share a kernel, so a UDS path one of
+    them bound is reachable by the other (modulo mount namespaces,
+    which the client's path-exists probe catches)."""
+    global _host_token_cache
+    if _host_token_cache is None:
+        boot = ""
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                boot = f.read().strip()
+        except OSError:
+            pass
+        _host_token_cache = f"{socket.gethostname()}|{boot}"
+    return _host_token_cache
+
+
+def lane_path(jobid: str) -> str:
+    """Deterministic, short UDS path for a worker (sun_path is ~107
+    bytes, so the jobid is hashed, never embedded)."""
+    tag = hashlib.sha1(jobid.encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(), f"dmlc-lane-{tag}.sock")
+
+
+def bind_lane(jobid: str) -> Optional[socket.socket]:
+    """Bind+listen the worker's UDS endpoint; None when the platform or
+    filesystem refuses (callers advertise no lane and stay TCP-only)."""
+    if not lane_enabled():
+        return None
+    path = lane_path(jobid)
+    try:
+        if os.path.exists(path):
+            os.unlink(path)  # stale socket from a dead predecessor
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(16)
+        return srv
+    except OSError:
+        return None
+
+
+def connect_lane(path: str, timeout: Optional[float] = None
+                 ) -> socket.socket:
+    """Dial a worker's UDS endpoint (raises OSError like TCP connect)."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    if timeout is not None:
+        sock.settimeout(timeout)
+    try:
+        sock.connect(path)
+    except BaseException:
+        sock.close()
+        raise
+    return sock
+
+
+def send_with_fds(sock: socket.socket, data: bytes,
+                  fds: List[int]) -> None:
+    """Send ``data`` with ``fds`` attached as SCM_RIGHTS ancillary on
+    the same ``sendmsg`` — the receiver's first-byte recvmsg gets them."""
+    anc = [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+            array.array("i", fds).tobytes())]
+    sent = sock.sendmsg([data], anc)
+    if sent < len(data):
+        sock.sendall(data[sent:])
+
+
+def _collect_fds(ancdata, fd_out: List[int]) -> None:
+    for level, typ, data in ancdata:
+        if level == socket.SOL_SOCKET and typ == socket.SCM_RIGHTS:
+            usable = len(data) - len(data) % 4
+            fd_out.extend(array.array("i", data[:usable]))
+
+
+def recv_exact_into(sock: socket.socket, view: memoryview,
+                    fd_out: Optional[List[int]] = None) -> None:
+    """Fill ``view`` exactly, collecting any SCM_RIGHTS descriptors into
+    ``fd_out`` along the way (``fd_out=None`` → plain ``recv_into``).
+    Raises ConnectionError on EOF mid-buffer."""
+    off, n = 0, len(view)
+    while off < n:
+        if fd_out is not None:
+            got, anc, _flags, _addr = sock.recvmsg_into(
+                [view[off:]], socket.CMSG_SPACE(4 * 4))
+            _collect_fds(anc, fd_out)
+        else:
+            got = sock.recv_into(view[off:])
+        if got == 0:
+            raise ConnectionError("connection closed mid-frame")
+        off += got

@@ -1,0 +1,509 @@
+"""Transport overhaul (PR 15): zero-copy UNIX lanes, vectored wire
+sends, negotiated compression, fd-passing, and the planned reshard
+round schedule.
+
+Wire-compatibility is the hard invariant: every lane and codec must
+deliver frames byte-identical to the single-host baseline, and peers
+from BEFORE the negotiation existed must interoperate with peers from
+after — proven here with a hand-rolled legacy client and a hand-rolled
+legacy worker speaking the seed framing verbatim."""
+
+import hashlib
+import json
+import socket
+import struct
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from dmlc_core_tpu import transport  # noqa: E402
+from dmlc_core_tpu.data import create_parser  # noqa: E402
+from dmlc_core_tpu.pipeline.data_service import (  # noqa: E402
+    DataServiceLoader, DataServiceWorker, Dispatcher, dispatcher_rpc)
+from dmlc_core_tpu.pipeline.data_service.worker import (  # noqa: E402
+    CTRL_SHARD_BEGIN, CTRL_SHARD_END)
+from dmlc_core_tpu.pipeline.device_loader import (  # noqa: E402
+    DeviceLoader, _fused_words_meta, _put_fused_buf)
+from dmlc_core_tpu.pipeline.ingest_service import _recv_exact  # noqa: E402
+from dmlc_core_tpu.transport import (  # noqa: E402
+    FRAME, NO_ROWS, FrameWriter, Transfer, available_codecs, choose_codec,
+    negotiate_reply, plan_rounds)
+from dmlc_core_tpu.transport.frames import CTRL_TRANSPORT  # noqa: E402
+from dmlc_core_tpu.utils import clear_faults, inject_faults  # noqa: E402
+from dmlc_core_tpu.utils.metrics import metrics  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _counter(name):
+    return metrics.counter(name).value
+
+
+ROWS = 300
+BATCH_ROWS = 32
+NNZ_CAP = 1024
+
+
+def _libsvm(tmp_path, rows=ROWS):
+    rng = np.random.default_rng(11)
+    path = tmp_path / "tp.libsvm"
+    with open(path, "w") as f:
+        for i in range(rows):
+            idx = np.sort(rng.choice(np.arange(1, 300), size=6,
+                                     replace=False))
+            f.write(f"{i + 1} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    return str(path)
+
+
+def _spec(uri, num_parts, **extra):
+    spec = {"uri": uri, "fmt": "libsvm", "num_parts": num_parts,
+            "batch_rows": BATCH_ROWS, "nnz_cap": NNZ_CAP}
+    spec.update(extra)
+    return spec
+
+
+def _frame_digest(buf, meta):
+    words = _fused_words_meta(BATCH_ROWS, int(meta))
+    return hashlib.sha1(np.asarray(buf)[:words].tobytes()).hexdigest()
+
+
+def _drain(loader):
+    labels, digests = Counter(), Counter()
+    for kind, buf, meta, _rows in loader:
+        assert kind == "fused"
+        digests[_frame_digest(buf, meta)] += 1
+        out = _put_fused_buf(
+            np.asarray(buf)[: _fused_words_meta(BATCH_ROWS, int(meta))],
+            BATCH_ROWS, int(meta))
+        labels.update(int(x) for x in np.asarray(out["labels"])
+                      if int(x) > 0)
+        loader.recycle(buf)
+    return labels, digests
+
+
+def _single_host_baseline(uri, num_parts):
+    labels, digests = Counter(), Counter()
+    for part in range(num_parts):
+        loader = DeviceLoader(
+            create_parser(uri, part, num_parts, "libsvm", nthreads=1,
+                          threaded=False),
+            batch_rows=BATCH_ROWS, nnz_cap=NNZ_CAP, emit="host")
+        try:
+            for kind, buf, meta, _rows in loader:
+                digests[_frame_digest(buf, meta)] += 1
+                out = _put_fused_buf(
+                    np.asarray(buf)[: _fused_words_meta(BATCH_ROWS,
+                                                        int(meta))],
+                    BATCH_ROWS, int(meta))
+                labels.update(int(x) for x in np.asarray(out["labels"])
+                              if int(x) > 0)
+        finally:
+            loader.close()
+    return labels, digests
+
+
+def _fleet_epoch(tmp_path, num_parts=2, workers=1, epochs=1, spec_extra=None,
+                 key_out=None):
+    """One dispatcher + N workers + one consumer; returns the per-epoch
+    (labels, digests) list."""
+    uri = _libsvm(tmp_path)
+    out = []
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=10.0) as d:
+        d.start()
+        ws = [DataServiceWorker(d.address).start() for _ in range(workers)]
+        try:
+            ldr = DataServiceLoader(
+                d.address, _spec(uri, num_parts, **(spec_extra or {})))
+            try:
+                for _ in range(epochs):
+                    out.append(_drain(ldr))
+            finally:
+                ldr.close()
+        finally:
+            for w in ws:
+                w.kill()
+    if key_out is not None:
+        key_out.append(uri)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# units: round planner
+# ---------------------------------------------------------------------------
+
+def test_plan_rounds_balances_holders_and_bounds_bytes():
+    """First-fit-decreasing: the 300-byte transfer fills round 0 alone
+    (budget 350 leaves no room for a 100), then the five 100-byte pulls
+    from one holder pack two per round under the per-holder slot cap."""
+    ts = [Transfer("p", i, i + 1, 0, (), nbytes=100, tag=i)
+          for i in range(5)]
+    ts.append(Transfer("q", 0, 3, 1, (), nbytes=300, tag=9))
+    rounds = plan_rounds(ts, max_bytes=350, per_holder=2)
+    shape = [sorted(t.tag for t in rnd) for rnd in rounds]
+    assert shape == [[9], [0, 1], [2, 3], [4]]
+    for rnd in rounds:
+        assert sum(t.nbytes for t in rnd) <= 350
+
+
+def test_plan_rounds_oversize_and_unbounded():
+    """A transfer bigger than the budget still ships — alone in its own
+    round; with no byte bound only the holder cap splits rounds."""
+    big = Transfer("x", 0, 10, 0, (), nbytes=10_000, tag="big")
+    small = Transfer("y", 0, 1, 0, (), nbytes=10, tag="small")
+    rounds = plan_rounds([big, small], max_bytes=100, per_holder=4)
+    assert [t.tag for t in rounds[0]] == ["big"]
+    assert [t.tag for t in rounds[1]] == ["small"]
+    # unbounded bytes, per_holder=1: one transfer per round per holder
+    rounds = plan_rounds([big, small], max_bytes=None, per_holder=1)
+    assert [len(r) for r in rounds] == [1, 1]
+    # fully unbounded: everything in one round
+    rounds = plan_rounds([big, small], max_bytes=None, per_holder=0)
+    assert [len(r) for r in rounds] == [2]
+
+
+def test_plan_rounds_deterministic_under_input_order():
+    """The plan is a pure function of the transfer set — every cohort
+    member computes the same schedule without communicating."""
+    ts = [Transfer(f"p{i % 3}", i, i + 2, i % 4, (), nbytes=50 + 13 * i,
+                   tag=i) for i in range(12)]
+    a = plan_rounds(list(ts), max_bytes=200, per_holder=2)
+    b = plan_rounds(list(reversed(ts)), max_bytes=200, per_holder=2)
+    assert [[t.tag for t in r] for r in a] == [[t.tag for t in r] for r in b]
+
+
+def test_remap_deltas_excludes_resident_rows():
+    from dmlc_core_tpu.parallel.mesh import remap_deltas, remap_rows
+    # 3 -> 2 shrink over 10 rows: each survivor keeps its resident rows
+    assert remap_deltas(10, 3, 2) == [[(1, 4, 5)], [(2, 7, 10)]]
+    # identity resize moves nothing
+    assert remap_deltas(10, 3, 3) == [[], [], []]
+    # deltas are always a subset of the full feed map
+    for new_rank, (full, delta) in enumerate(zip(remap_rows(10, 2, 3),
+                                                 remap_deltas(10, 2, 3))):
+        assert set(delta) <= set(full)
+
+
+# ---------------------------------------------------------------------------
+# units: codec negotiation + frame writer
+# ---------------------------------------------------------------------------
+
+def test_choose_codec_and_negotiate_fallback():
+    assert "zlib" in available_codecs()     # stdlib floor, always present
+    assert choose_codec(["zlib"], ["zlib"], ["zlib"]) == "zlib"
+    # peer lacks the wanted codec: fall back to UNCOMPRESSED, never to a
+    # codec the caller didn't ask for
+    f0 = _counter("transport.codec_fallbacks")
+    assert choose_codec(["zstd"], ["zlib"], ["zlib"]) is None
+    neg = negotiate_reply({"codecs": ["zlib"], "want": "zstd",
+                           "lane": "tcp", "fdpass": False},
+                          uds=False, fdpass_ok=False)
+    assert neg["compress"] is None and neg["fdpass"] is False
+    assert _counter("transport.codec_fallbacks") - f0 >= 1
+    # no wish at all: no fallback counted, no compression
+    neg = negotiate_reply({"codecs": ["zlib"], "want": None,
+                           "lane": "tcp", "fdpass": False},
+                          uds=False, fdpass_ok=False)
+    assert neg["compress"] is None
+
+
+def test_frame_writer_vectored_send_is_byte_identical():
+    """A queued control frame + data frame leave in ONE sendmsg whose
+    bytes equal the seed's sequential sendall layout exactly."""
+    a, b = socket.socketpair()
+    c0 = _counter("transport.frames_coalesced")
+    try:
+        w = FrameWriter(a)
+        payload = np.arange(64, dtype=np.uint32).tobytes()
+        w.control(3, CTRL_SHARD_BEGIN, 7)
+        w.send_frame(123, 64, 5, payload)
+        w.control(3, CTRL_SHARD_END, 1)
+        w.control(0, 0, 0)
+        w.flush()
+        expect = (FRAME.pack(3, CTRL_SHARD_BEGIN, 7)
+                  + FRAME.pack(123, 64, 5) + payload
+                  + FRAME.pack(3, CTRL_SHARD_END, 1)
+                  + FRAME.pack(0, 0, 0))
+        got = _recv_exact(b, len(expect))
+        assert bytes(got) == expect
+    finally:
+        a.close()
+        b.close()
+    assert _counter("transport.frames_coalesced") - c0 >= 4
+
+
+def test_frame_writer_compression_roundtrip():
+    """Compressed data frames keep the UNCOMPRESSED word count in the
+    header and carry a trailing u32 wire length; clen=0 marks a frame
+    that didn't shrink and rides raw."""
+    import zlib
+    a, b = socket.socketpair()
+    try:
+        w = FrameWriter(a, compress="zlib")
+        payload = np.zeros(256, dtype=np.uint32).tobytes()   # compresses
+        w.send_frame(9, 256, NO_ROWS, payload)
+        hdr = _recv_exact(b, FRAME.size)
+        meta, words, rows = FRAME.unpack(bytes(hdr))
+        assert (meta, words, rows) == (9, 256, NO_ROWS)
+        (clen,) = struct.unpack("<I", bytes(_recv_exact(b, 4)))
+        assert 0 < clen < len(payload)
+        assert zlib.decompress(bytes(_recv_exact(b, clen))) == payload
+    finally:
+        a.close()
+        b.close()
+    with pytest.raises(ValueError):
+        FrameWriter(None, compress="not-a-codec")
+
+
+def test_sock_buf_knob_applied(monkeypatch):
+    from dmlc_core_tpu.parallel.reshard import _apply_sock_buf
+    monkeypatch.setenv("DMLC_SOCK_BUF_KB", "256")
+    s = socket.socket()
+    try:
+        _apply_sock_buf(s)
+        # kernels report >= the requested size (linux doubles it)
+        assert s.getsockopt(socket.SOL_SOCKET,
+                            socket.SO_SNDBUF) >= 256 * 1024
+        assert s.getsockopt(socket.SOL_SOCKET,
+                            socket.SO_RCVBUF) >= 256 * 1024
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# lanes: UNIX vs TCP byte-identical, chaos fallback, fd-passing
+# ---------------------------------------------------------------------------
+
+def test_uds_lane_matches_tcp_byte_identical(tmp_path, monkeypatch):
+    """The same dataset over the TCP path and over the colocated UNIX
+    lane: labels exactly once and frames byte-identical both ways."""
+    uri = _libsvm(tmp_path)
+    base_labels, base_digests = _single_host_baseline(uri, 2)
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=10.0) as d:
+        d.start()
+        with DataServiceWorker(d.address) as w:
+            w.start()
+            assert w.uds_path is not None    # lane bound by default
+            tcp0 = _counter("transport.lane.tcp")
+            monkeypatch.setenv("DMLC_TRANSPORT_LANE", "0")
+            ldr = DataServiceLoader(d.address, _spec(uri, 2))
+            labels, digests = _drain(ldr)
+            ldr.close()
+            assert labels == base_labels and digests == base_digests
+            assert _counter("transport.lane.tcp") - tcp0 >= 1
+            uds0 = _counter("transport.lane.uds")
+            monkeypatch.delenv("DMLC_TRANSPORT_LANE")
+            ldr = DataServiceLoader(d.address, _spec(uri, 2))
+            labels, digests = _drain(ldr)
+            ldr.close()
+            assert labels == base_labels and digests == base_digests
+            assert _counter("transport.lane.uds") - uds0 >= 1
+
+
+def test_wire_compression_negotiated_and_fallback(tmp_path, monkeypatch):
+    """DMLC_WIRE_COMPRESS=zlib streams compressed frames that decompress
+    to the exact baseline; asking for a codec this host lacks degrades
+    to uncompressed (counted), never to a broken stream."""
+    uri = _libsvm(tmp_path)
+    base_labels, base_digests = _single_host_baseline(uri, 2)
+    monkeypatch.setenv("DMLC_TRANSPORT_LANE", "0")   # exercise TCP framing
+    monkeypatch.setenv("DMLC_WIRE_COMPRESS", "zlib")
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=10.0) as d:
+        d.start()
+        with DataServiceWorker(d.address) as w:
+            w.start()
+            ldr = DataServiceLoader(d.address, _spec(uri, 2))
+            labels, digests = _drain(ldr)
+            ldr.close()
+            assert labels == base_labels and digests == base_digests
+            ratio = metrics.gauge("transport.compress_ratio").value
+            assert 0 < ratio < 1.0       # sparse int frames shrink
+            if "zstd" not in available_codecs():
+                f0 = _counter("transport.codec_fallbacks")
+                monkeypatch.setenv("DMLC_WIRE_COMPRESS", "zstd")
+                ldr = DataServiceLoader(d.address, _spec(uri, 2))
+                labels, digests = _drain(ldr)
+                ldr.close()
+                assert labels == base_labels and digests == base_digests
+                assert _counter("transport.codec_fallbacks") - f0 >= 1
+
+
+def test_fdpass_shard_crosses_as_descriptor(tmp_path):
+    """A page-cache-backed shard on a UNIX lane crosses as ONE
+    SCM_RIGHTS descriptor: epoch 2 is served from the cache the worker
+    built in epoch 1, zero payload bytes on the wire, frames still
+    byte-identical and exactly-once."""
+    uri = _libsvm(tmp_path)
+    base_labels, base_digests = _single_host_baseline(uri, 1)
+    cache = str(tmp_path / "shard0.pages")
+    z0 = _counter("transport.bytes_zero_copy")
+    s0 = _counter("data_service.worker.fdpass_shards")
+    dup0 = _counter("data_service.client.dup_frames")
+    epochs = _fleet_epoch(tmp_path, num_parts=1, epochs=2,
+                          spec_extra={"cache": cache})
+    for labels, digests in epochs:
+        assert labels == base_labels
+        assert digests == base_digests
+    assert _counter("data_service.worker.fdpass_shards") - s0 >= 1
+    assert _counter("transport.bytes_zero_copy") - z0 > 0
+    assert _counter("data_service.client.dup_frames") - dup0 == 0
+
+
+def test_lane_fault_mid_epoch_falls_back_to_tcp(tmp_path):
+    """Chaos: the UNIX lane dies mid-epoch (DMLC_FAULT_SPEC).  The
+    consumer marks the lane down, redials over TCP, and the exactly-once
+    ledger holds — every row once, every frame byte-identical."""
+    uri = _libsvm(tmp_path)
+    base_labels, base_digests = _single_host_baseline(uri, 2)
+    fb0 = _counter("transport.lane_fallbacks")
+    f0 = _counter("faults.transport.lane.errors")
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=10.0) as d:
+        d.start()
+        with DataServiceWorker(d.address) as w:
+            w.start()
+            with inject_faults("transport.lane:error=1:times=1:after=3"):
+                ldr = DataServiceLoader(d.address, _spec(uri, 2))
+                labels, digests = _drain(ldr)
+                ldr.close()
+    assert _counter("faults.transport.lane.errors") - f0 == 1
+    assert labels == base_labels          # every row exactly once
+    assert digests == base_digests        # every frame byte-identical
+    assert _counter("transport.lane_fallbacks") - fb0 >= 1
+
+
+# ---------------------------------------------------------------------------
+# mixed-version interop: the negotiation must be invisible to old peers
+# ---------------------------------------------------------------------------
+
+def test_legacy_client_against_new_worker(tmp_path):
+    """A consumer from before the negotiation existed: raw hello with NO
+    "transport" key.  The new worker must serve the seed framing
+    verbatim — no CTRL_TRANSPORT frame, no compression, no trailers."""
+    from dmlc_core_tpu.parallel.tracker import send_json
+    uri = _libsvm(tmp_path)
+    base_labels, base_digests = _single_host_baseline(uri, 2)
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=10.0) as d:
+        d.start()
+        with DataServiceWorker(d.address) as w:
+            w.start()
+            key = dispatcher_rpc(d.address, {
+                "cmd": "register_dataset", "spec": _spec(uri, 2)})["key"]
+            listing = dispatcher_rpc(d.address, {"cmd": "list_workers"})
+            (jobid, addr), = listing["workers"].items()
+            labels, digests = Counter(), Counter()
+            with socket.create_connection(tuple(addr), timeout=10) as s:
+                s.settimeout(30.0)
+                send_json(s, {"key": key, "epoch": 0})   # seed-era hello
+                while True:
+                    meta, words, rows = FRAME.unpack(
+                        bytes(_recv_exact(s, FRAME.size)))
+                    assert words != CTRL_TRANSPORT, \
+                        "negotiation reply leaked to a legacy consumer"
+                    if words == 0:
+                        break
+                    if words in (CTRL_SHARD_BEGIN, CTRL_SHARD_END):
+                        continue
+                    buf = np.frombuffer(
+                        bytes(_recv_exact(s, words * 4)), dtype=np.uint32)
+                    digests[_frame_digest(buf, meta)] += 1
+                    out = _put_fused_buf(buf, BATCH_ROWS, int(meta))
+                    labels.update(int(x) for x in np.asarray(out["labels"])
+                                  if int(x) > 0)
+    assert labels == base_labels
+    assert digests == base_digests
+
+
+def test_new_client_against_legacy_worker(tmp_path):
+    """A worker from before the negotiation existed: ignores the
+    "transport" hello key, never replies CTRL_TRANSPORT, streams seed
+    framing with raw sendall.  The new consumer must accept it as-is."""
+    uri = _libsvm(tmp_path)
+    base_labels, base_digests = _single_host_baseline(uri, 2)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def legacy_worker(dispatcher_addr):
+        """The seed-era serve loop, hand-rolled: JSON hello in, struct
+        frames out via plain sendall, leases via dispatcher RPC."""
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                req = json.loads(conn.makefile("r").readline())
+                key = req["key"]
+                assert "transport" in req     # new hello carries the offer
+                while not stop.is_set():
+                    reply = dispatcher_rpc(dispatcher_addr, {
+                        "cmd": "next_lease", "key": key,
+                        "jobid": "legacy-w"})
+                    if reply.get("status") == "done":
+                        conn.sendall(FRAME.pack(0, 0, 0))
+                        break
+                    lease = reply.get("lease")
+                    if lease is None:
+                        time.sleep(0.05)
+                        continue
+                    part = int(lease["part"])
+                    epoch_id = int(lease["lease_epoch"])
+                    spec = lease["spec"]
+                    loader = DeviceLoader(
+                        create_parser(str(spec["uri"]), part,
+                                      int(spec["num_parts"]),
+                                      str(spec["fmt"]), nthreads=1,
+                                      threaded=False),
+                        batch_rows=int(spec["batch_rows"]),
+                        nnz_cap=int(spec["nnz_cap"]), emit="host")
+                    conn.sendall(FRAME.pack(part, CTRL_SHARD_BEGIN,
+                                            epoch_id))
+                    frames = 0
+                    try:
+                        for _kind, buf, meta, rows in loader:
+                            words = _fused_words_meta(
+                                int(spec["batch_rows"]), int(meta))
+                            conn.sendall(FRAME.pack(
+                                int(meta), words,
+                                NO_ROWS if rows is None else int(rows)))
+                            conn.sendall(memoryview(
+                                np.asarray(buf)[:words]).cast("B"))
+                            frames += 1
+                    finally:
+                        loader.close()
+                    conn.sendall(FRAME.pack(part, CTRL_SHARD_END, frames))
+                    dispatcher_rpc(dispatcher_addr, {
+                        "cmd": "complete_lease", "key": key, "part": part,
+                        "lease_epoch": epoch_id, "jobid": "legacy-w"})
+
+    with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=60.0) as d:
+        d.start()
+        dispatcher_rpc(d.address, {"cmd": "register_worker",
+                                   "jobid": "legacy-w",
+                                   "host": "127.0.0.1", "port": port})
+        t = threading.Thread(target=legacy_worker, args=(d.address,),
+                             daemon=True)
+        t.start()
+        try:
+            ldr = DataServiceLoader(d.address, _spec(uri, 2))
+            labels, digests = _drain(ldr)
+            ldr.close()
+        finally:
+            stop.set()
+            srv.close()
+            t.join(timeout=10.0)
+    assert labels == base_labels
+    assert digests == base_digests
