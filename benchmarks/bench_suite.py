@@ -1248,7 +1248,7 @@ def _merge_child_telemetry(tag: str, states=None, trace_files=()) -> None:
 
 
 def _fleet_ingest_rate(nworkers: int, num_parts: int = 6,
-                       attempts: int = 2) -> float:
+                       attempts: int = 2, batch_rows: int = 4096) -> float:
     """One dispatcher + ``nworkers`` data-service worker subprocesses
     pulling shard leases for a shared dataset; measure aggregate MB/s of
     fused host frames arriving at a single ``DataServiceLoader``
@@ -1284,7 +1284,7 @@ def _fleet_ingest_rate(nworkers: int, num_parts: int = 6,
                     f"data-service workers registered")
             time.sleep(0.25)
         spec = {"uri": f"file://{path}", "fmt": "libsvm",
-                "num_parts": num_parts, "batch_rows": 4096,
+                "num_parts": num_parts, "batch_rows": batch_rows,
                 "nnz_cap": 131072}
         best = 0.0
         for _ in range(attempts):
@@ -1341,28 +1341,158 @@ def _fleet_ingest_rate(nworkers: int, num_parts: int = 6,
         disp.stop()
 
 
+def _fleet_failover_s(num_parts: int = 6) -> float:
+    """Dispatcher HA drill: run the dispatcher as a *subprocess* with a
+    journal, SIGKILL it after the consumer has taken its first frames,
+    restart it on the same port + journal, and measure kill→recovered
+    (new process answering a ``status`` RPC with the epoch's state
+    replayed).  The consumer keeps iterating across the outage — its
+    control-plane retries ride over the dead window — so the epoch also
+    completing (frames > 0 after the kill) is part of the drill, not a
+    separate test."""
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+    from dmlc_core_tpu.pipeline.data_service import DataServiceLoader
+    from dmlc_core_tpu.pipeline.data_service.dispatcher import dispatcher_rpc
+
+    path = "/tmp/bench_suite.libsvm"
+    _gen_libsvm(path)
+    tmp = tempfile.mkdtemp(prefix="dmlc_failover_")
+    journal = os.path.join(tmp, "dispatch")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+           # fast re-registration beats: the drill's clock includes the
+           # worker noticing the new dispatcher
+           "DMLC_DATA_HEARTBEAT_TIMEOUT": "3"}
+
+    def _spawn_dispatcher(port: int) -> Tuple[subprocess.Popen, int]:
+        proc = subprocess.Popen(
+            [_sys.executable, "-m",
+             "dmlc_core_tpu.pipeline.data_service.dispatcher",
+             f"port={port}", f"journal={journal}"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        line = proc.stdout.readline()
+        return proc, int(json.loads(line)["port"])
+
+    disp, port = _spawn_dispatcher(0)
+    worker = subprocess.Popen(
+        [_sys.executable, "-m", "dmlc_core_tpu.pipeline.data_service.worker",
+         f"127.0.0.1:{port}"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    # the consumer must out-retry the dead window: the default policy
+    # gives up in ~seconds and the breaker would stop redialing the
+    # (innocent) worker while its completions bounce off a dead control
+    # plane
+    chaos_env = {"DMLC_DATA_CLIENT_RETRIES": "40",
+                 "DMLC_DATA_CLIENT_BREAKER_THRESHOLD": "1000",
+                 "DMLC_DS_CTRL_RETRIES": "40"}
+    saved = {k: os.environ.get(k) for k in chaos_env}
+    os.environ.update(chaos_env)
+    try:
+        # the worker's interpreter start-up is seconds on a loaded host;
+        # the consumer's first start_epoch must not race it to the
+        # registry
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                if dispatcher_rpc(("127.0.0.1", port),
+                                  {"cmd": "list_workers"},
+                                  timeout=2.0)["workers"]:
+                    break
+            except (OSError, ValueError, KeyError):
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError("data-service worker never registered "
+                                   "for the failover drill")
+            time.sleep(0.25)
+        spec = {"uri": f"file://{path}", "fmt": "libsvm",
+                "num_parts": num_parts, "batch_rows": 4096,
+                "nnz_cap": 131072}
+        loader = DataServiceLoader(("127.0.0.1", port), spec,
+                                   connect_timeout=120.0, emit="host")
+        it = iter(loader)
+        frames = 0
+        for _kind, buf, _meta, _rows in it:
+            frames += 1
+            loader.recycle(buf)
+            if frames >= 2:
+                break  # mid-epoch: leases granted, parts outstanding
+        disp.kill()
+        disp.wait()
+        t0 = time.perf_counter()
+        disp, port2 = _spawn_dispatcher(port)
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                st = dispatcher_rpc(("127.0.0.1", port2),
+                                    {"cmd": "status", "key": loader.key},
+                                    timeout=2.0)
+                if int(st.get("epoch", 0)) >= 1:
+                    break  # journal replayed: the epoch survived the crash
+            except (OSError, ValueError, KeyError):
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError("restarted dispatcher never recovered")
+            time.sleep(0.05)
+        failover = time.perf_counter() - t0
+        for _kind, buf, _meta, _rows in it:
+            frames += 1
+            loader.recycle(buf)
+        loader.close()
+        if frames <= 2:
+            raise RuntimeError("epoch did not resume after failover")
+        return failover
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        worker.kill()
+        disp.kill()
+        worker.wait()
+        disp.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_ingest_fleet() -> dict:
-    """Data-service fleet scaling: dispatcher + N leased workers feeding
-    one consumer, N = 1/2/3.  On a multi-core host 3 workers should
-    deliver ≥ 1.6× the 1-worker aggregate MB/s; on a 1-core host every
+    """Data-service fleet scaling + HA: dispatcher + N leased workers
+    feeding one consumer, N = 1/2/3, plus a SIGKILL failover drill
+    against a journaled dispatcher subprocess.
+
+    On a multi-core host 3 workers should deliver ≥ 1.6× the 1-worker
+    aggregate MB/s; on a host with fewer cores than workers every
     process time-slices the same core, so the curve records the
-    lease/control-plane overhead against the static-assignment baseline,
-    not fleet scaling — stamped via host_cores (same discipline as
-    ingest_worker_scaling)."""
+    lease/control-plane overhead, not fleet scaling — in that case the
+    ``speedup_3v1`` keys are OMITTED (not stamped at ~1.0), so the
+    regression gate never judges scaling a core-starved host cannot
+    exhibit (host_cores records why).  The parser-bound variant shrinks
+    ``batch_rows`` 8× so per-batch parse/framing overhead dominates the
+    wire — the regime where extra workers pay off first."""
     import bench
     cores = bench.host_cores()
     curve = {}
     for n in (1, 2, 3):
         curve[f"workers_{n}"] = round(_fleet_ingest_rate(n), 1)
+    parser = {}
+    for n in (1, 3):
+        parser[f"workers_{n}"] = round(
+            _fleet_ingest_rate(n, batch_rows=512), 1)
     r = {"metric": "ingest_fleet_mb_s", "value": curve["workers_3"],
-         "unit": "MB/s", "curve": curve,
-         "speedup_3v1": round(curve["workers_3"]
-                              / max(1e-9, curve["workers_1"]), 2),
+         "unit": "MB/s", "curve": curve, "curve_parser_bound": parser,
+         "dispatcher_failover_s": round(_fleet_failover_s(), 3),
          "host_cores": cores}
-    if cores < 3:
+    if cores >= 3:
+        r["speedup_3v1"] = round(curve["workers_3"]
+                                 / max(1e-9, curve["workers_1"]), 2)
+        r["parser_speedup_3v1"] = round(parser["workers_3"]
+                                        / max(1e-9, parser["workers_1"]), 2)
+    else:
         r["note"] = (f"{cores}-core host: dispatcher, consumer and all "
                      "workers share the core(s); curve measures "
-                     "data-service overhead, not fleet scaling")
+                     "data-service overhead, not fleet scaling — "
+                     "speedup keys omitted")
     return r
 
 

@@ -68,11 +68,16 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 #  on shed_pct (via "shed"), rolling_restart_p99_ms (via "p99"/"_ms") and
 #  router_overhead_p50 (via "overhead"); scaling_qps gates higher-better
 #  via "qps".
+#  dispatcher_failover_s (ISSUE 16 dispatcher HA): SIGKILL→journal-replayed
+#  dispatcher answering status — recovery time, lower is better.  The
+#  fleet speedup keys (speedup_3v1 / parser_speedup_3v1) gate
+#  higher-better via "speedup" and are stamped only on hosts with
+#  cores >= workers, so a core-starved runner simply doesn't gate them.
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
                  "errors", "misses", "padding_ratio", "truncated",
                  "epochs_to_converge", "bytes_per_row",
-                 "shed_pct", "rolling_restart_p99_ms")
+                 "shed_pct", "rolling_restart_p99_ms", "failover")
 
 
 def _direction(key: str) -> Optional[str]:

@@ -176,9 +176,9 @@ def lint_rule(name: str, description: str = ""):
 
 def _load_builtin_rules() -> None:
     # import for registration side effects; idempotent via the registry
-    from . import (rules_endpoints, rules_env, rules_io,  # noqa: F401
-                   rules_jit, rules_locks, rules_metrics, rules_spans,
-                   rules_threads, rules_transport)
+    from . import (rules_durable, rules_endpoints, rules_env,  # noqa: F401
+                   rules_io, rules_jit, rules_locks, rules_metrics,
+                   rules_spans, rules_threads, rules_transport)
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterable[str]:

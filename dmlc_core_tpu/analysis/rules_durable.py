@@ -1,0 +1,189 @@
+"""durable-state: journaled state must only change through the journal.
+
+The data-service dispatcher (PR 16) survives SIGKILL by write-ahead
+journaling every lease/registry mutation: append a fsync'd record,
+*then* change the in-memory table.  The failure mode this rule pins is
+the silent hole — a new code path that mutates the lease table (or the
+worker/page registries) without appending, which replays fine in every
+test that doesn't crash at exactly that point and loses rows in the one
+that does.
+
+A class opts in by declaring what is durable::
+
+    class Dispatcher:
+        _DURABLE_STATE = ("_datasets", "_workers", "_pages")
+        _DURABLE_FIELDS = ("state", "lease_epoch", "worker", ...)
+
+Within such a class, any method that mutates a durable container
+(``self._datasets[k] = ...``, ``self._pages.setdefault(...)``) or a
+durable record field (``ls.state = ...``, ``ds.epoch += 1`` — attribute
+stores on non-``self`` names) must also call the journal append API —
+``self._jlog(...)`` or ``self._journal.append(...)``/``compact(...)``
+— somewhere in the same method.  Mutating without journaling is a
+finding.  ``__init__`` is exempt (construction precedes durability) and
+so are ``_restore*`` methods (replay *applies* the journal; appending
+there would double every record).
+
+The granularity is deliberately method-level, not statement-order:
+write-ahead ordering is a runtime property the chaos tests own; the
+lint owns the cheaper invariant that no mutation path forgets the
+journal entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, LintContext, LintRule, ParsedModule, lint_rule
+
+#: container-mutating method names (same vocabulary as lock-discipline)
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "remove", "discard", "clear", "update",
+             "add", "setdefault", "push", "sort", "reverse"}
+#: calls that count as "this method journals"
+_JOURNAL_CALLS = {"_jlog"}
+_JOURNAL_ATTRS = ("_journal",)          # self._journal.append/compact(...)
+
+
+def _is_self(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _tuple_literal(node: Optional[ast.AST]) -> Optional[Sequence[str]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(el, ast.Constant) and isinstance(el.value, str)
+            for el in node.elts):
+        return [el.value for el in node.elts]
+    return None
+
+
+def _durable_decl(cls: ast.ClassDef) -> Tuple[Set[str], Set[str]]:
+    """The class's ``_DURABLE_STATE`` / ``_DURABLE_FIELDS`` tuples, as
+    literal string sets (non-literal declarations are ignored — the
+    contract is a declaration, not a computation)."""
+    state: Set[str] = set()
+    fields: Set[str] = set()
+    for node in cls.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            vals = _tuple_literal(node.value)
+            if vals is None:
+                continue
+            if name == "_DURABLE_STATE":
+                state.update(vals)
+            elif name == "_DURABLE_FIELDS":
+                fields.update(vals)
+    return state, fields
+
+
+class _Scan(ast.NodeVisitor):
+    """Walk one method: collect durable mutations + journal calls."""
+
+    def __init__(self, state: Set[str], fields: Set[str]) -> None:
+        self.state = state
+        self.fields = fields
+        self.journaled = False
+        self.mutations: List[Tuple[str, int, int]] = []
+
+    # -- journal detection ----------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            # self._jlog(...)
+            if f.attr in _JOURNAL_CALLS and _is_self(f.value):
+                self.journaled = True
+            # self._journal.append(...) / .compact(...)
+            inner = f.value
+            if (isinstance(inner, ast.Attribute)
+                    and inner.attr in _JOURNAL_ATTRS
+                    and _is_self(inner.value)):
+                self.journaled = True
+            # container mutators on durable attrs:
+            # self._pages.setdefault(...), self._workers.pop(...)
+            if f.attr in _MUTATORS:
+                obj = f.value
+                if isinstance(obj, ast.Attribute) and _is_self(obj.value) \
+                        and obj.attr in self.state:
+                    self._mutate(obj.attr, node)
+        self.generic_visit(node)
+
+    # -- mutation detection ---------------------------------------------
+    def _mutate(self, what: str, node: ast.AST) -> None:
+        self.mutations.append((what, node.lineno, node.col_offset))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._target(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._target(node.target, node)
+        self.generic_visit(node)
+
+    def _target(self, t: ast.AST, node: ast.AST) -> None:
+        if isinstance(t, ast.Tuple):
+            for el in t.elts:
+                self._target(el, node)
+        elif isinstance(t, ast.Attribute):
+            if _is_self(t.value):
+                # self._datasets = ... (rebinding the whole table)
+                if t.attr in self.state:
+                    self._mutate(t.attr, node)
+            elif isinstance(t.value, ast.Name):
+                # ls.state = ..., ds.epoch += 1 — a durable record field
+                if t.attr in self.fields:
+                    self._mutate(f"{t.value.id}.{t.attr}", node)
+        elif isinstance(t, ast.Subscript):
+            inner = t.value
+            if isinstance(inner, ast.Attribute) and _is_self(inner.value) \
+                    and inner.attr in self.state:
+                # self._datasets[key] = ...
+                self._mutate(inner.attr, node)
+
+    # nested defs: their journal context is the call site's — skip
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+
+@lint_rule("durable-state",
+           description="journaled state mutated outside the journal "
+                       "append API (lost on crash-replay)")
+class DurableStateRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            state, fields = _durable_decl(cls)
+            if not state:
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name in ("__init__", "__new__") \
+                        or meth.name.startswith("_restore"):
+                    continue
+                scan = _Scan(state, fields)
+                for stmt in meth.body:
+                    scan.visit(stmt)
+                if scan.journaled or not scan.mutations:
+                    continue
+                for what, line, col in scan.mutations:
+                    out.append(Finding(
+                        self.name, mod.rel, line, col,
+                        f"{cls.name}.{meth.name} mutates durable "
+                        f"{what!r} without journaling — route the "
+                        f"mutation through the journal append API "
+                        f"(self._jlog) so a crash-replay reproduces it"))
+        return out

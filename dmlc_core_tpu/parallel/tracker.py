@@ -31,6 +31,7 @@ non-JAX host processes, metadata exchange, elastic restart bookkeeping.  The
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import threading
@@ -46,9 +47,23 @@ from ..utils import DMLCError, check, get_env, get_logger, log_info
 from ..utils.metrics import metrics
 
 __all__ = ["RabitTracker", "PSTracker", "LivenessBoard", "compute_tree",
-           "compute_ring", "recv_json", "send_json"]
+           "compute_ring", "recv_json", "send_json", "jittered"]
 
 logger = get_logger()
+
+
+def jittered(interval_s: float) -> float:
+    """``interval_s`` ± ``DMLC_HEARTBEAT_JITTER`` (default 0.2 = ±20%),
+    uniformly drawn per call.  Every periodic re-registration loop
+    (data-service workers, serving replica agents) sleeps through this:
+    a restarted control plane then sees beats *spread over* the interval
+    instead of a thundering herd synchronized by the restart itself."""
+    frac = float(get_env("DMLC_HEARTBEAT_JITTER", 0.2))
+    if frac <= 0.0 or interval_s <= 0.0:
+        return interval_s
+    frac = min(frac, 0.9)
+    spread = random.uniform(-frac, frac)
+    return max(0.001, interval_s * (1.0 + spread))
 
 
 # ---------------- topology math ----------------

@@ -26,11 +26,30 @@ Roles:
 * :class:`~.client.DataServiceLoader` — consumer: concurrent per-worker
   streams, frame-level dedup for replayed leases, mid-epoch failover
   wired through :mod:`dmlc_core_tpu.utils.retry` breakers.
+
+v2 (durable control plane + shared data plane):
+
+* :mod:`.journal` — the dispatcher's fsync'd write-ahead journal +
+  atomic snapshot; ``DMLC_DS_JOURNAL`` makes a SIGKILLed dispatcher
+  resume mid-epoch with ``lease_epoch`` monotonicity intact.
+* shared jobs — ``DMLC_DS_SHARING=shared`` (default) lets N consumers
+  naming one dataset fingerprint join a single epoch, shard leases
+  partitioned first-come with per-consumer affinity.
+* :mod:`.snapshot` — materialize a dataset to packed page files via the
+  normal lease machinery; the dispatcher's page registry then serves
+  every part build-once/serve-many (fd-passed or streamed compressed).
+* :class:`~.autoscale.FleetAutoscaler` — dispatcher-side loop sizing
+  the local worker pool to consumer backlog between
+  ``DMLC_DS_WORKERS_MIN`` and ``DMLC_DS_WORKERS_MAX``.
 """
 
+from .autoscale import FleetAutoscaler  # noqa: F401
 from .client import DataServiceLoader  # noqa: F401
 from .dispatcher import Dispatcher, dispatcher_rpc  # noqa: F401
+from .journal import DispatchJournal, replay_state  # noqa: F401
+from .snapshot import materialize_dataset, snapshot_spec  # noqa: F401
 from .worker import DataServiceWorker  # noqa: F401
 
 __all__ = ["Dispatcher", "DataServiceWorker", "DataServiceLoader",
-           "dispatcher_rpc"]
+           "dispatcher_rpc", "DispatchJournal", "replay_state",
+           "FleetAutoscaler", "materialize_dataset", "snapshot_spec"]

@@ -1,0 +1,237 @@
+"""Fleet autoscaler: size the local worker pool to consumer demand.
+
+The dispatcher-side loop the tf.data-service paper assumes but leaves
+to the cluster manager (PAPERS.md arxiv 2210.14826 §"horizontal
+scaling"): every ``DMLC_DS_AUTOSCALE_INTERVAL`` it folds the signals
+the dispatcher already has — live worker count, outstanding leases,
+the consumers' ``consumer_stats`` backlog reports, and the r14
+:class:`~...telemetry.timeseries.HistoryStore` throughput burn rate —
+into one :func:`FleetAutoscaler.decide` verdict, then spawns or drains
+local worker processes between ``DMLC_DS_WORKERS_MIN`` and
+``DMLC_DS_WORKERS_MAX``.  Every action is journaled and threaded into
+the lease ledger (:meth:`~.dispatcher.Dispatcher.scale_event`), so
+``/leases`` shows fleet-size changes inline with the grants they
+affected and ``/fleet`` carries the scaler's live state.
+
+``decide`` is a pure function over an observation dict and the
+spawn/drain effects are injectable, so the policy is unit-testable
+without processes and the loop is testable without subprocesses.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ...utils import check
+from ...utils.logging import get_logger, log_info
+from ...utils.metrics import metrics
+from ...utils.parameter import get_env
+
+__all__ = ["FleetAutoscaler"]
+
+logger = get_logger()
+
+
+def _default_spawn(dispatcher_addr) -> subprocess.Popen:
+    """Spawn one worker subprocess pointed at the dispatcher (the same
+    invocation the bench harness uses)."""
+    return subprocess.Popen(
+        [sys.executable, "-m", "dmlc_core_tpu.pipeline.data_service.worker",
+         f"{dispatcher_addr[0]}:{dispatcher_addr[1]}"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _default_drain(proc: subprocess.Popen) -> None:
+    """SIGTERM = clean departure: the worker deregisters, held leases
+    re-queue immediately (see ``data_service_worker_main``)."""
+    proc.terminate()
+
+
+class FleetAutoscaler:
+    """Demand-driven worker pool attached to one dispatcher.
+
+    >>> scaler = FleetAutoscaler(dispatcher).start()
+    >>> ...
+    >>> scaler.stop()          # drains every worker it spawned
+
+    ``spawn_fn(dispatcher_addr) -> handle`` and ``drain_fn(handle)``
+    default to subprocess workers; tests inject in-process fakes.
+    """
+
+    def __init__(self, dispatcher, *,
+                 min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 interval_s: Optional[float] = None,
+                 cooldown_s: Optional[float] = None,
+                 spawn_fn: Optional[Callable[[Any], Any]] = None,
+                 drain_fn: Optional[Callable[[Any], None]] = None):
+        self.dispatcher = dispatcher
+        self.min_workers = int(get_env("DMLC_DS_WORKERS_MIN", 0)
+                               if min_workers is None else min_workers)
+        self.max_workers = int(get_env("DMLC_DS_WORKERS_MAX", 4)
+                               if max_workers is None else max_workers)
+        check(0 <= self.min_workers <= self.max_workers,
+              f"DMLC_DS_WORKERS_MIN..MAX must be ordered, got "
+              f"{self.min_workers}..{self.max_workers}")
+        self.interval_s = float(get_env("DMLC_DS_AUTOSCALE_INTERVAL", 2.0)
+                                if interval_s is None else interval_s)
+        self.cooldown_s = float(get_env("DMLC_DS_AUTOSCALE_COOLDOWN", 10.0)
+                                if cooldown_s is None else cooldown_s)
+        self.backlog_high = int(get_env("DMLC_DS_BACKLOG_HIGH", 8))
+        self.backlog_low = int(get_env("DMLC_DS_BACKLOG_LOW", 1))
+        self._spawn_fn = spawn_fn or _default_spawn
+        self._drain_fn = drain_fn or _default_drain
+        self._spawned: List[Any] = []
+        self._lock = threading.Lock()
+        self._stop_ev = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_action_ts = 0.0
+        self._last_action: Optional[str] = None
+        self._last_reason: Optional[str] = None
+        dispatcher.autoscaler = self
+
+    # -- policy (pure) ---------------------------------------------------
+    @staticmethod
+    def decide(obs: Dict[str, Any], min_workers: int,
+               max_workers: int) -> Optional[Dict[str, str]]:
+        """``{"action": "up"|"down", "reason": ...}`` or None.
+
+        Scale up when consumers report backlog pressure above
+        ``DMLC_DS_BACKLOG_HIGH``, when leases are outstanding with no
+        live worker to pull them, or when the fleet is under its floor.
+        Scale down when the fleet idles — no outstanding work, backlog
+        at/under ``DMLC_DS_BACKLOG_LOW`` — above its floor.  ``burn_mb_s``
+        (the HistoryStore's fleet throughput rate) only annotates the
+        reason: a stall is visible in the ledger, not guessed at.
+        """
+        workers = int(obs.get("workers", 0))
+        pending = int(obs.get("pending", 0))
+        granted = int(obs.get("granted", 0))
+        backlog = int(obs.get("backlog", 0))
+        burn = obs.get("burn_mb_s")
+        if workers < min_workers:
+            return {"action": "up",
+                    "reason": f"fleet {workers} under floor {min_workers}"}
+        if workers < max_workers:
+            if pending > 0 and workers == 0:
+                return {"action": "up",
+                        "reason": f"{pending} leases pending, no workers"}
+            if backlog >= max(1, obs.get("backlog_high", 8)):
+                why = f"consumer backlog {backlog}"
+                if burn is not None:
+                    why += f" at {float(burn):.1f} MB/s fleet rate"
+                return {"action": "up", "reason": why}
+        if (workers > min_workers and pending == 0 and granted == 0
+                and backlog <= int(obs.get("backlog_low", 1))):
+            return {"action": "down",
+                    "reason": f"idle fleet of {workers} "
+                              f"(backlog {backlog})"}
+        return None
+
+    # -- observation -----------------------------------------------------
+    def observe(self) -> Dict[str, Any]:
+        d = self.dispatcher
+        fleet = d.fleet_snapshot()
+        workers = sum(1 for w in fleet["workers"].values() if w["alive"])
+        pending = sum(int(s.get("pending", 0))
+                      for s in fleet["datasets"].values())
+        granted = sum(int(s.get("granted", 0))
+                      for s in fleet["datasets"].values())
+        backlog = sum(int(c.get("backlog", 0))
+                      for c in fleet["consumers"].values())
+        burn = self._burn_rate()
+        return {"workers": workers, "pending": pending,
+                "granted": granted, "backlog": backlog,
+                "burn_mb_s": burn, "backlog_high": self.backlog_high,
+                "backlog_low": self.backlog_low}
+
+    def _burn_rate(self) -> Optional[float]:
+        """Mean fleet ingest rate (MB/s) over the last few samples of
+        the dispatcher's HistoryStore — the r14 burn-rate signal, used
+        to annotate scale reasons in the ledger."""
+        history = getattr(self.dispatcher, "history", None)
+        if history is None:
+            return None
+        for name in ("data_service.worker.bytes.windowed_rate",
+                     "data_service.worker.bytes.rate"):
+            pts = history.query(name, since=30.0)
+            if pts:
+                return sum(v for _ts, v in pts) / len(pts) / 1e6
+        return None
+
+    # -- loop ------------------------------------------------------------
+    def start(self) -> "FleetAutoscaler":
+        self._thread = threading.Thread(target=self._run,
+                                        name="ds-autoscale", daemon=True)
+        self._thread.start()
+        log_info("data-service autoscaler: %d..%d workers, every %.1fs",
+                 self.min_workers, self.max_workers, self.interval_s)
+        return self
+
+    def step(self, now: Optional[float] = None) -> Optional[str]:
+        """One evaluate-and-act cycle (the loop body, callable directly
+        by tests).  Returns the action taken, if any."""
+        now = time.monotonic() if now is None else now
+        if now - self._last_action_ts < self.cooldown_s:
+            return None
+        obs = self.observe()
+        verdict = self.decide(obs, self.min_workers, self.max_workers)
+        if verdict is None:
+            return None
+        action, reason = verdict["action"], verdict["reason"]
+        with self._lock:
+            if action == "up":
+                if obs["workers"] >= self.max_workers:
+                    return None
+                handle = self._spawn_fn(getattr(self.dispatcher,
+                                                "address", None))
+                self._spawned.append(handle)
+                metrics.counter("data_service.autoscale.ups").add(1)
+                target = obs["workers"] + 1
+            else:
+                if not self._spawned:
+                    return None     # only drain workers we own
+                handle = self._spawned.pop()
+                self._drain_fn(handle)
+                metrics.counter("data_service.autoscale.downs").add(1)
+                target = max(0, obs["workers"] - 1)
+            self._last_action_ts = now
+            self._last_action = action
+            self._last_reason = reason
+        self.dispatcher.scale_event(action, reason, target)
+        return action
+
+    def _run(self) -> None:
+        while not self._stop_ev.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — the scaler must not
+                # die with the fleet it manages; a bad cycle logs and the
+                # next interval re-evaluates from fresh observations
+                logger.warning("autoscaler: cycle failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            spawned, self._spawned = list(self._spawned), []
+        for handle in spawned:
+            try:
+                self._drain_fn(handle)
+            except Exception as e:  # noqa: BLE001 — best-effort teardown
+                logger.warning("autoscaler: drain failed: %s", e)
+
+    # -- exposition ------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/fleet`` autoscale block."""
+        with self._lock:
+            return {"min": self.min_workers, "max": self.max_workers,
+                    "owned": len(self._spawned),
+                    "last_action": self._last_action,
+                    "last_reason": self._last_reason,
+                    "cooldown_s": self.cooldown_s}

@@ -54,6 +54,16 @@ __all__ = ["DataServiceLoader"]
 
 logger = get_logger()
 
+_consumer_seq = [0]
+_consumer_lock = threading.Lock()
+
+
+def _default_consumer_id() -> str:
+    with _consumer_lock:
+        _consumer_seq[0] += 1
+        return (f"dsc-{socket.gethostname()}-{os.getpid()}-"
+                f"{_consumer_seq[0]}")
+
 
 class DataServiceLoader:
     """Iterate a data-service dataset; each ``__iter__`` is one epoch.
@@ -79,6 +89,10 @@ class DataServiceLoader:
         self.batch_rows = int(spec["batch_rows"])
         self.connect_timeout = float(connect_timeout)
         self.emit = emit
+        # shared-job identity: rides start_epoch (join), every stream
+        # request (lease partitioning) and consumer_stats (liveness) —
+        # the dispatcher's affinity machinery keys on it
+        self.consumer = _default_consumer_id()
         self._depth = max(2, int(prefetch))
         self._pool = _BufPool(cap=2 * self._depth + 2)
         self._closed = False
@@ -110,7 +124,8 @@ class DataServiceLoader:
     # -- epoch machinery -------------------------------------------------
     def _start_epoch(self) -> dict:
         ep = dispatcher_rpc(self.dispatcher,
-                            {"cmd": "start_epoch", "key": self.key})
+                            {"cmd": "start_epoch", "key": self.key,
+                             "consumer": self.consumer})
         listing = dispatcher_rpc(self.dispatcher, {"cmd": "list_workers"})
         workers = listing["workers"]
         if not workers:
@@ -120,6 +135,11 @@ class DataServiceLoader:
         state = {
             "cv": cv, "out": [], "stop": False, "socks": [],
             "epoch": int(ep["epoch"]),
+            # shared jobs partition parts across consumers: this
+            # consumer's `done` ledger may close fewer than num_parts
+            # even in a perfect epoch (the dispatcher's status is the
+            # completion authority then)
+            "sharing": str(ep.get("sharing", "isolated")),
             "live": len(workers), "errs": [],
             # exactly-once ledger: frames delivered per part, and the
             # parts whose shard-end accounting has closed
@@ -210,7 +230,7 @@ class DataServiceLoader:
         for this jobid before), else TCP."""
         li = state.get("lanes", {}).get(jobid)
         if (li and _lane.lane_enabled() and jobid not in self._lane_down
-                and li.get("hostid") == _lane.host_token()
+                and _lane.same_host(li.get("hostid"))
                 and os.path.exists(str(li.get("uds", "")))):
             try:
                 sock = _lane.connect_lane(str(li["uds"]),
@@ -264,6 +284,7 @@ class DataServiceLoader:
                 tid, sid = teltrace.wire_ids()
                 send_json(sock, {
                     "key": self.key, "epoch": state["epoch"],
+                    "consumer": self.consumer,
                     "trace_id": tid, "parent_span": sid,
                     # negotiation offer; a legacy worker ignores this key
                     # and streams the seed framing (no CTRL_TRANSPORT
@@ -495,6 +516,13 @@ class DataServiceLoader:
                     frame = None           # epoch complete
                     break
                 if state["live"] == 0 or state["stop"]:
+                    if self._epoch_done_remote(state):
+                        # shared job: every stream ended cleanly and the
+                        # dispatcher confirms the job's epoch closed —
+                        # the parts this consumer never saw belong to
+                        # its peers' ledgers
+                        frame = None
+                        break
                     errs = list(state["errs"])
                     raise DMLCError(
                         f"data service: epoch incomplete — all workers "
@@ -535,10 +563,28 @@ class DataServiceLoader:
         try:
             dispatcher_rpc(self.dispatcher,
                            {"cmd": "consumer_stats", "key": self.key,
+                            "consumer": self.consumer,
                             "backlog": backlog, "batches": self._batches},
                            timeout=2.0)
         except OSError:
             pass
+
+    def _epoch_done_remote(self, state: dict) -> bool:
+        """Shared-job completion check, called when every stream of this
+        consumer ended without closing all parts locally: the dispatcher
+        is the completion authority for a partitioned epoch.  True iff
+        the job's epoch finished (or a peer already re-armed the next
+        one, which implies ours finished first)."""
+        if state["errs"] or state["stop"]:
+            return False
+        try:
+            st = dispatcher_rpc(self.dispatcher,
+                                {"cmd": "status", "key": self.key},
+                                timeout=5.0)
+        except (OSError, DMLCError):
+            return False
+        return (int(st.get("epoch", 0)) > state["epoch"]
+                or int(st.get("completed", 0)) >= self.num_parts)
 
     def _cancel_readers(self, state: Optional[dict]) -> None:
         if state is None:

@@ -33,13 +33,31 @@ board).  RPCs carrying non-zero ``trace_id``/``parent_span`` ids (see
 :func:`dispatcher_rpc`) are handled under a span parented to the remote
 caller, so a consumer's trace reaches the lease grant that fed it.
 
-The service assumes one consumer per dataset epoch (the trainer); a new
-pass calls ``start_epoch``, which re-arms every shard with a fresh
-lease epoch.
+**Durability (v2).**  With ``DMLC_DS_JOURNAL`` set, every lease/registry
+mutation is appended to a fsync'd write-ahead journal
+(:mod:`.journal`) *before* the in-memory table changes; boot replays
+the snapshot+log, so a SIGKILLed dispatcher restarted at the same
+address resumes mid-epoch: ``lease_epoch`` monotonicity survives,
+stale completions from pre-crash grants stay rejected, and the
+``/leases`` ledger is rebuilt from the journaled transitions.  Workers
+re-register through the heartbeat-is-registration idiom (the serving
+fleet's convention): a heartbeat from an unknown jobid that carries the
+worker's address IS its registration, so the fleet reassembles without
+anyone restarting workers.
+
+**Sharing (v2).**  ``DMLC_DS_SHARING=shared`` (default) makes N
+consumers naming the same dataset fingerprint join one job: a consumer
+that names an in-progress epoch joins it instead of re-arming, and
+shard leases are partitioned across consumers first-come (the lease
+remembers which consumer's stream it was granted under, and replays
+stay with that consumer so per-consumer delivered-frame ledgers keep
+working).  ``isolated`` restores the seed semantics — every
+``start_epoch`` on a touched table re-arms the whole dataset.
 """
 
 from __future__ import annotations
 
+import json
 import socket
 import threading
 import time
@@ -53,12 +71,14 @@ from ...telemetry.aggregate import ResetGuard, merge_states, state_to_snapshot
 from ...telemetry.anomaly import StragglerBoard
 from ...telemetry.exposition import TelemetryServer
 from ...telemetry.timeseries import HistoryStore
+from ...utils import check
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
 from ...utils.parameter import get_env
 from .. import fingerprint as fingerprint_mod
+from . import journal as journal_mod
 
-__all__ = ["Dispatcher", "dispatcher_rpc"]
+__all__ = ["Dispatcher", "dispatcher_rpc", "dispatcher_main"]
 
 logger = get_logger()
 
@@ -66,7 +86,7 @@ logger = get_logger()
 #: construction surface); everything else in a register_dataset spec is
 #: ignored so clients can attach annotations without breaking workers
 _SPEC_KEYS = ("uri", "fmt", "num_parts", "batch_rows", "nnz_cap",
-              "id_mod", "wire_compact", "cache")
+              "id_mod", "wire_compact", "cache", "snapshot")
 
 _PENDING, _GRANTED, _COMPLETED = "pending", "granted", "completed"
 
@@ -102,7 +122,7 @@ class _Lease:
     """One shard's grant bookkeeping (guarded by the dispatcher lock)."""
 
     __slots__ = ("part", "state", "lease_epoch", "worker", "deadline",
-                 "regrants")
+                 "regrants", "consumer")
 
     def __init__(self, part: int):
         self.part = part
@@ -111,6 +131,10 @@ class _Lease:
         self.worker: Optional[str] = None
         self.deadline: Optional[float] = None
         self.regrants = 0
+        # shared-job affinity: the consumer this shard's stream belongs
+        # to (first-come); replays of the lease stay with that consumer
+        # so its delivered-frame ledger can dedup them
+        self.consumer: Optional[str] = None
 
 
 class _Dataset:
@@ -135,18 +159,36 @@ class Dispatcher:
     granted shard may stay unreported before it is re-granted;
     ``heartbeat_timeout_s`` (default ``DMLC_DATA_HEARTBEAT_TIMEOUT``,
     10 s) declares a silent worker dead, which re-grants everything it
-    held immediately instead of waiting out the TTL.
+    held immediately instead of waiting out the TTL.  ``journal``
+    (default ``DMLC_DS_JOURNAL``; empty = ephemeral) is the write-ahead
+    journal path prefix; ``sharing`` (default ``DMLC_DS_SHARING``,
+    ``shared``) picks the multi-consumer epoch semantics.
     """
+
+    # durable-state lint contract: mutations of these attrs (and of
+    # these fields on lease/dataset records) must ride the journal
+    # append API (`_jlog`) in the same method — see analysis/rules_durable
+    _DURABLE_STATE = ("_datasets", "_workers", "_pages")
+    _DURABLE_FIELDS = ("state", "lease_epoch", "worker", "deadline",
+                       "regrants", "epoch", "consumer")
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  lease_ttl_s: Optional[float] = None,
                  heartbeat_timeout_s: Optional[float] = None,
-                 telemetry_port: Optional[int] = None):
+                 telemetry_port: Optional[int] = None,
+                 journal: Optional[str] = None,
+                 sharing: Optional[str] = None):
         if lease_ttl_s is None:
             lease_ttl_s = get_env("DMLC_LEASE_TTL", 30.0)
         if heartbeat_timeout_s is None:
             heartbeat_timeout_s = get_env("DMLC_DATA_HEARTBEAT_TIMEOUT",
                                           10.0)
+        if sharing is None:
+            sharing = str(get_env("DMLC_DS_SHARING", "shared"))
+        self.sharing = sharing.strip().lower() or "shared"
+        check(self.sharing in ("shared", "isolated"),
+              f"DMLC_DS_SHARING must be shared|isolated, "
+              f"got {self.sharing!r}")
         self.lease_ttl_s = float(lease_ttl_s)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.liveness = LivenessBoard(self.heartbeat_timeout_s)
@@ -165,7 +207,16 @@ class Dispatcher:
         # worker, beat wall-times, and consumer backlog reports
         self._worker_states: Dict[str, dict] = {}
         self._last_beat: Dict[str, float] = {}
+        # consumer id → last backlog report (+ the dataset key it names);
+        # doubles as the consumer liveness board for affinity release
         self._consumers: Dict[str, Dict[str, Any]] = {}
+        # build-once/serve-many page registry: key → part → page record
+        self._pages: Dict[str, Dict[int, dict]] = {}
+        # a PENDING shard reserved for a consumer silent longer than this
+        # loses its affinity (a shared job must not wedge on a dead peer)
+        self._consumer_timeout_s = float(
+            get_env("DMLC_DS_CONSUMER_TIMEOUT", 30.0))
+        self.autoscaler = None          # set by FleetAutoscaler(self)
         self.straggler_board = StragglerBoard()
         self._stop_ev = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -191,6 +242,15 @@ class Dispatcher:
                 leases_fn=self.ledger_snapshot,
                 fleet_fn=self.fleet_snapshot,
                 timeline_fn=self.history.timeline)
+        if journal is None:
+            journal = str(get_env("DMLC_DS_JOURNAL", "")) or None
+        self._journal: Optional[journal_mod.DispatchJournal] = None
+        self._journal_snap_every = max(
+            16, int(get_env("DMLC_DS_JOURNAL_SNAP_EVERY", 512)))
+        if journal:
+            self._journal = journal_mod.DispatchJournal(journal)
+            with self._lock:
+                self._restore_locked()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -216,6 +276,16 @@ class Dispatcher:
 
     def stop(self) -> None:
         self._stop_ev.set()
+        if self._journal is not None:
+            # clean shutdown compaction: the next boot replays one
+            # snapshot and an empty log (crash shutdowns replay the log)
+            try:
+                with self._lock:
+                    self._journal.compact(self._durable_state_locked())
+            except OSError as e:
+                logger.warning("dispatcher: journal compaction on stop "
+                               "failed: %s", e)
+            self._journal.close()
         flight_mod.unregister_contributor("lease_ledger")
         self.history.stop()
         if self.telemetry is not None:
@@ -243,8 +313,8 @@ class Dispatcher:
     def dataset_status(self, key: str) -> Dict[str, int]:
         with self._lock:
             ds = self._datasets[key]
-            out = {"epoch": ds.epoch, "pending": 0, "granted": 0,
-                   "completed": 0,
+            out = {"epoch": ds.epoch, "num_parts": len(ds.leases),
+                   "pending": 0, "granted": 0, "completed": 0,
                    "regrants": sum(ls.regrants for ls in ds.leases)}
             for ls in ds.leases:
                 out[ls.state] += 1
@@ -321,14 +391,36 @@ class Dispatcher:
                     "shards": int(shards.get("value", 0) or 0),
                     "straggler": jobid in suspects,
                 }
-            consumers = {key: {"backlog": int(c.get("backlog", 0)),
+            consumers = {cid: {"key": c.get("key"),
+                               "backlog": int(c.get("backlog", 0)),
                                "batches": int(c.get("batches", 0)),
                                "age_s": round(now - c.get("ts", now), 3)}
-                         for key, c in self._consumers.items()}
-        return {"schema": "dmlc.data_service.fleet/1", "ts": time.time(),
+                         for cid, c in self._consumers.items()}
+            pages = {key: len(parts) for key, parts in self._pages.items()}
+        body = {"schema": "dmlc.data_service.fleet/1", "ts": time.time(),
                 "heartbeat_timeout_s": self.heartbeat_timeout_s,
+                "sharing": self.sharing, "durable": self._journal is not None,
                 "workers": workers, "consumers": consumers,
-                "datasets": datasets}
+                "datasets": datasets, "pages": pages}
+        scaler = self.autoscaler
+        if scaler is not None:
+            body["autoscale"] = scaler.snapshot()
+        return body
+
+    def scale_event(self, action: str, reason: str, workers: int) -> None:
+        """Autoscaler hook: one scale decision, journaled and threaded
+        into the lease ledger so /leases shows fleet-size changes inline
+        with the grants they affected."""
+        with self._lock:
+            self._jlog("event", event=f"scale_{action}", reason=reason,
+                       workers=int(workers))
+            self._ledger.append({
+                "ts": time.time(), "key": None, "part": None,
+                "event": f"scale_{action}", "state": None,
+                "lease_epoch": None, "worker": None,
+                "reason": reason, "workers": int(workers)})
+        log_info("dispatcher: autoscale %s (%s) — fleet target %d",
+                 action, reason, workers)
 
     def _beat(self, jobid: str) -> None:
         """Liveness beat + wall-time bookkeeping for /fleet heartbeat age
@@ -336,6 +428,101 @@ class Dispatcher:
         self.liveness.beat(jobid)
         with self._lock:
             self._last_beat[jobid] = time.monotonic()
+
+    # -- durability (call under self._lock) -----------------------------
+    def _jlog(self, op: str, **fields: Any) -> None:
+        """The journal append API: one write-ahead record, fsync'd before
+        the caller's in-memory mutation.  Every durable mutation in this
+        class funnels through here (the `durable-state` lint rule keeps
+        it that way).  No journal configured → durability is off and
+        this is a no-op."""
+        if self._journal is None:
+            return
+        self._journal.append({"op": op, "ts": time.time(), **fields})
+        if self._journal.appends_since_snapshot >= self._journal_snap_every:
+            self._journal.compact(self._durable_state_locked())
+
+    def _durable_state_locked(self) -> Dict[str, Any]:
+        """The snapshot body: everything `_restore_locked` needs to
+        resume mid-epoch (lease table, worker registry, page registry,
+        ledger ring).  Deadlines are NOT persisted — monotonic clocks do
+        not survive a process, so restored grants get a fresh TTL."""
+        return {
+            "datasets": {
+                key: {"spec": dict(ds.spec), "epoch": ds.epoch,
+                      "leases": [{"part": ls.part, "state": ls.state,
+                                  "lease_epoch": ls.lease_epoch,
+                                  "worker": ls.worker,
+                                  "consumer": ls.consumer,
+                                  "regrants": ls.regrants}
+                                 for ls in ds.leases]}
+                for key, ds in self._datasets.items()},
+            "workers": {
+                j: {"host": a[0], "port": a[1],
+                    "uds": self._lanes.get(j, {}).get("uds"),
+                    "hostid": self._lanes.get(j, {}).get("hostid")}
+                for j, a in self._workers.items()},
+            "pages": {key: {str(p): dict(rec) for p, rec in parts.items()}
+                      for key, parts in self._pages.items()},
+            "events": list(self._ledger),
+        }
+
+    def _restore_locked(self) -> None:
+        """Boot-time replay: rebuild the lease table, worker registry,
+        page registry and ledger from the journal, then compact so the
+        reconstructed state becomes the next boot's snapshot.
+
+        Restored GRANTED leases keep their worker and lease_epoch (a
+        surviving worker's completion is accepted, no double-serve) but
+        get a fresh TTL deadline; if the worker never comes back the
+        death/TTL sweep re-grants as usual.  Restored workers get one
+        liveness-grace beat — real survivors re-beat within a heartbeat
+        interval, corpses are swept on the first timeout."""
+        assert self._journal is not None
+        snap, records = self._journal.load()
+        state = journal_mod.replay_state(snap, records)
+        now = time.monotonic()
+        for key, d in state["datasets"].items():
+            ds = _Dataset(key, dict(d["spec"]))
+            ds.epoch = int(d["epoch"])
+            for ls, rec in zip(ds.leases, d["leases"]):
+                ls.state = str(rec["state"])
+                ls.lease_epoch = int(rec["lease_epoch"])
+                ls.worker = rec.get("worker")
+                ls.consumer = rec.get("consumer")
+                ls.regrants = int(rec.get("regrants", 0))
+                if ls.state == _GRANTED:
+                    ls.deadline = now + self.lease_ttl_s
+                if ls.consumer:
+                    # restart grace for the affinity sweep: a consumer
+                    # named only by replayed leases has not reported yet
+                    self._consumers.setdefault(
+                        str(ls.consumer),
+                        {"backlog": 0, "batches": 0, "ts": now,
+                         "key": key})
+            self._datasets[key] = ds
+        for jobid, w in state["workers"].items():
+            if w.get("host") is None or w.get("port") is None:
+                continue
+            self._workers[jobid] = (str(w["host"]), int(w["port"]))
+            if w.get("uds"):
+                self._lanes[jobid] = {"uds": str(w["uds"]),
+                                      "hostid": str(w.get("hostid") or "")}
+            self.liveness.beat(jobid)
+            self._last_beat[jobid] = now
+        for key, parts in state["pages"].items():
+            self._pages[key] = {int(p): dict(rec)
+                                for p, rec in parts.items()}
+        for ev in state["events"]:
+            self._ledger.append(ev)
+        metrics.counter("data_service.journal.replayed").add(len(records))
+        if state["datasets"] or state["workers"]:
+            log_info("dispatcher: journal replay restored %d dataset(s), "
+                     "%d worker(s), %d page(s) from %d record(s)",
+                     len(state["datasets"]), len(state["workers"]),
+                     sum(len(p) for p in state["pages"].values()),
+                     len(records))
+        self._journal.compact(self._durable_state_locked())
 
     # -- lease machinery (call under self._lock) ------------------------
     def _ledger_event(self, key: str, ls: _Lease, event: str,
@@ -350,6 +537,12 @@ class Dispatcher:
             "lease_epoch": ls.lease_epoch, "worker": ls.worker, **extra})
 
     def _regrant(self, key: str, ls: _Lease, why: str) -> None:
+        # consumer affinity survives the regrant on purpose: the replay
+        # must land on the stream whose ledger saw the first delivery,
+        # or a shared job would hand the same rows to a second consumer
+        self._jlog("regrant", key=key, part=ls.part,
+                   lease_epoch=ls.lease_epoch + 1, why=why,
+                   regrants=ls.regrants + 1, consumer=ls.consumer)
         ls.state = _PENDING
         ls.lease_epoch += 1
         ls.worker = None
@@ -359,6 +552,18 @@ class Dispatcher:
         self._ledger_event(key, ls, "regranted", why=why)
         logger.warning("dispatcher: re-granting part %d (%s) — lease "
                        "epoch now %d", ls.part, why, ls.lease_epoch)
+
+    def _release_affinity_locked(self, key: str, ls: _Lease) -> None:
+        """Un-reserve a PENDING shard whose consumer stopped reporting:
+        the next next_lease from ANY consumer's stream may take it."""
+        self._jlog("release", key=key, part=ls.part, consumer=ls.consumer)
+        metrics.counter("data_service.affinity_releases").add(1)
+        self._ledger_event(key, ls, "affinity_released",
+                           consumer=ls.consumer)
+        logger.warning("dispatcher: consumer %r silent > %.1fs — "
+                       "releasing its claim on part %d", ls.consumer,
+                       self._consumer_timeout_s, ls.part)
+        ls.consumer = None
 
     def _sweep_loop(self) -> None:
         interval = max(0.05, min(self.lease_ttl_s,
@@ -371,8 +576,15 @@ class Dispatcher:
                     metrics.counter("data_service.dead_workers").add(1)
                     logger.warning("dispatcher: worker %r silent for "
                                    "%.1fs — declaring dead", jobid, silence)
+                stale_consumers = {
+                    cid for cid, c in self._consumers.items()
+                    if now - float(c.get("ts", 0.0))
+                    > self._consumer_timeout_s}
                 for ds in self._datasets.values():
                     for ls in ds.leases:
+                        if (ls.state == _PENDING and ls.consumer
+                                and ls.consumer in stale_consumers):
+                            self._release_affinity_locked(ds.key, ls)
                         if ls.state != _GRANTED:
                             continue
                         if any(ls.worker == j for j, _ in newly_dead):
@@ -435,6 +647,15 @@ class Dispatcher:
             return self._cmd_deregister_worker(msg)
         if cmd == "heartbeat":
             jobid = str(msg["jobid"])
+            with self._lock:
+                known = jobid in self._workers
+            if not known and msg.get("host") and msg.get("port"):
+                # heartbeat-is-registration (the serving fleet's idiom):
+                # after a dispatcher restart the fleet reassembles from
+                # the beats already in flight — an unknown jobid whose
+                # beat carries its address IS a registration
+                metrics.counter("data_service.reregistrations").add(1)
+                self._register_worker_record(msg)
             self._beat(jobid)
             state = msg.get("state")
             if isinstance(state, dict):
@@ -448,13 +669,20 @@ class Dispatcher:
             return {"ok": True}
         if cmd == "consumer_stats":
             # the client's backlog report — the /fleet console's
-            # consumer-side pressure signal
+            # consumer-side pressure signal, and (v2) the consumer
+            # liveness beat the affinity sweep reads.  Old clients send
+            # no "consumer" id; the dataset key stands in for one.
             with self._lock:
-                self._consumers[str(msg["key"])] = {
+                self._consumers[str(msg.get("consumer", msg["key"]))] = {
+                    "key": str(msg["key"]),
                     "backlog": int(msg.get("backlog", 0)),
                     "batches": int(msg.get("batches", 0)),
                     "ts": time.monotonic()}
             return {"ok": True}
+        if cmd == "register_page":
+            return self._cmd_register_page(msg)
+        if cmd == "lookup_page":
+            return self._cmd_lookup_page(msg)
         if cmd == "list_workers":
             alive = self.workers_alive()
             # "lanes" is a SEPARATE key so the {jobid: [host, port]}
@@ -479,9 +707,18 @@ class Dispatcher:
         return {"error": f"unknown cmd {cmd!r}"}
 
     def _cmd_register_worker(self, msg: dict) -> dict:
+        self._register_worker_record(msg)
+        return {"ok": True}
+
+    def _register_worker_record(self, msg: dict) -> None:
+        """Shared by explicit register_worker and the heartbeat-is-
+        registration path: journal, then mutate the registry."""
         jobid = str(msg["jobid"])
         addr = (str(msg["host"]), int(msg["port"]))
         with self._lock:
+            self._jlog("worker", jobid=jobid, host=addr[0], port=addr[1],
+                       uds=(str(msg["uds"]) if msg.get("uds") else None),
+                       hostid=(str(msg.get("hostid", "")) or None))
             self._workers[jobid] = addr
             if msg.get("uds"):
                 self._lanes[jobid] = {"uds": str(msg["uds"]),
@@ -490,11 +727,11 @@ class Dispatcher:
                 self._lanes.pop(jobid, None)
         self._beat(jobid)
         log_info("dispatcher: worker %r registered at %s:%d", jobid, *addr)
-        return {"ok": True}
 
     def _cmd_deregister_worker(self, msg: dict) -> dict:
         jobid = str(msg["jobid"])
         with self._lock:
+            self._jlog("worker_gone", jobid=jobid)
             self._workers.pop(jobid, None)
             self._lanes.pop(jobid, None)
             self._worker_states.pop(jobid, None)
@@ -509,19 +746,64 @@ class Dispatcher:
         self.liveness.forget(jobid)
         return {"ok": True}
 
+    def _cmd_register_page(self, msg: dict) -> dict:
+        """A worker finished building a page-cache shard: record it
+        build-once/serve-many.  Colocated workers answer later leases of
+        this shard straight from the page file (fd-passed on UNIX lanes,
+        streamed compressed to remote consumers) — the parse/pack cost
+        is paid once per fleet, not once per consumer."""
+        key = str(msg["key"])
+        part = int(msg["part"])
+        rec = {"path": str(msg["path"]),
+               "hostid": str(msg.get("hostid", "")),
+               "jobid": str(msg.get("jobid", "")),
+               "pages": int(msg.get("pages", 0))}
+        with self._lock:
+            ds = self._datasets.get(key)
+            if ds is None or not 0 <= part < len(ds.leases):
+                return {"error": f"register_page: unknown {key}[{part}]"}
+            self._jlog("page", key=key, part=part, **rec)
+            self._pages.setdefault(key, {})[part] = rec
+            self._ledger.append({
+                "ts": time.time(), "key": key, "part": part,
+                "event": "page_registered", "state": None,
+                "lease_epoch": None, "worker": rec["jobid"],
+                "pages": rec["pages"]})
+        metrics.counter("data_service.pages_registered").add(1)
+        return {"ok": True}
+
+    def _cmd_lookup_page(self, msg: dict) -> dict:
+        """Page-registry lookup, filtered by host identity: a page file
+        is only reachable from the kernel that wrote it, so a lookup
+        carrying a foreign hostid answers None rather than a path the
+        caller cannot open."""
+        key = str(msg["key"])
+        part = int(msg["part"])
+        hostid = str(msg.get("hostid", ""))
+        with self._lock:
+            rec = self._pages.get(key, {}).get(part)
+        if rec is None or (hostid and rec.get("hostid") != hostid):
+            return {"page": None}
+        return {"page": dict(rec)}
+
     def _cmd_register_dataset(self, msg: dict) -> dict:
         spec = {k: msg["spec"][k] for k in _SPEC_KEYS if k in msg["spec"]}
         for req in ("uri", "fmt", "num_parts", "batch_rows", "nnz_cap"):
             if req not in spec:
                 return {"error": f"dataset spec missing {req!r}"}
+        # snapshot jobs live in their own key namespace: a materialize
+        # run and a plain consumer naming the same source must NOT share
+        # a dataset entry (the snapshot spec serves empty brackets)
         key = fingerprint_mod.autotune_key(
             {k: spec[k] for k in ("uri", "fmt", "num_parts", "batch_rows",
                                   "nnz_cap") if k in spec},
-            platform="data_service")
+            platform=("data_service.snapshot" if spec.get("snapshot")
+                      else "data_service"))
         with self._lock:
             ds = self._datasets.get(key)
             if ds is None:
                 ds = _Dataset(key, spec)
+                self._jlog("dataset", key=key, spec=spec, epoch=ds.epoch)
                 self._datasets[key] = ds
                 log_info("dispatcher: dataset %s registered (%d parts, "
                          "uri=%s)", key, len(ds.leases), spec["uri"])
@@ -529,36 +811,66 @@ class Dispatcher:
                     "epoch": ds.epoch}
 
     def _cmd_start_epoch(self, msg: dict) -> dict:
+        consumer = msg.get("consumer")
         with self._lock:
             ds = self._datasets[str(msg["key"])]
             touched = any(ls.state != _PENDING or ls.regrants
                           for ls in ds.leases)
-            if touched:
+            finished = all(ls.state == _COMPLETED for ls in ds.leases)
+            # shared mode (tf.data-service shared jobs): a consumer
+            # naming an in-progress dataset JOINS the running epoch;
+            # only a finished table re-arms.  isolated keeps the seed
+            # semantics — any touched table re-arms, each consumer
+            # drives its own full pass.
+            rearm = finished if self.sharing == "shared" else touched
+            if touched and rearm:
                 # re-arm every shard under a fresh lease epoch; grants
                 # still in flight from the previous pass become stale
+                self._jlog("epoch", key=ds.key, epoch=ds.epoch + 1,
+                           lease_epochs=[ls.lease_epoch + 1
+                                         for ls in ds.leases])
                 ds.epoch += 1
                 for ls in ds.leases:
                     ls.state = _PENDING
                     ls.lease_epoch += 1
                     ls.worker = None
                     ls.deadline = None
+                    ls.consumer = None
                 self._ledger.append({
                     "ts": time.time(), "key": ds.key, "part": None,
                     "event": "epoch_started", "state": _PENDING,
                     "lease_epoch": None, "worker": None,
                     "epoch": ds.epoch, "num_parts": len(ds.leases)})
-            return {"epoch": ds.epoch, "num_parts": len(ds.leases)}
+            if consumer is not None:
+                # joining the job doubles as the consumer's first
+                # liveness beat (the affinity sweep reads these)
+                self._consumers[str(consumer)] = {
+                    "key": ds.key, "backlog": 0, "batches": 0,
+                    "ts": time.monotonic()}
+            return {"epoch": ds.epoch, "num_parts": len(ds.leases),
+                    "sharing": self.sharing}
 
     def _cmd_next_lease(self, msg: dict) -> dict:
         jobid = str(msg["jobid"])
+        consumer = msg.get("consumer")
+        consumer = None if consumer is None else str(consumer)
         self._beat(jobid)
         with self._lock:
             ds = self._datasets[str(msg["key"])]
             grant: Optional[_Lease] = None
             outstanding = False
             for ls in ds.leases:
-                if ls.state == _PENDING and grant is None:
-                    grant = ls
+                if ls.state == _PENDING:
+                    # first-come dynamic split: an unclaimed shard goes
+                    # to whichever consumer's stream asks first; a shard
+                    # already claimed (or replaying) only goes back to
+                    # its own consumer's streams
+                    if (ls.consumer is None or consumer is None
+                            or ls.consumer == consumer):
+                        if grant is None:
+                            grant = ls
+                    else:
+                        outstanding = True
                 elif ls.state == _GRANTED:
                     outstanding = True
             if grant is None:
@@ -567,6 +879,11 @@ class Dispatcher:
                 # — the worker must keep polling so a failed lease finds
                 # a living server
                 return {"status": "wait" if outstanding else "done"}
+            if consumer is not None and self.sharing == "shared":
+                grant.consumer = consumer
+            self._jlog("grant", key=ds.key, part=grant.part,
+                       lease_epoch=grant.lease_epoch, worker=jobid,
+                       consumer=grant.consumer)
             grant.state = _GRANTED
             grant.worker = jobid
             grant.deadline = time.monotonic() + self.lease_ttl_s
@@ -606,6 +923,9 @@ class Dispatcher:
                     ls.state)
                 return {"ok": False, "stale": True}
             completed_by = ls.worker
+            self._jlog("complete", key=ds.key, part=ls.part,
+                       lease_epoch=ls.lease_epoch, worker=None,
+                       by=completed_by)
             ls.state = _COMPLETED
             ls.worker = None
             ls.deadline = None
@@ -628,3 +948,47 @@ class Dispatcher:
                                why=str(msg.get("why", "reported failed")))
             self._regrant(ds.key, ls, str(msg.get("why", "reported failed")))
             return {"ok": True}
+
+
+def dispatcher_main(argv=None) -> int:
+    """CLI: ``python -m dmlc_core_tpu.pipeline.data_service.dispatcher
+    [host=H] [port=N] [journal=PREFIX] [sharing=MODE] [autoscale=1]`` —
+    serve until killed.
+
+    This is the chaos-drill surface: the failover tests run the
+    dispatcher as a subprocess, SIGKILL it mid-epoch, and restart it
+    with the same ``port=`` and ``journal=`` to prove the journal replay
+    resumes the epoch.  The bound port is printed as one JSON line on
+    stdout (``{"host": ..., "port": ...}``) so a parent that asked for
+    ``port=0`` learns where the dispatcher landed.  SIGTERM is a clean
+    stop (journal compacted); SIGKILL is the crash the journal exists
+    for."""
+    import signal
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    kw = dict(a.split("=", 1) for a in args)
+    d = Dispatcher(host=kw.get("host", "127.0.0.1"),
+                   port=int(kw.get("port", 0)),
+                   journal=kw.get("journal") or None,
+                   sharing=kw.get("sharing") or None)
+    if kw.get("autoscale", "") not in ("", "0", "false"):
+        from .autoscale import FleetAutoscaler
+        FleetAutoscaler(d).start()
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
+    d.start()
+    print(json.dumps({"host": d.host, "port": d.port}), flush=True)
+    try:
+        while not done.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    if d.autoscaler is not None:
+        d.autoscaler.stop()
+    d.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(dispatcher_main())

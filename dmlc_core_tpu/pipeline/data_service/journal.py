@@ -1,0 +1,233 @@
+"""Dispatcher write-ahead journal: fsync'd records + atomic snapshots.
+
+The dispatcher's lease table is the only state in the data service that
+cannot be regenerated: workers re-register on their next heartbeat and
+consumers redial, but which shard is COMPLETED under which
+``lease_epoch`` exists nowhere else — lose it and a restarted
+dispatcher re-serves finished shards (duplicate rows) or accepts stale
+completions (missing rows).  So every durable mutation appends one
+JSON-line record here *before* the in-memory table changes
+(write-ahead), each line fsync'd, and boot replays the log over the
+last snapshot.
+
+Two files under one ``DMLC_DS_JOURNAL`` prefix:
+
+* ``<prefix>.log`` — append-only JSON-lines; a torn tail (crash inside
+  a write) is tolerated by stopping replay at the first undecodable
+  line, same as a page file's missing footer.
+* ``<prefix>.snap`` — the full state as one JSON document, written with
+  the :mod:`..page_cache` crash-safety idiom (``.tmp.<pid>`` + fsync +
+  ``os.replace``) so a crash mid-snapshot leaves the previous snapshot
+  intact.
+
+Records carry *resulting* values (the new ``lease_epoch``, the granted
+worker) rather than deltas, which makes replay idempotent: a crash
+between snapshot replace and log truncation re-applies logged records
+onto a snapshot that already includes them and lands on the same state.
+:func:`replay_state` is a pure function over ``(snapshot, records)`` —
+the property tests drive it directly over every record prefix.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...utils.logging import get_logger
+from ...utils.metrics import metrics
+
+__all__ = ["DispatchJournal", "replay_state", "SNAP_SCHEMA", "LOG_SCHEMA"]
+
+logger = get_logger()
+
+SNAP_SCHEMA = "dmlc.data_service.snapshot/1"
+LOG_SCHEMA = "dmlc.data_service.journal/1"
+
+_PENDING, _GRANTED, _COMPLETED = "pending", "granted", "completed"
+
+
+class DispatchJournal:
+    """Append-only journal + snapshot pair under one path prefix."""
+
+    def __init__(self, prefix: str):
+        self.prefix = str(prefix)
+        self.log_path = self.prefix + ".log"
+        self.snap_path = self.prefix + ".snap"
+        d = os.path.dirname(os.path.abspath(self.log_path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(self.log_path, "ab")
+        self.appends_since_snapshot = 0
+
+    # -- write side ------------------------------------------------------
+    def append(self, record: Dict[str, Any]) -> None:
+        """One fsync'd JSON line; durable before the caller's in-memory
+        mutation proceeds (write-ahead ordering)."""
+        line = json.dumps(record, sort_keys=True, default=str) + "\n"
+        self._f.write(line.encode("utf-8"))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self.appends_since_snapshot += 1
+        metrics.counter("data_service.journal.appends").add(1)
+
+    def compact(self, state: Dict[str, Any]) -> None:
+        """Atomic-rename snapshot of ``state``, then truncate the log.
+        Crash windows: before the replace → old snapshot + full log
+        (nothing lost); between replace and truncation → new snapshot +
+        old log, whose records re-apply idempotently."""
+        doc = {"schema": SNAP_SCHEMA, **state}
+        tmp = f"{self.snap_path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        self._f.close()
+        self._f = open(self.log_path, "wb")
+        os.fsync(self._f.fileno())
+        self.appends_since_snapshot = 0
+        metrics.counter("data_service.journal.snapshots").add(1)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    # -- read side -------------------------------------------------------
+    def load(self) -> Tuple[Optional[Dict[str, Any]],
+                            List[Dict[str, Any]]]:
+        """``(snapshot|None, records)`` as found on disk.  A snapshot
+        that fails to parse is discarded (the log alone rebuilds state
+        from genesis); replay of the log stops at the first torn line."""
+        snap: Optional[Dict[str, Any]] = None
+        try:
+            with open(self.snap_path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if doc.get("schema") == SNAP_SCHEMA:
+                snap = doc
+        except (OSError, ValueError):
+            snap = None
+        records: List[Dict[str, Any]] = []
+        try:
+            with open(self.log_path, encoding="utf-8") as f:
+                for line in f:
+                    if not line.endswith("\n"):
+                        break               # torn tail: crash mid-append
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        break
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except OSError:
+            pass
+        return snap, records
+
+
+def _blank_state() -> Dict[str, Any]:
+    return {"datasets": {}, "workers": {}, "pages": {}, "events": []}
+
+
+def replay_state(snapshot: Optional[Dict[str, Any]],
+                 records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pure replay: apply ``records`` in order over ``snapshot`` (or a
+    blank state).  Unknown ops are skipped (forward compatibility);
+    records referencing datasets the prefix never registered are skipped
+    too, so *any* prefix of a valid log replays without error — the
+    property the journal tests pin.
+
+    State shape (all JSON)::
+
+        {"datasets": {key: {"spec": {...}, "epoch": int,
+                            "leases": [{"part", "state", "lease_epoch",
+                                        "worker", "consumer",
+                                        "regrants"}, ...]}},
+         "workers": {jobid: {"host", "port", "uds", "hostid"}},
+         "pages":   {key: {part: {"path", "hostid", "jobid", "pages"}}},
+         "events":  [ledger events]}
+    """
+    state = _blank_state()
+    if snapshot:
+        for k in ("datasets", "workers", "pages", "events"):
+            v = snapshot.get(k)
+            if isinstance(v, (dict, list)):
+                state[k] = json.loads(json.dumps(v))   # deep copy
+    for rec in records:
+        op = rec.get("op")
+        if op == "dataset":
+            key = str(rec["key"])
+            if key not in state["datasets"]:
+                spec = dict(rec.get("spec") or {})
+                n = int(spec.get("num_parts", 0))
+                state["datasets"][key] = {
+                    "spec": spec, "epoch": int(rec.get("epoch", 1)),
+                    "leases": [{"part": p, "state": _PENDING,
+                                "lease_epoch": 1, "worker": None,
+                                "consumer": None, "regrants": 0}
+                               for p in range(n)]}
+        elif op == "epoch":
+            ds = state["datasets"].get(str(rec.get("key")))
+            if ds is not None:
+                ds["epoch"] = int(rec["epoch"])
+                epochs = rec.get("lease_epochs") or []
+                for i, ls in enumerate(ds["leases"]):
+                    ls["state"] = _PENDING
+                    if i < len(epochs):
+                        ls["lease_epoch"] = max(int(ls["lease_epoch"]),
+                                                int(epochs[i]))
+                    ls["worker"] = None
+                    ls["consumer"] = None
+        elif op in ("grant", "complete", "regrant", "release"):
+            ds = state["datasets"].get(str(rec.get("key")))
+            if ds is None:
+                continue
+            part = int(rec.get("part", -1))
+            if not 0 <= part < len(ds["leases"]):
+                continue
+            ls = ds["leases"][part]
+            if op == "grant":
+                ls["state"] = _GRANTED
+                ls["lease_epoch"] = max(int(ls["lease_epoch"]),
+                                        int(rec["lease_epoch"]))
+                ls["worker"] = rec.get("worker")
+                if rec.get("consumer") is not None:
+                    ls["consumer"] = rec["consumer"]
+            elif op == "complete":
+                if int(rec["lease_epoch"]) >= int(ls["lease_epoch"]):
+                    ls["state"] = _COMPLETED
+                    ls["lease_epoch"] = int(rec["lease_epoch"])
+                    ls["worker"] = None
+            elif op == "regrant":
+                ls["state"] = _PENDING
+                ls["lease_epoch"] = max(int(ls["lease_epoch"]),
+                                        int(rec["lease_epoch"]))
+                ls["worker"] = None
+                ls["regrants"] = int(rec.get("regrants",
+                                             ls["regrants"] + 1))
+            else:                           # release: consumer affinity
+                ls["consumer"] = None
+        elif op == "worker":
+            state["workers"][str(rec["jobid"])] = {
+                "host": rec.get("host"), "port": rec.get("port"),
+                "uds": rec.get("uds"), "hostid": rec.get("hostid")}
+        elif op == "worker_gone":
+            state["workers"].pop(str(rec.get("jobid")), None)
+        elif op == "page":
+            key = str(rec.get("key"))
+            state["pages"].setdefault(key, {})[str(rec.get("part"))] = {
+                "path": rec.get("path"), "hostid": rec.get("hostid"),
+                "jobid": rec.get("jobid"),
+                "pages": int(rec.get("pages", 0))}
+        # op == "event" (scale events etc.) carries no table state; the
+        # dispatcher re-threads it into the ledger ring below either way
+        if op is not None:
+            ev = {k: v for k, v in rec.items() if k != "op"}
+            ev.setdefault("event", {"grant": "granted",
+                                    "complete": "completed",
+                                    "regrant": "regranted"}.get(op, op))
+            state["events"].append(ev)
+    cap = 4096
+    if len(state["events"]) > cap:
+        state["events"] = state["events"][-cap:]
+    return state

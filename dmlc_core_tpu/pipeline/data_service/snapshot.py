@@ -1,0 +1,94 @@
+"""Dataset snapshots: materialize a whole dataset to packed page files.
+
+tf.data's snapshot idea (PAPERS.md arxiv 2101.12127) on top of the
+lease machinery: a *snapshot job* is an ordinary dataset registration
+whose spec carries ``snapshot: true`` and a per-part ``cache`` template
+(``.../part{part}.pages``).  Workers that pull its leases drain the
+shard through their normal :class:`~..device_loader.DeviceLoader`
+write-through build — finalizing one page file per part — and deliver
+**no data frames**: each shard closes with an empty begin/end bracket,
+so the driving consumer's ledger completes the epoch having moved zero
+payload bytes.  Every materialized part is then registered
+build-once/serve-many with the dispatcher, so later consumers of the
+*same* dataset spec are served from the page files (fd-passed when
+colocated, streamed compressed when remote).
+
+Because a snapshot is just an epoch, it inherits everything the lease
+machinery already does: failed workers re-grant, a SIGKILLed dispatcher
+replays its journal mid-snapshot, and progress shows on ``/leases``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+from ...utils import check
+from ...utils.logging import get_logger, log_info
+from ...utils.metrics import metrics
+
+__all__ = ["snapshot_spec", "cached_spec", "materialize_dataset"]
+
+logger = get_logger()
+
+
+def snapshot_spec(spec: dict, out_dir: str) -> dict:
+    """The snapshot-job variant of ``spec``: same source/pack geometry
+    (so the page fingerprints match later cached reads), ``snapshot``
+    flagged, and ``cache`` pointed at one page file per part under
+    ``out_dir``."""
+    snap = dict(spec)
+    snap["snapshot"] = True
+    snap["cache"] = os.path.join(str(out_dir), "part{part}.pages")
+    return snap
+
+
+def cached_spec(spec: dict, out_dir: str) -> dict:
+    """The *consumer* spec that rides a finished snapshot: same dataset,
+    ``cache`` pointed at the materialized page files.  Workers serving
+    it hit the validated pages (mmap replay, no parse), register them
+    build-once/serve-many, and fd-pass them to colocated consumers."""
+    rd = dict(spec)
+    rd.pop("snapshot", None)
+    rd["cache"] = os.path.join(str(out_dir), "part{part}.pages")
+    return rd
+
+
+def materialize_dataset(dispatcher: Tuple[str, int], spec: dict,
+                        out_dir: str) -> Dict[int, str]:
+    """Drive one snapshot job to completion and return
+    ``{part: page_file_path}`` for every materialized part.
+
+    The job is a normal epoch: this function registers the snapshot
+    variant of ``spec``, consumes the (frame-less) epoch, and verifies
+    every part's page file landed.  Workers do the building; the caller
+    only needs dispatcher reachability, not source-data access.
+    """
+    from .client import DataServiceLoader
+    os.makedirs(str(out_dir), exist_ok=True)
+    snap = snapshot_spec(spec, out_dir)
+    with DataServiceLoader(dispatcher, snap) as loader:
+        n = 0
+        for item in loader:
+            # snapshot shards are empty brackets; any frame that does
+            # arrive is recycled and ignored (a worker running older
+            # code would stream normally — the snapshot still builds)
+            loader.recycle(item[1])
+            n += 1
+        num_parts = loader.num_parts
+    out: Dict[int, str] = {}
+    missing: List[int] = []
+    for part in range(num_parts):
+        path = snap["cache"].format(part=part)
+        if os.path.exists(path):
+            out[part] = path
+        else:
+            missing.append(part)
+    check(not missing,
+          f"snapshot epoch completed but parts {missing} left no page "
+          f"file under {out_dir} (worker-side build failed?)")
+    metrics.counter("data_service.snapshots").add(1)
+    log_info("data service: snapshot of %s materialized %d part(s) "
+             "under %s (%d stray frames)", snap.get("uri"), len(out),
+             out_dir, n)
+    return out

@@ -33,7 +33,7 @@ import threading
 import time
 from typing import Optional, Tuple
 
-from ...parallel.tracker import recv_json, send_json
+from ...parallel.tracker import jittered, recv_json, send_json
 from ...telemetry import trace as teltrace
 from ...transport import frames as _wire
 from ...transport import lane as _lane
@@ -43,7 +43,8 @@ from ...utils.metrics import metrics
 from ...utils.parameter import get_env
 from ...utils.retry import RetryPolicy
 from .. import page_cache
-from ..ingest_service import _FRAME, _send_all, stream_epoch_frames
+from ..ingest_service import (_FRAME, _NO_ROWS, _send_all,
+                              stream_epoch_frames)
 from .dispatcher import dispatcher_rpc
 
 __all__ = ["DataServiceWorker", "CTRL_SHARD_BEGIN", "CTRL_SHARD_END",
@@ -94,6 +95,15 @@ class DataServiceWorker:
                                     10.0)) / 3.0)
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.lease_poll_s = float(lease_poll_s)
+        # bounded retry for mid-stream control RPCs (next_lease,
+        # complete_lease): a dispatcher restart must look like a long
+        # RPC, not a dead stream — the journal replay on the other side
+        # is what makes retrying correct
+        self._ctrl_retry = RetryPolicy(
+            max_attempts=int(get_env("DMLC_DS_CTRL_RETRIES", 20)),
+            base_delay_s=0.1, max_delay_s=1.0,
+            retryable=lambda e: isinstance(e, OSError),
+            name="data_service.ctrl")
         self._stop_ev = threading.Event()
         self._threads: list = []
         self._conn_lock = threading.Lock()
@@ -193,15 +203,23 @@ class DataServiceWorker:
 
     # -- control plane ---------------------------------------------------
     def _heartbeat_loop(self) -> None:
-        while not self._stop_ev.wait(self.heartbeat_interval_s):
+        # jittered interval (±DMLC_HEARTBEAT_JITTER): a restarted
+        # dispatcher must not take every worker's re-registration beat
+        # in the same instant
+        while not self._stop_ev.wait(jittered(self.heartbeat_interval_s)):
             try:
-                # the beat doubles as the fleet-console metrics push: the
-                # dispatcher merges these states into /fleet (same
-                # mergeable-state payload ranks push to the tracker)
-                dispatcher_rpc(self.dispatcher,
-                               {"cmd": "heartbeat", "jobid": self.jobid,
-                                "state": metrics.state()},
-                               timeout=5.0)
+                # the beat doubles as the fleet-console metrics push (the
+                # dispatcher merges these states into /fleet) AND as the
+                # re-registration path: it carries the worker's address,
+                # so a restarted dispatcher that has never heard of this
+                # jobid treats the beat itself as the registration
+                beat = {"cmd": "heartbeat", "jobid": self.jobid,
+                        "host": self.host, "port": self.port,
+                        "state": metrics.state()}
+                if self.uds_path is not None:
+                    beat["uds"] = self.uds_path
+                    beat["hostid"] = _lane.host_token()
+                dispatcher_rpc(self.dispatcher, beat, timeout=5.0)
             except OSError as e:
                 logger.warning("worker %s: heartbeat failed: %s",
                                self.jobid, e)
@@ -234,6 +252,10 @@ class DataServiceWorker:
             if req is None:
                 return
             key = str(req["key"])
+            # shared-job identity: the consumer id rides every next_lease
+            # so the dispatcher can partition shards across consumers
+            consumer = req.get("consumer")
+            consumer = None if consumer is None else str(consumer)
             # transport negotiation: only a hello carrying a "transport"
             # dict gets the CTRL_TRANSPORT reply — a legacy consumer sends
             # none and is served the seed framing verbatim
@@ -260,7 +282,7 @@ class DataServiceWorker:
                                   compress=neg["compress"] if neg else None
                                   ) as sp:
                 sp.attrs["shards"] = self._serve_stream(
-                    conn, key, writer, neg)
+                    conn, key, writer, neg, consumer)
         except FaultInjected as e:
             # chaos schedule says this worker dies NOW: no lease cleanup,
             # no deregistration — the fleet must absorb a real crash
@@ -280,14 +302,19 @@ class DataServiceWorker:
 
     def _serve_stream(self, conn: socket.socket, key: str,
                       writer: _wire.FrameWriter,
-                      neg: Optional[dict] = None) -> int:
+                      neg: Optional[dict] = None,
+                      consumer: Optional[str] = None) -> int:
         """Pull leases for ``key`` until the dispatcher says the epoch is
         done; serve each over ``conn``.  Returns shards served."""
         shards = 0
         while not self._stop_ev.is_set():
-            reply = dispatcher_rpc(
-                self.dispatcher,
-                {"cmd": "next_lease", "key": key, "jobid": self.jobid})
+            ask = {"cmd": "next_lease", "key": key, "jobid": self.jobid}
+            if consumer is not None:
+                ask["consumer"] = consumer
+            # retried across a dispatcher restart: the stream outlives
+            # the control plane's failover window
+            reply = self._ctrl_retry.call(dispatcher_rpc,
+                                          self.dispatcher, ask)
             if reply.get("status") == "done":
                 writer.control(0, 0, 0)                 # stream end
                 writer.flush()
@@ -323,6 +350,79 @@ class DataServiceWorker:
         metrics.counter("data_service.worker.fdpass_shards").add(1)
         return npages
 
+    def _lookup_page(self, key: str, part: int) -> Optional[dict]:
+        """Ask the build-once/serve-many registry whether someone on this
+        host already packed this shard; None on any failure (the advert
+        is an optimization — building locally is always correct)."""
+        try:
+            reply = dispatcher_rpc(
+                self.dispatcher,
+                {"cmd": "lookup_page", "key": key, "part": part,
+                 "hostid": _lane.host_token()}, timeout=5.0)
+        except (OSError, DMLCError):
+            return None
+        rec = reply.get("page")
+        return rec if isinstance(rec, dict) else None
+
+    def _register_page(self, key: str, part: int, loader) -> None:
+        """Advertise a freshly built (validated) page file to the
+        dispatcher's registry so fleet peers on this host serve it
+        instead of re-packing.  Best-effort: losing the advert costs a
+        rebuild, never correctness."""
+        try:
+            path = loader.cached_page_file()
+            if path is None:
+                return
+            info = page_cache.page_file_info(path)
+            if info is None:
+                return
+            dispatcher_rpc(
+                self.dispatcher,
+                {"cmd": "register_page", "key": key, "part": part,
+                 "path": path, "hostid": _lane.host_token(),
+                 "jobid": self.jobid, "pages": info["pages"]}, timeout=5.0)
+        except (OSError, DMLCError):
+            pass
+
+    def _serve_page_shard(self, conn: socket.socket, part: int,
+                          lease_epoch: int, path: str,
+                          writer: _wire.FrameWriter,
+                          neg: Optional[dict]
+                          ) -> Optional[Tuple[int, int]]:
+        """Serve a shard straight from a registered page file: fd-pass it
+        whole on a negotiated UNIX lane, else stream the mmap'd pages
+        (compressed when the stream negotiated a codec).  Returns
+        ``(frames, bytes)`` or None when the file is unusable — the
+        caller falls back to a local build."""
+        try:
+            if neg and neg.get("fdpass"):
+                frames = self._serve_fd_shard(conn, part, lease_epoch,
+                                              path)
+                metrics.counter(
+                    "data_service.worker.page_serves").add(1)
+                return frames, 0
+            reader = page_cache.PageCacheReader(path, readahead=0)
+        except (OSError, page_cache.PageCacheError) as e:
+            log_info("worker %s: registered page %s unusable (%r) — "
+                     "building locally", self.jobid, path, e)
+            return None
+        try:
+            writer.control(part, CTRL_SHARD_BEGIN, lease_epoch)
+            frames = 0
+            sent = 0
+            for meta, rows, view in reader.pages():
+                sent += writer.send_frame(
+                    int(meta), view.size,
+                    _NO_ROWS if rows is None else int(rows),
+                    memoryview(view).cast("B"))
+                frames += 1
+            writer.control(part, CTRL_SHARD_END, frames)
+            writer.flush()
+        finally:
+            reader.close()
+        metrics.counter("data_service.worker.page_serves").add(1)
+        return frames, sent
+
     def _serve_shard(self, conn: socket.socket, key: str, lease: dict,
                      writer: _wire.FrameWriter,
                      neg: Optional[dict] = None) -> None:
@@ -332,6 +432,11 @@ class DataServiceWorker:
         lease_epoch = int(lease["lease_epoch"])
         spec = lease["spec"]
         batch_rows = int(spec["batch_rows"])
+        cache = spec.get("cache", "auto")
+        if isinstance(cache, str) and "{part}" in cache:
+            # per-part page files: snapshot jobs (and any multi-part
+            # cached spec) name one template for the whole dataset
+            cache = cache.format(part=part)
         # chaos probe: an injected error here is a worker death scheduled
         # between lease grant and first frame — the FaultInjected escalates
         # to kill() in the connection handler
@@ -341,6 +446,29 @@ class DataServiceWorker:
             with teltrace.span("data_service.serve_shard", part=part,
                                lease_epoch=lease_epoch,
                                worker=self.jobid) as sp:
+                if not spec.get("snapshot"):
+                    # build-once/serve-many: a shard a fleet peer on this
+                    # host already packed serves from its page file — the
+                    # parse/pack cost was paid once, by whoever built it
+                    rec = self._lookup_page(key, part)
+                    if rec is not None:
+                        served = self._serve_page_shard(
+                            conn, part, lease_epoch, str(rec["path"]),
+                            writer, neg)
+                        if served is not None:
+                            frames, sent = served
+                            sp.attrs.update(frames=frames, bytes=sent,
+                                            shared_page=True)
+                            metrics.counter(
+                                "data_service.worker.shards").add(1)
+                            metrics.throughput(
+                                "data_service.worker.bytes").add(int(sent))
+                            self._ctrl_retry.call(
+                                dispatcher_rpc, self.dispatcher,
+                                {"cmd": "complete_lease", "key": key,
+                                 "part": part, "lease_epoch": lease_epoch,
+                                 "jobid": self.jobid})
+                            return
                 # single-threaded parse per shard: frame sequences must be
                 # deterministic so a survivor's replay is byte-identical
                 # (the consumer dedups by frame index)
@@ -351,28 +479,48 @@ class DataServiceWorker:
                     batch_rows=batch_rows, nnz_cap=int(spec["nnz_cap"]),
                     id_mod=int(spec.get("id_mod", 0)),
                     wire_compact=spec.get("wire_compact", "auto"),
-                    emit="host", cache=spec.get("cache", "auto"))
-                # fd-passing lane: when negotiated AND a validated page
-                # cache backs this shard, the descriptor crosses instead
-                # of the bytes; otherwise fall through to streaming
-                page_file = (loader.cached_page_file()
-                             if neg and neg.get("fdpass") else None)
-                if page_file is not None:
-                    frames = self._serve_fd_shard(conn, part, lease_epoch,
-                                                  page_file)
-                    sent = 0
-                    sp.attrs.update(frames=frames, bytes=0, fdpass=True)
-                else:
-                    # shard-begin is QUEUED, not sent: it coalesces into
-                    # the same sendmsg as the first data frame
+                    emit="host", cache=cache)
+                if spec.get("snapshot"):
+                    # snapshot job (tf.data materialization): drain the
+                    # loader so its write-through build finalizes the
+                    # page file, deliver NO data frames — the empty
+                    # bracket closes this part in the consumer's ledger
+                    for _kind, buf, _meta, _rows in loader:
+                        loader.recycle(buf)
                     writer.control(part, CTRL_SHARD_BEGIN, lease_epoch)
-                    frames, sent = stream_epoch_frames(
-                        conn, loader, batch_rows, eos=False, writer=writer)
-                    writer.control(part, CTRL_SHARD_END, frames)
+                    writer.control(part, CTRL_SHARD_END, 0)
                     writer.flush()
-                    sp.attrs.update(frames=frames, bytes=sent)
+                    frames, sent = 0, 0
+                    metrics.counter(
+                        "data_service.worker.snapshot_shards").add(1)
+                    sp.attrs.update(snapshot=True)
+                else:
+                    # fd-passing lane: when negotiated AND a validated
+                    # page cache backs this shard, the descriptor crosses
+                    # instead of the bytes; otherwise fall through to
+                    # streaming
+                    page_file = (loader.cached_page_file()
+                                 if neg and neg.get("fdpass") else None)
+                    if page_file is not None:
+                        frames = self._serve_fd_shard(conn, part,
+                                                      lease_epoch,
+                                                      page_file)
+                        sent = 0
+                        sp.attrs.update(frames=frames, bytes=0,
+                                        fdpass=True)
+                    else:
+                        # shard-begin is QUEUED, not sent: it coalesces
+                        # into the same sendmsg as the first data frame
+                        writer.control(part, CTRL_SHARD_BEGIN, lease_epoch)
+                        frames, sent = stream_epoch_frames(
+                            conn, loader, batch_rows, eos=False,
+                            writer=writer)
+                        writer.control(part, CTRL_SHARD_END, frames)
+                        writer.flush()
+                        sp.attrs.update(frames=frames, bytes=sent)
             metrics.counter("data_service.worker.shards").add(1)
             metrics.throughput("data_service.worker.bytes").add(int(sent))
+            self._register_page(key, part, loader)
         except (OSError, ValueError, DMLCError) as e:
             # the consumer did not get this shard: re-queue it for any
             # living worker (possibly this one, on the next connection).
@@ -394,9 +542,10 @@ class DataServiceWorker:
         finally:
             if loader is not None:
                 loader.close()
-        dispatcher_rpc(self.dispatcher,
-                       {"cmd": "complete_lease", "key": key, "part": part,
-                        "lease_epoch": lease_epoch, "jobid": self.jobid})
+        self._ctrl_retry.call(
+            dispatcher_rpc, self.dispatcher,
+            {"cmd": "complete_lease", "key": key, "part": part,
+             "lease_epoch": lease_epoch, "jobid": self.jobid})
 
 
 def data_service_worker_main(argv=None) -> int:

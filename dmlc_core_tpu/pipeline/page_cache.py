@@ -360,3 +360,22 @@ def open_reader(path: str, fingerprint: dict,
         reader.close()
         return None
     return reader
+
+
+def page_file_info(path: str) -> Optional[dict]:
+    """``{"pages": n, "size": bytes}`` for a structurally valid page file
+    at ``path``, else None.  The cheap validity probe the data-service
+    page registry uses before advertising or fd-passing a file: the full
+    framing is validated (a torn build never crosses a socket) but no
+    fingerprint is compared — registry entries carry their own identity
+    (the dataset key they were built under)."""
+    try:
+        reader = PageCacheReader(path, readahead=0)
+    except (OSError, PageCacheError):
+        return None
+    try:
+        return {"pages": reader.npages, "size": os.path.getsize(path)}
+    except OSError:
+        return None
+    finally:
+        reader.close()

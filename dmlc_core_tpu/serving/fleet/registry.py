@@ -38,7 +38,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ...parallel.tracker import LivenessBoard, recv_json, send_json
+from ...parallel.tracker import (LivenessBoard, jittered, recv_json,
+                                 send_json)
 from ...telemetry import flight as flight_mod
 from ...telemetry import trace as teltrace
 from ...telemetry.anomaly import StragglerBoard
@@ -538,7 +539,9 @@ class ReplicaAgent:
             pass               # registry gone — its sweep will notice
 
     def _run(self) -> None:
-        while not self._stop_ev.wait(self.interval_s):
+        # jittered beats (±DMLC_HEARTBEAT_JITTER): a restarted registry
+        # must not absorb every agent's re-registration in one instant
+        while not self._stop_ev.wait(jittered(self.interval_s)):
             msg = {"cmd": "heartbeat", **self._report()}
             with self._lock:
                 if self._acks:

@@ -258,7 +258,14 @@ def span(name: str, **attrs: Any) -> Iterator[Span]:
         s.end(error=f"{type(e).__name__}: {e}")
         raise
     finally:
-        _current.reset(token)
+        try:
+            _current.reset(token)
+        except ValueError:
+            # a span opened inside a generator dies wherever the
+            # generator is finalized: GC can close an abandoned iterator
+            # from another thread's context, where this token is foreign.
+            # The span still ends; only the ambient-context pop is moot.
+            pass
         s.end()
 
 

@@ -32,8 +32,8 @@ from typing import List, Optional
 from ..utils.parameter import parse_lenient_bool
 
 __all__ = ["HAVE_UNIX", "lane_enabled", "fd_passing_ok", "host_token",
-           "lane_path", "bind_lane", "connect_lane", "send_with_fds",
-           "recv_exact_into"]
+           "same_host", "lane_path", "bind_lane", "connect_lane",
+           "send_with_fds", "recv_exact_into"]
 
 HAVE_UNIX = hasattr(socket, "AF_UNIX")
 _HAVE_SCM = (HAVE_UNIX and hasattr(socket.socket, "sendmsg")
@@ -72,6 +72,14 @@ def host_token() -> str:
             pass
         _host_token_cache = f"{socket.gethostname()}|{boot}"
     return _host_token_cache
+
+
+def same_host(hostid) -> bool:
+    """True iff ``hostid`` (a peer-advertised :func:`host_token`) names
+    this kernel — the colocated-or-not decision every shared-resource
+    path (UDS lanes, fd-passed page files) hangs on.  Empty/None is
+    never colocated: an absent advert must fall back to the network."""
+    return bool(hostid) and str(hostid) == host_token()
 
 
 def lane_path(jobid: str) -> str:

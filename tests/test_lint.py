@@ -471,7 +471,7 @@ def test_cli_lists_all_builtin_rules(capsys):
     out = capsys.readouterr().out
     for rule in ("env-discipline", "metric-vocabulary", "span-vocabulary",
                  "endpoint-vocabulary", "lock-discipline", "atomic-write",
-                 "retrace-hazard", "thread-hygiene"):
+                 "retrace-hazard", "thread-hygiene", "durable-state"):
         assert rule in out
 
 
