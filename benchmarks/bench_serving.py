@@ -31,9 +31,19 @@ time-machine sampler off vs sampling the live registry at 4 Hz —
 ``timeline_sampler_qps_overhead_pct`` is the acceptance number (< 1%
 QPS; ``sampler_budget_ok`` gates it in ``check_regression.py``).
 
+``--ha`` swaps the sweep for the control-plane failover drills
+(committed as BENCH_ha_r{N}.json): the journaled fleet registry and the
+journaled rabit tracker each run as a subprocess, get SIGKILLed with
+state in flight, and are restarted on the same port + journal —
+``registry_failover_s`` / ``tracker_failover_s`` measure kill→serving
+control RPCs again with the pre-kill state replayed (membership +
+heartbeat re-attach for the registry, rank re-admission at the current
+generation for the tracker).  Both gate lower-better in
+``check_regression.py`` via the "failover" token.
+
 Usage: python benchmarks/bench_serving.py [out.json]
                                           [--telemetry-out PREFIX]
-                                          [--router] [--timeline]
+                                          [--router] [--timeline] [--ha]
 Env:   DMLC_SERVE_REQUESTS (default 2000), DMLC_SERVE_FEATURES (2^16),
        DMLC_SERVE_MODEL (fm), DMLC_SERVE_DIM (16),
        DMLC_TELEMETRY_OUT (same as --telemetry-out)
@@ -234,6 +244,165 @@ def router_bench(model, params, *, requests: int, features: int):
     return out, headlines
 
 
+def _spawn_singleton(module: str, **kw):
+    """``python -m <module> k=v ...`` — every journaled singleton CLI
+    prints one JSON bind line; returns ``(proc, (host, port))``."""
+    import subprocess
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module] + [f"{k}={v}" for k, v in kw.items()],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    line = proc.stdout.readline()
+    if not line:
+        raise RuntimeError(f"{module} subprocess died before binding")
+    doc = json.loads(line)
+    return proc, (str(doc["host"]), int(doc["port"]))
+
+
+def _registry_failover(model, params, *, features: int) -> dict:
+    """SIGKILL a journaled registry subprocess with two heartbeating
+    replicas attached, restart it on the same port + journal, and
+    measure kill→membership served again (both replicas replayed)."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from dmlc_core_tpu.serving import (InferenceEngine, PredictionServer,
+                                       ReplicaAgent, fleet_rpc)
+
+    tmp = tempfile.mkdtemp(prefix="dmlc_ha_reg_")
+    journal = os.path.join(tmp, "registry")
+    chaos_env = {"DMLC_ROUTER_BREAKER_COOLDOWN": "0.3",
+                 "DMLC_ROUTER_BREAKER_THRESHOLD": "3"}
+    saved = {k: os.environ.get(k) for k in chaos_env}
+    os.environ.update(chaos_env)
+    proc, addr = _spawn_singleton("dmlc_core_tpu.serving.fleet.registry",
+                                  port=0, journal=journal,
+                                  heartbeat_timeout=5.0)
+    pairs = []
+    try:
+        for _ in range(2):
+            engine = InferenceEngine(model, params, postprocess="sigmoid")
+            srv = PredictionServer(engine, metrics_port=0).start()
+            pairs.append((srv, ReplicaAgent(srv, addr,
+                                            interval_s=0.1).start()))
+
+        def members(timeout=2.0):
+            try:
+                return [r["jobid"] for r in fleet_rpc(
+                    addr, {"cmd": "list_replicas"},
+                    timeout=timeout)["replicas"]]
+            except (OSError, ValueError, KeyError):
+                return []
+
+        deadline = time.monotonic() + 60
+        while len(members()) < 2:
+            if time.monotonic() > deadline:
+                raise RuntimeError("replicas never registered")
+            time.sleep(0.1)
+        roster = sorted(members())
+        os.kill(proc.pid, _signal.SIGKILL)
+        proc.wait()
+        t0 = time.perf_counter()
+        proc, addr2 = _spawn_singleton(
+            "dmlc_core_tpu.serving.fleet.registry",
+            port=addr[1], journal=journal, heartbeat_timeout=5.0)
+        assert addr2 == addr
+        deadline = time.monotonic() + 60
+        while sorted(members()) != roster:
+            if time.monotonic() > deadline:
+                raise RuntimeError("restarted registry never replayed "
+                                   "the membership")
+            time.sleep(0.02)
+        failover = time.perf_counter() - t0
+        return {"failover_s": round(failover, 3), "replicas": len(roster)}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for srv, ag in pairs:
+            ag.stop()
+            srv.stop()
+        proc.kill()
+        proc.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _tracker_failover() -> dict:
+    """SIGKILL a journaled tracker subprocess holding an assigned
+    two-worker cohort, restart it on the same port + journal, and
+    measure kill→both workers re-admitted at their old ranks (current
+    generation, no reset)."""
+    import shutil
+    import signal as _signal
+    import socket
+    import tempfile
+    import threading
+
+    from dmlc_core_tpu.parallel.tracker import recv_json, send_json
+
+    def cmd(addr, msg, timeout=30.0):
+        with socket.create_connection(addr, timeout=timeout) as s:
+            s.settimeout(timeout)
+            send_json(s, msg)
+            return recv_json(s.makefile("r"))
+
+    tmp = tempfile.mkdtemp(prefix="dmlc_ha_trk_")
+    journal = os.path.join(tmp, "tracker")
+    proc, addr = _spawn_singleton("dmlc_core_tpu.parallel.tracker",
+                                  port=0, workers=2, journal=journal)
+    try:
+        replies = {}
+        # "start" blocks until the cohort is complete — register both
+        # workers concurrently
+        ts = [threading.Thread(
+            target=lambda j=j, p=p: replies.update(
+                {j: cmd(addr, {"cmd": "start", "jobid": j,
+                               "host": "127.0.0.1", "port": p})}))
+            for j, p in (("w1", 7101), ("w2", 7102))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60.0)
+        ranks = {j: replies[j]["rank"] for j in ("w1", "w2")}
+        os.kill(proc.pid, _signal.SIGKILL)
+        proc.wait()
+        t0 = time.perf_counter()
+        proc, addr2 = _spawn_singleton("dmlc_core_tpu.parallel.tracker",
+                                       port=addr[1], workers=2,
+                                       journal=journal)
+        assert addr2 == addr
+        for jobid, port in (("w1", 7101), ("w2", 7102)):
+            r = cmd(addr, {"cmd": "recover", "jobid": jobid,
+                           "host": "127.0.0.1", "port": port})
+            if r.get("rank") != ranks[jobid] or r.get("generation") != 0:
+                raise RuntimeError(f"re-admission broke: {jobid} {r}")
+        failover = time.perf_counter() - t0
+        return {"failover_s": round(failover, 3), "workers": 2}
+    finally:
+        proc.kill()
+        proc.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def ha_bench(model, params, *, features: int):
+    """The control-plane HA sweep: one SIGKILL drill per journaled
+    singleton (the dispatcher's equivalent lives in bench_suite's
+    ``dispatcher_failover_s``).  Returns scenarios + headline numbers."""
+    out = {"registry": _registry_failover(model, params,
+                                         features=features)}
+    log(f"registry failover: {out['registry']['failover_s']:.3f}s")
+    out["tracker"] = _tracker_failover()
+    log(f"tracker failover: {out['tracker']['failover_s']:.3f}s")
+    headlines = {
+        "registry_failover_s": out["registry"]["failover_s"],
+        "tracker_failover_s": out["tracker"]["failover_s"],
+    }
+    return out, headlines
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -250,6 +419,9 @@ def main() -> int:
     timeline_mode = "--timeline" in argv
     if timeline_mode:
         argv.remove("--timeline")
+    ha_mode = "--ha" in argv
+    if ha_mode:
+        argv.remove("--ha")
     telemetry_prefix = os.environ.get("DMLC_TELEMETRY_OUT")
     if "--telemetry-out" in argv:
         i = argv.index("--telemetry-out")
@@ -269,12 +441,25 @@ def main() -> int:
 
     report = {
         "bench": ("router" if router_mode
-                  else "timeline" if timeline_mode else "serving"),
+                  else "timeline" if timeline_mode
+                  else "ha" if ha_mode else "serving"),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "backend": jax.default_backend(), "model": model_name,
         "features": features, "dim": dim, "requests": requests,
         "scenarios": {},
     }
+
+    if ha_mode:
+        scenarios, headlines = ha_bench(model, params, features=features)
+        report["scenarios"] = scenarios
+        report.update(headlines)
+        blob = json.dumps(report, indent=2)
+        print(blob)
+        if argv:
+            with open(argv[0], "w") as f:
+                f.write(blob + "\n")
+            log(f"wrote {argv[0]}")
+        return 0
 
     if router_mode:
         scenarios, headlines = router_bench(model, params,

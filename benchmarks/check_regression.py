@@ -73,6 +73,9 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 #  fleet speedup keys (speedup_3v1 / parser_speedup_3v1) gate
 #  higher-better via "speedup" and are stamped only on hosts with
 #  cores >= workers, so a core-starved runner simply doesn't gate them.
+#  The ha family (ISSUE 17, BENCH_ha_r*.json): registry_failover_s /
+#  tracker_failover_s — SIGKILL→journal-replayed singleton serving its
+#  control RPCs again — both gate lower-better via "failover".
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
                  "errors", "misses", "padding_ratio", "truncated",

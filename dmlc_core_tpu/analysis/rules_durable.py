@@ -6,7 +6,10 @@ journaling every lease/registry mutation: append a fsync'd record,
 the silent hole — a new code path that mutates the lease table (or the
 worker/page registries) without appending, which replays fine in every
 test that doesn't crash at exactly that point and loses rows in the one
-that does.
+that does.  Since r17 the serving-fleet registry, its rollout manager,
+and the rabit tracker declare their durable tables the same way — the
+rule covers every control-plane singleton, and ``del`` statements count
+as mutations (a replay that misses a removal resurrects the entry).
 
 A class opts in by declaring what is durable::
 
@@ -120,6 +123,13 @@ class _Scan(ast.NodeVisitor):
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         self._target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        # del self._active[k] / del ls.worker — removal IS a mutation;
+        # a replay that misses it resurrects the deleted entry
+        for t in node.targets:
+            self._target(t, node)
         self.generic_visit(node)
 
     def _target(self, t: ast.AST, node: ast.AST) -> None:

@@ -41,7 +41,7 @@ import numpy as np
 from ..utils import DMLCError, check, get_env, log_info, log_warning
 from ..utils.logging import set_log_context
 from ..transport.frames import pack_obj, send_all, unpack_obj
-from .tracker import recv_json, send_json
+from .tracker import jittered, recv_json, send_json
 
 __all__ = ["RabitContext"]
 
@@ -565,7 +565,7 @@ class RabitContext:
 
     def _heartbeat_loop(self) -> None:
         from ..utils.metrics import metrics
-        while not self._hb_stop.wait(self.heartbeat_interval):
+        while not self._hb_stop.wait(jittered(self.heartbeat_interval)):
             try:
                 self._tracker_cmd({"cmd": "heartbeat", "jobid": self.jobid})
             except OSError:
@@ -589,7 +589,7 @@ class RabitContext:
 
     def _telemetry_loop(self) -> None:
         from ..utils.metrics import metrics
-        while not self._tel_stop.wait(self.telemetry_interval):
+        while not self._tel_stop.wait(jittered(self.telemetry_interval)):
             try:
                 self.push_telemetry()
             except OSError:

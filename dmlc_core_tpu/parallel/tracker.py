@@ -26,6 +26,16 @@ On TPU pods the *data-plane* collectives ride ICI via XLA (see
 non-JAX host processes, metadata exchange, elastic restart bookkeeping.  The
 ``PSTracker`` analog (scheduler bootstrap env) is
 :func:`dmlc_core_tpu.parallel.launcher.tpu.jax_coordinator_env`.
+
+**Durability (r17).**  With ``journal=`` (or ``DMLC_TRACKER_JOURNAL``)
+the tracker write-ahead-journals rank assignments, worker addresses,
+and the link generation through the shared
+:class:`~dmlc_core_tpu.utils.durable.StateJournal`.  A SIGKILLed
+tracker restarted on the same port + journal re-admits the live cohort:
+a worker's ``recover`` from an unchanged address gets its old rank at
+the *current* generation — no generation bump, no fleet-wide
+re-rendezvous — because its peers' links were never broken (only the
+tracker died).
 """
 
 from __future__ import annotations
@@ -36,7 +46,7 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..transport.frames import send_all
 from ..telemetry.aggregate import ResetGuard, merge_states, render_fleet
@@ -44,10 +54,45 @@ from ..telemetry.anomaly import StragglerBoard
 from ..telemetry.exposition import TelemetryServer
 from ..telemetry.timeseries import HistoryStore
 from ..utils import DMLCError, check, get_env, get_logger, log_info
+from ..utils.durable import StateJournal
 from ..utils.metrics import metrics
 
 __all__ = ["RabitTracker", "PSTracker", "LivenessBoard", "compute_tree",
-           "compute_ring", "recv_json", "send_json", "jittered"]
+           "compute_ring", "recv_json", "send_json", "jittered",
+           "replay_tracker_state", "tracker_main", "TRACKER_SNAP_SCHEMA"]
+
+TRACKER_SNAP_SCHEMA = "dmlc.tracker.snapshot/1"
+
+
+def replay_tracker_state(snapshot: Optional[Dict[str, Any]],
+                         records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pure replay of tracker journal ``records`` over ``snapshot`` (or
+    a blank state); any prefix of a valid log replays without error.
+
+    State shape: ``{"workers": {jobid: {"host", "port", "rank"}},
+    "generation": int}``.
+    """
+    state: Dict[str, Any] = {"workers": {}, "generation": 0}
+    if snapshot:
+        w = snapshot.get("workers")
+        if isinstance(w, dict):
+            state["workers"] = json.loads(json.dumps(w))
+        state["generation"] = int(snapshot.get("generation", 0))
+    for rec in records:
+        op = rec.get("op")
+        if op == "worker":
+            state["workers"][str(rec["jobid"])] = {
+                "host": rec.get("host"), "port": rec.get("port"),
+                "rank": int(rec.get("rank", -1))}
+        elif op == "assign":
+            for jobid, rank in (rec.get("ranks") or {}).items():
+                w = state["workers"].get(str(jobid))
+                if w is not None:
+                    w["rank"] = int(rank)
+        elif op == "generation":
+            state["generation"] = max(state["generation"],
+                                      int(rec.get("generation", 0)))
+    return state
 
 logger = get_logger()
 
@@ -198,10 +243,15 @@ class RabitTracker:
     >>> t.join()                 # until all workers shut down
     """
 
+    #: journal-before-mutate contract (dmlclint ``durable-state``)
+    _DURABLE_STATE = ("_workers", "_rank_of", "_generation")
+    _DURABLE_FIELDS = ("rank", "host", "port")
+
     def __init__(self, num_workers: int, host_ip: Optional[str] = None,
                  port: int = 0, max_port: int = 9999,
                  heartbeat_timeout_s: Optional[float] = None,
-                 telemetry_port: Optional[int] = None):
+                 telemetry_port: Optional[int] = None,
+                 journal: Optional[str] = None):
         self.num_workers = num_workers
         self.host_ip = host_ip or _default_host_ip()
         # dead-worker detection: workers beat (cmd=heartbeat) and a monitor
@@ -221,8 +271,10 @@ class RabitTracker:
         # port=0 (default) = OS-assigned ephemeral port: concurrent trackers
         # can never collide (the DMLC_TRACKER_PORT env carries the real port
         # to workers).  An explicit port keeps the reference's scan behavior
-        # (`tracker.py:141-153`) for fixed-port deployments.
-        candidates = [0] if port == 0 else range(port, max_port + 1)
+        # (`tracker.py:141-153`) for fixed-port deployments; a port above
+        # max_port (a restart pinned to a prior ephemeral bind) is a
+        # single exact candidate, not an empty scan range.
+        candidates = [0] if port == 0 else range(port, max(port, max_port) + 1)
         for p in candidates:
             try:
                 self._sock.bind((self.host_ip, p))
@@ -249,6 +301,21 @@ class RabitTracker:
         if telemetry_port is None:
             p = get_env("DMLC_TRACKER_METRICS_PORT", -1)
             telemetry_port = p if p >= 0 else None
+        # durable rendezvous (r17): journal rank assignments + link
+        # generation so a restarted tracker re-admits the live cohort
+        if journal is None:
+            journal = get_env("DMLC_TRACKER_JOURNAL", "") or None
+        self._journal: Optional[StateJournal] = None
+        self._journal_snap_every = max(16, int(get_env(
+            "DMLC_TRACKER_JOURNAL_SNAP_EVERY", 512)))
+        if journal:
+            self._journal = StateJournal(
+                str(journal), snap_schema=TRACKER_SNAP_SCHEMA,
+                on_append=metrics.counter("tracker.journal.appends").add,
+                on_snapshot=metrics.counter(
+                    "tracker.journal.snapshots").add)
+            with self._lock:
+                self._restore_locked()
         self._telemetry_states: Dict[str, dict] = {}
         # cross-rank straggler detection over the same pushes: every
         # rank-tagged state feeds the board, /metrics carries per-rank
@@ -316,10 +383,22 @@ class RabitTracker:
         self.history.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
+        # shutdown() before close(): close() alone does not wake a
+        # thread blocked inside accept(), and the blocked syscall keeps
+        # the listen port held — an in-process restart on the same port
+        # (the HA drills) would then fail to rebind
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        if self._journal is not None:
+            with self._lock:
+                self._journal.compact(self._durable_state_locked())
+            self._journal.close()
 
     def _render_fleet(self) -> str:
         with self._lock:
@@ -335,6 +414,50 @@ class RabitTracker:
         """Latest per-rank registry states pushed via ``cmd=telemetry``."""
         with self._lock:
             return dict(self._telemetry_states)
+
+    # -- durable rendezvous (r17) --
+    def _jlog(self, op: str, **fields: Any) -> None:
+        """One write-ahead record; no-op without a journal.  Callers
+        hold ``self._lock`` (the tracker's one big lock — the
+        dispatcher's inline-compaction pattern applies)."""
+        if self._journal is None:
+            return
+        self._journal.append({"op": op, "ts": time.time(), **fields})
+        if self._journal.appends_since_snapshot >= self._journal_snap_every:
+            self._journal.compact(self._durable_state_locked())
+
+    def _durable_state_locked(self) -> Dict[str, Any]:
+        return {"workers": {j: {"host": r.host, "port": r.port,
+                                "rank": r.rank}
+                            for j, r in self._workers.items()},
+                "generation": self._generation}
+
+    def _restore_locked(self) -> None:
+        snap, records = self._journal.load()
+        if snap is None and not records:
+            return
+        state = replay_tracker_state(snap, records)
+        self._workers = {}
+        self._rank_of = {}
+        for jobid, w in state.get("workers", {}).items():
+            rec = _WorkerRecord(jobid, str(w.get("host")),
+                                int(w.get("port") or 0))
+            rec.rank = int(w.get("rank", -1))
+            self._workers[jobid] = rec
+            if rec.rank >= 0:
+                self._rank_of[jobid] = rec.rank
+        self._generation = int(state.get("generation", 0))
+        self._assigned = any(r.rank >= 0 for r in self._workers.values())
+        for jobid in self._workers:
+            # liveness grace: restored workers get a full window to
+            # re-attach before the monitor declares them dead
+            self.liveness.beat(jobid)
+        metrics.counter("tracker.journal.replayed").add(len(records))
+        log_info("tracker: replayed %d journal record(s) → %d worker(s)"
+                 ", generation %d%s", len(records), len(self._workers),
+                 self._generation,
+                 " (ranks assigned)" if self._assigned else "")
+        self._journal.compact(self._durable_state_locked())
 
     # -- accept/assign logic --
     def _accept_loop(self) -> None:
@@ -407,16 +530,27 @@ class RabitTracker:
             rec = self._workers.get(jobid)
             if rec is None:
                 rec = _WorkerRecord(jobid, host, port)
+                self._jlog("worker", jobid=jobid, host=host, port=port,
+                           rank=-1)
                 self._workers[jobid] = rec
             else:
-                # restarted worker: keep rank, refresh address
+                # restarted worker: keep rank, refresh address.  An
+                # UNCHANGED address is re-admission after a *tracker*
+                # restart (the worker never died, its peers' links are
+                # intact) — same rank, current generation, no reset.
+                moved = (rec.host, rec.port) != (host, port)
+                if moved:
+                    self._jlog("worker", jobid=jobid, host=host,
+                               port=port, rank=rec.rank)
                 rec.host, rec.port = host, port
-                if self._assigned and rec.rank >= 0:
+                if moved and self._assigned and rec.rank >= 0:
                     # MID-JOB restart: surviving peers hold sockets to the
                     # dead incarnation — bump the link generation and push a
                     # reset to every survivor so they drop stale links and
                     # re-rendezvous (reference wait_conn re-linking,
                     # `tracker.py:80-135,279-291`)
+                    self._jlog("generation",
+                               generation=self._generation + 1)
                     self._generation += 1
                     notify = [(w.host, w.port) for w in self._workers.values()
                               if w.jobid != jobid and w.rank >= 0]
@@ -426,7 +560,7 @@ class RabitTracker:
                 # and was restarted by the launcher retry loop) — assignment
                 # must trigger regardless of the command
                 if len(self._workers) >= self.num_workers:
-                    self._assign_ranks()
+                    self._assign_ranks_locked()
                     self._lock.notify_all()
                 else:
                     # wait until full cohort present
@@ -474,6 +608,7 @@ class RabitTracker:
                         "tracker: worker %r (rank %d) missed heartbeats "
                         "for %.1fs — declaring dead", j,
                         self._workers[j].rank, silence)
+                self._jlog("generation", generation=self._generation + 1)
                 self._generation += 1
                 dead = self.liveness.dead_members()
                 notify = [(w.host, w.port) for w in self._workers.values()
@@ -505,10 +640,12 @@ class RabitTracker:
         logger.warning("tracker: reset notify to %s failed after retries: %s",
                        addr, last)
 
-    def _assign_ranks(self) -> None:
+    def _assign_ranks_locked(self) -> None:
         # sort by host then jobid for locality (reference :294-311)
         ordered = sorted(self._workers.values(),
                          key=lambda r: (r.host, r.jobid))
+        self._jlog("assign", ranks={rec.jobid: rank
+                                    for rank, rec in enumerate(ordered)})
         for rank, rec in enumerate(ordered):
             rec.rank = rank
             self._rank_of[rec.jobid] = rank
@@ -633,3 +770,43 @@ def _default_host_ip() -> str:
         return ip
     except OSError:
         return "127.0.0.1"
+
+
+def tracker_main(argv=None) -> int:
+    """CLI: ``python -m dmlc_core_tpu.parallel.tracker [host=H] [port=N]
+    [workers=N] [journal=PREFIX] [heartbeat_timeout=S]`` — serve until
+    killed.
+
+    The chaos-drill surface, mirroring ``dispatcher_main``: the HA
+    tests run the tracker as a subprocess, SIGKILL it mid-epoch, and
+    restart it with the same ``port=`` and ``journal=`` to prove the
+    replay re-admits the cohort at the current generation.  The bound
+    port is printed as one JSON line on stdout (``{"host": ...,
+    "port": ...}``); SIGTERM is a clean stop (journal compacted)."""
+    import signal
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    kw = dict(a.split("=", 1) for a in args)
+    t = RabitTracker(
+        num_workers=int(kw.get("workers", 1)),
+        host_ip=kw.get("host", "127.0.0.1"),
+        port=int(kw.get("port", 0)),
+        journal=kw.get("journal") or None,
+        heartbeat_timeout_s=(float(kw["heartbeat_timeout"])
+                             if "heartbeat_timeout" in kw else None))
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
+    t.start()
+    print(json.dumps({"host": t.host_ip, "port": t.port}), flush=True)
+    try:
+        while not done.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    t.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(tracker_main())

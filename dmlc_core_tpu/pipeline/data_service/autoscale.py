@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ...parallel.tracker import jittered
 from ...utils import check
 from ...utils.logging import get_logger, log_info
 from ...utils.metrics import metrics
@@ -206,7 +207,8 @@ class FleetAutoscaler:
         return action
 
     def _run(self) -> None:
-        while not self._stop_ev.wait(self.interval_s):
+        # jittered so a fleet of autoscalers never thunders in lock-step
+        while not self._stop_ev.wait(jittered(self.interval_s)):
             try:
                 self.step()
             except Exception as e:  # noqa: BLE001 — the scaler must not

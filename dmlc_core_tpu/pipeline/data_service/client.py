@@ -24,6 +24,14 @@ Failure wiring is the standard resilience vocabulary
 per-worker :class:`CircuitBreaker` stops redialing a corpse while the
 :class:`RetryPolicy` rides over transient drops; the epoch only fails
 when **all** workers are lost with parts still owed.
+
+**Dispatcher HA (r17).**  ``dispatcher`` accepts an ordered endpoint
+list — ``(host, port)``, ``"host:port,host:port"``, or a list of
+either — wrapped in an
+:class:`~dmlc_core_tpu.transport.endpoints.EndpointSet`: every control
+RPC (register, epoch start, lease failure, stats) walks the list with
+per-endpoint breakers and ``control_epoch`` fencing, so a dispatcher
+SIGKILL plus standby takeover costs one failover, not an epoch.
 """
 
 from __future__ import annotations
@@ -38,6 +46,7 @@ from typing import Dict, List, Optional, Tuple
 from ...telemetry import trace as teltrace
 from ...transport import frames as _wire
 from ...transport import lane as _lane
+from ...transport.endpoints import EndpointSet, EndpointsLike
 from ...utils import check
 from ...utils.faults import fault_point
 from ...utils.parameter import get_env
@@ -80,11 +89,16 @@ class DataServiceLoader:
     loaders).
     """
 
-    def __init__(self, dispatcher: Tuple[str, int], spec: dict, *,
+    def __init__(self, dispatcher: EndpointsLike, spec: dict, *,
                  prefetch: int = 4, connect_timeout: float = 30.0,
                  emit: str = "host"):
         check(emit in ("host", "device"), f"bad emit {emit!r}")
-        self.dispatcher = (str(dispatcher[0]), int(dispatcher[1]))
+        # ordered endpoint list (primary + warm standbys); the plain
+        # tuple alias keeps the seed's single-dispatcher surface intact
+        self._dispatcher = EndpointSet(dispatcher,
+                                       env_prefix="DMLC_DATA_CLIENT",
+                                       name="data_service.dispatcher")
+        self.dispatcher = self._dispatcher.primary
         self.spec = dict(spec)
         self.batch_rows = int(spec["batch_rows"])
         self.connect_timeout = float(connect_timeout)
@@ -98,8 +112,7 @@ class DataServiceLoader:
         self._closed = False
         self._state_lock = threading.Lock()
         self._epoch_state: Optional[dict] = None
-        reg = dispatcher_rpc(self.dispatcher,
-                             {"cmd": "register_dataset", "spec": self.spec})
+        reg = self._rpc({"cmd": "register_dataset", "spec": self.spec})
         self.key: str = reg["key"]
         self.num_parts: int = int(reg["num_parts"])
         # a broken stream surfaces as DMLCError (protocol break) as often
@@ -122,11 +135,16 @@ class DataServiceLoader:
         self._batches = 0
 
     # -- epoch machinery -------------------------------------------------
+    def _rpc(self, msg: dict, timeout: float = 30.0) -> dict:
+        """One dispatcher round trip over the endpoint set: sticky
+        failover across standbys, breaker-gated, fencing-aware."""
+        return self._dispatcher.call(
+            lambda addr: dispatcher_rpc(addr, msg, timeout=timeout))
+
     def _start_epoch(self) -> dict:
-        ep = dispatcher_rpc(self.dispatcher,
-                            {"cmd": "start_epoch", "key": self.key,
-                             "consumer": self.consumer})
-        listing = dispatcher_rpc(self.dispatcher, {"cmd": "list_workers"})
+        ep = self._rpc({"cmd": "start_epoch", "key": self.key,
+                        "consumer": self.consumer})
+        listing = self._rpc({"cmd": "list_workers"})
         workers = listing["workers"]
         if not workers:
             raise DMLCError("data service: no live workers registered "
@@ -356,14 +374,12 @@ class DataServiceLoader:
                 # TTL: report what we saw break (best-effort; the TTL
                 # sweep remains the backstop)
                 try:
-                    dispatcher_rpc(
-                        self.dispatcher,
-                        {"cmd": "fail_lease", "key": self.key,
-                         "part": cur["part"],
-                         "lease_epoch": cur["lease_epoch"],
-                         "why": "consumer stream broke mid-shard"},
-                        timeout=5.0)
-                except OSError:
+                    self._rpc({"cmd": "fail_lease", "key": self.key,
+                               "part": cur["part"],
+                               "lease_epoch": cur["lease_epoch"],
+                               "why": "consumer stream broke mid-shard"},
+                              timeout=5.0)
+                except (OSError, DMLCError):
                     pass
             raise
         finally:
@@ -561,12 +577,11 @@ class DataServiceLoader:
             backlog = len(state["out"])
         metrics.gauge("data_service.client.backlog").set(float(backlog))
         try:
-            dispatcher_rpc(self.dispatcher,
-                           {"cmd": "consumer_stats", "key": self.key,
-                            "consumer": self.consumer,
-                            "backlog": backlog, "batches": self._batches},
-                           timeout=2.0)
-        except OSError:
+            self._rpc({"cmd": "consumer_stats", "key": self.key,
+                       "consumer": self.consumer,
+                       "backlog": backlog, "batches": self._batches},
+                      timeout=2.0)
+        except (OSError, DMLCError):
             pass
 
     def _epoch_done_remote(self, state: dict) -> bool:
@@ -578,9 +593,8 @@ class DataServiceLoader:
         if state["errs"] or state["stop"]:
             return False
         try:
-            st = dispatcher_rpc(self.dispatcher,
-                                {"cmd": "status", "key": self.key},
-                                timeout=5.0)
+            st = self._rpc({"cmd": "status", "key": self.key},
+                           timeout=5.0)
         except (OSError, DMLCError):
             return False
         return (int(st.get("epoch", 0)) > state["epoch"]

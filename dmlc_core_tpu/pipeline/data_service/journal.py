@@ -26,14 +26,21 @@ between snapshot replace and log truncation re-applies logged records
 onto a snapshot that already includes them and lands on the same state.
 :func:`replay_state` is a pure function over ``(snapshot, records)`` —
 the property tests drive it directly over every record prefix.
+
+Since r17 the file/fsync mechanics live in
+:class:`~dmlc_core_tpu.utils.durable.StateJournal`, the shared
+durable-state substrate the serving-fleet registry and the rabit
+tracker journal through as well; :class:`DispatchJournal` is the
+data-service binding (snapshot schema + metric names), and this module
+keeps the dispatcher's domain replay.
 """
 
 from __future__ import annotations
 
 import json
-import os
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
+from ...utils.durable import StateJournal
 from ...utils.logging import get_logger
 from ...utils.metrics import metrics
 
@@ -47,82 +54,14 @@ LOG_SCHEMA = "dmlc.data_service.journal/1"
 _PENDING, _GRANTED, _COMPLETED = "pending", "granted", "completed"
 
 
-class DispatchJournal:
+class DispatchJournal(StateJournal):
     """Append-only journal + snapshot pair under one path prefix."""
 
     def __init__(self, prefix: str):
-        self.prefix = str(prefix)
-        self.log_path = self.prefix + ".log"
-        self.snap_path = self.prefix + ".snap"
-        d = os.path.dirname(os.path.abspath(self.log_path))
-        os.makedirs(d, exist_ok=True)
-        self._f = open(self.log_path, "ab")
-        self.appends_since_snapshot = 0
-
-    # -- write side ------------------------------------------------------
-    def append(self, record: Dict[str, Any]) -> None:
-        """One fsync'd JSON line; durable before the caller's in-memory
-        mutation proceeds (write-ahead ordering)."""
-        line = json.dumps(record, sort_keys=True, default=str) + "\n"
-        self._f.write(line.encode("utf-8"))
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self.appends_since_snapshot += 1
-        metrics.counter("data_service.journal.appends").add(1)
-
-    def compact(self, state: Dict[str, Any]) -> None:
-        """Atomic-rename snapshot of ``state``, then truncate the log.
-        Crash windows: before the replace → old snapshot + full log
-        (nothing lost); between replace and truncation → new snapshot +
-        old log, whose records re-apply idempotently."""
-        doc = {"schema": SNAP_SCHEMA, **state}
-        tmp = f"{self.snap_path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f, sort_keys=True, default=str)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.snap_path)
-        self._f.close()
-        self._f = open(self.log_path, "wb")
-        os.fsync(self._f.fileno())
-        self.appends_since_snapshot = 0
-        metrics.counter("data_service.journal.snapshots").add(1)
-
-    def close(self) -> None:
-        try:
-            self._f.close()
-        except OSError:
-            pass
-
-    # -- read side -------------------------------------------------------
-    def load(self) -> Tuple[Optional[Dict[str, Any]],
-                            List[Dict[str, Any]]]:
-        """``(snapshot|None, records)`` as found on disk.  A snapshot
-        that fails to parse is discarded (the log alone rebuilds state
-        from genesis); replay of the log stops at the first torn line."""
-        snap: Optional[Dict[str, Any]] = None
-        try:
-            with open(self.snap_path, encoding="utf-8") as f:
-                doc = json.load(f)
-            if doc.get("schema") == SNAP_SCHEMA:
-                snap = doc
-        except (OSError, ValueError):
-            snap = None
-        records: List[Dict[str, Any]] = []
-        try:
-            with open(self.log_path, encoding="utf-8") as f:
-                for line in f:
-                    if not line.endswith("\n"):
-                        break               # torn tail: crash mid-append
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        break
-                    if isinstance(rec, dict):
-                        records.append(rec)
-        except OSError:
-            pass
-        return snap, records
+        super().__init__(
+            prefix, snap_schema=SNAP_SCHEMA,
+            on_append=metrics.counter("data_service.journal.appends").add,
+            on_snapshot=metrics.counter("data_service.journal.snapshots").add)
 
 
 def _blank_state() -> Dict[str, Any]:

@@ -29,10 +29,34 @@ Wire protocol: the tracker's JSON-line vocabulary (``send_json`` /
 ``recv_json``), one request per connection; traced requests
 (``trace_id``/``parent_span`` keys) are handled under a
 ``serving.fleet.rpc`` span parented to the caller.
+
+**Durability (r17).**  With a ``journal=`` prefix (or
+``DMLC_REGISTRY_JOURNAL``) the registry write-ahead-journals every
+durable mutation — membership, the multi-model stable-pointer map, the
+per-replica directive queues, and the rollout machinery's active
+canaries + ledger — through the shared
+:class:`~dmlc_core_tpu.utils.durable.StateJournal` substrate, exactly
+the dispatcher's pattern.  A SIGKILLed registry restarted on the same
+port + journal resumes mid-rollout: the canary set, pending directive
+acks, and ledger replay from disk, and replicas re-attach via the
+heartbeat-is-registration idiom.  Volatile heartbeat *reports* (qps,
+queue pressure, p99) are deliberately not journaled — the next beat
+refreshes them.
+
+**Fencing + warm standby.**  A journaled registry stamps a monotonic
+``control_epoch`` on every reply and refreshes a
+:class:`~dmlc_core_tpu.utils.durable.FencedLease` beside the journal.
+A second registry started with ``standby=True`` on the same journal
+serves stale reads while polling the lease; when the lease expires it
+replays the journal, bumps the epoch, and takes over — after which the
+old primary's writes are rejected (``fenced``) and clients'
+:class:`~dmlc_core_tpu.transport.endpoints.EndpointSet` drops any
+lower-epoch reply.
 """
 
 from __future__ import annotations
 
+import json
 import socket
 import threading
 import time
@@ -45,13 +69,22 @@ from ...telemetry import trace as teltrace
 from ...telemetry.anomaly import StragglerBoard
 from ...telemetry.exposition import TelemetryServer
 from ...telemetry.timeseries import HistoryStore
+from ...transport.endpoints import EndpointSet, EndpointsLike
+from ...utils.durable import FencedLease, StateJournal
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
 from ...utils.parameter import get_env
 
-__all__ = ["ReplicaRegistry", "ReplicaAgent", "fleet_rpc"]
+__all__ = ["ReplicaRegistry", "ReplicaAgent", "fleet_rpc",
+           "replay_registry_state", "registry_main", "REGISTRY_SNAP_SCHEMA"]
 
 logger = get_logger()
+
+REGISTRY_SNAP_SCHEMA = "dmlc.fleet.registry.snapshot/1"
+
+#: membership facts journaled per replica (the durable half of a
+#: record; heartbeat report fields are volatile and live in ``_reports``)
+_MEMBER_KEYS = ("host", "port", "health_port", "model_id")
 
 #: replica report keys copied verbatim from a heartbeat into the record
 _REPORT_KEYS = ("health", "queue_fraction", "queue_depth", "inflight",
@@ -78,6 +111,113 @@ def fleet_rpc(addr: Tuple[str, int], obj: dict,
     return reply
 
 
+def _blank_registry_state() -> Dict[str, Any]:
+    return {"control_epoch": 0, "replicas": {}, "models": {},
+            "directives": {},
+            "rollouts": {"active": {}, "ledger": [], "seq": 0}}
+
+
+def replay_registry_state(snapshot: Optional[Dict[str, Any]],
+                          records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pure replay of registry journal ``records`` over ``snapshot`` (or
+    a blank state) — the registry mirror of the dispatcher's
+    :func:`~dmlc_core_tpu.pipeline.data_service.journal.replay_state`.
+    Unknown ops are skipped (forward compatibility) and records
+    referencing absent replicas/rollouts are skipped too, so *any*
+    prefix of a valid log replays without error — the property the HA
+    tests pin.
+
+    State shape (all JSON)::
+
+        {"control_epoch": int,
+         "replicas":   {jobid: {"host", "port", "health_port",
+                                "model_id"}},
+         "models":     {model_id: {"ckpt_dir", "step"}},
+         "directives": {jobid: [directive, ...]},
+         "rollouts":   {"active": {model_id: rollout-record},
+                        "ledger": [events], "seq": int}}
+    """
+    state = _blank_registry_state()
+    if snapshot:
+        for k in ("replicas", "models", "directives", "rollouts"):
+            v = snapshot.get(k)
+            if isinstance(v, dict):
+                state[k] = json.loads(json.dumps(v))    # deep copy
+        state["control_epoch"] = int(snapshot.get("control_epoch", 0))
+        state["rollouts"].setdefault("active", {})
+        state["rollouts"].setdefault("ledger", [])
+        state["rollouts"].setdefault("seq", 0)
+    ro_tab = state["rollouts"]
+    for rec in records:
+        op = rec.get("op")
+        if op == "epoch":
+            state["control_epoch"] = max(state["control_epoch"],
+                                         int(rec.get("control_epoch", 0)))
+        elif op == "replica":
+            state["replicas"][str(rec["jobid"])] = {
+                k: rec.get(k) for k in _MEMBER_KEYS}
+        elif op == "replica_gone":
+            jobid = str(rec.get("jobid"))
+            state["replicas"].pop(jobid, None)
+            state["directives"].pop(jobid, None)
+        elif op == "model":
+            state["models"][str(rec["model_id"])] = {
+                "ckpt_dir": rec.get("ckpt_dir"), "step": rec.get("step")}
+        elif op == "directive":
+            state["directives"].setdefault(str(rec["jobid"]), []) \
+                .append(dict(rec.get("directive") or {}))
+        elif op == "directives_drained":
+            jobid = str(rec.get("jobid"))
+            q = state["directives"].get(jobid) or []
+            q = q[int(rec.get("count", len(q))):]
+            if q:
+                state["directives"][jobid] = q
+            else:
+                state["directives"].pop(jobid, None)
+        elif op == "rollout_staged":
+            ro = dict(rec.get("rollout") or {})
+            if ro.get("model_id") is not None:
+                ro.setdefault("acked", [])
+                ro.setdefault("failed", [])
+                ro_tab["active"][str(ro["model_id"])] = ro
+                ro_tab["seq"] = max(int(ro_tab.get("seq", 0)),
+                                    int(rec.get("seq", 0)))
+        elif op == "rollout_ack":
+            rid = rec.get("rollout_id")
+            for ro in ro_tab["active"].values():
+                if ro.get("id") != rid:
+                    continue
+                side = "acked" if rec.get("ok", True) else "failed"
+                if rec["jobid"] not in ro[side]:
+                    ro[side].append(rec["jobid"])
+        elif op == "rollout_gone":
+            jobid = rec.get("jobid")
+            for ro in ro_tab["active"].values():
+                if jobid in (ro.get("canaries") or []):
+                    ro["canaries"].remove(jobid)
+        elif op == "rollout_finished":
+            # one fsync'd record = the atomic promote/rollback
+            # transition: close the rollout AND (on promote) move the
+            # stable pointer, so replay can never re-promote a closed
+            # rollout or close one whose pointer move was lost
+            model_id = str(rec.get("model_id"))
+            ro = ro_tab["active"].get(model_id)
+            if ro is not None and ro.get("id") == rec.get("rollout_id"):
+                del ro_tab["active"][model_id]
+                if rec.get("promoted"):
+                    state["models"][model_id] = {
+                        "ckpt_dir": rec.get("ckpt_dir"),
+                        "step": rec.get("step")}
+        elif op == "rollout_event":
+            ev = rec.get("event")
+            if isinstance(ev, dict):
+                ro_tab["ledger"].append(ev)
+    cap = 4096
+    if len(ro_tab["ledger"]) > cap:
+        ro_tab["ledger"] = ro_tab["ledger"][-cap:]
+    return state
+
+
 class ReplicaRegistry:
     """TCP control-plane server for the serving fleet.
 
@@ -92,11 +232,27 @@ class ReplicaRegistry:
     a :class:`TelemetryServer` with the fleet console (``/fleet``) and
     the rollout ledger (``/rollouts``) — the router usually fronts
     these instead, proxying over RPC.
+
+    ``journal`` (default ``DMLC_REGISTRY_JOURNAL``) enables the durable
+    control plane: a ``<prefix>.log``/``.snap`` journal pair plus a
+    ``<prefix>.lease`` fencing lease (TTL ``DMLC_CONTROL_LEASE_S``,
+    compaction threshold ``DMLC_REGISTRY_JOURNAL_SNAP_EVERY``).
+    ``standby=True`` makes this instance a warm standby on the shared
+    journal: reads are served from the replayed (possibly stale) state,
+    writes are refused, and the instance promotes itself once the
+    primary's lease expires.
     """
+
+    #: journal-before-mutate contract, checked by the dmlclint
+    #: ``durable-state`` rule: every method mutating these must journal
+    _DURABLE_STATE = ("_replicas", "_models", "_directives",
+                      "_control_epoch")
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  heartbeat_timeout_s: Optional[float] = None,
-                 telemetry_port: Optional[int] = None):
+                 telemetry_port: Optional[int] = None,
+                 journal: Optional[str] = None,
+                 standby: bool = False):
         if heartbeat_timeout_s is None:
             heartbeat_timeout_s = get_env("DMLC_ROUTER_HEARTBEAT_TIMEOUT",
                                           5.0)
@@ -104,8 +260,10 @@ class ReplicaRegistry:
         self.liveness = LivenessBoard(self.heartbeat_timeout_s)
         self.straggler_board = StragglerBoard()
         self._lock = threading.Lock()
-        #: jobid → replica record (address + latest heartbeat report)
+        #: jobid → membership record (address, model) — durable
         self._replicas: Dict[str, Dict[str, Any]] = {}
+        #: jobid → latest heartbeat report fields — volatile by design
+        self._reports: Dict[str, Dict[str, Any]] = {}
         #: model_id → {"ckpt_dir", "step"} — the stable pointer the
         #: rollout machinery moves on promote
         self._models: Dict[str, Dict[str, Any]] = {}
@@ -120,8 +278,40 @@ class ReplicaRegistry:
         self._srv.bind((host, port))
         self._srv.listen(64)
         self.host, self.port = self._srv.getsockname()[:2]
+        # -- durable control plane (r17) --------------------------------
+        if journal is None:
+            journal = get_env("DMLC_REGISTRY_JOURNAL", "") or None
+        self.standby = bool(standby)
+        self._fenced = False
+        self._control_epoch = 0
+        self._owner = f"{self.host}:{self.port}"
+        self._journal: Optional[StateJournal] = None
+        self._lease: Optional[FencedLease] = None
+        #: serializes journal appends against compaction; never held
+        #: while taking ``_lock`` inside an append path (``_jlog`` is
+        #: always called with no registry/rollout lock held)
+        self._jmutex = threading.Lock()
+        self._journal_snap_every = max(16, int(get_env(
+            "DMLC_REGISTRY_JOURNAL_SNAP_EVERY", 512)))
+        restored: Optional[Dict[str, Any]] = None
+        if journal:
+            self._journal = StateJournal(
+                str(journal), snap_schema=REGISTRY_SNAP_SCHEMA,
+                on_append=metrics.counter(
+                    "fleet.registry.journal.appends").add,
+                on_snapshot=metrics.counter(
+                    "fleet.registry.journal.snapshots").add)
+            self._lease = FencedLease(
+                str(journal) + ".lease",
+                ttl_s=float(get_env("DMLC_CONTROL_LEASE_S", 2.0)))
+            with self._lock:
+                restored = self._restore_locked()
         from .rollout import RolloutManager
         self.rollouts = RolloutManager(self)
+        if restored is not None:
+            self.rollouts._restore_state(restored.get("rollouts") or {})
+        if self._journal is not None and not self.standby:
+            self._become_primary()
         # fleet timeline: the registry's own counters plus synthetic
         # fleet-level gauges derived from heartbeat reports, so
         # /timeline answers "how did alive-count / aggregate inflight /
@@ -139,10 +329,130 @@ class ReplicaRegistry:
     def address(self) -> Tuple[str, int]:
         return (self.host, self.port)
 
+    # -- durable control plane (r17) -------------------------------------
+    def _jlog(self, op: str, **fields: Any) -> None:
+        """One write-ahead journal record; no-op without a journal.
+        Callers must not hold ``_lock`` or the rollout lock (compaction
+        takes ``_jmutex`` first, then those — same order everywhere)."""
+        if self._journal is None:
+            return
+        with self._jmutex:
+            self._journal.append({"op": op, "ts": time.time(), **fields})
+
+    def _durable_state_locked(self) -> Dict[str, Any]:
+        return {
+            "control_epoch": self._control_epoch,
+            "replicas": {j: {k: r.get(k) for k in _MEMBER_KEYS}
+                         for j, r in self._replicas.items()},
+            "models": {m: dict(ptr) for m, ptr in self._models.items()},
+            "directives": {j: [dict(d) for d in q]
+                           for j, q in self._directives.items() if q},
+        }
+
+    def _restore_locked(self) -> Optional[Dict[str, Any]]:
+        """Replay the journal into the membership / model / directive
+        tables; returns the full replayed state (the rollout slice is
+        applied by the caller once the RolloutManager exists)."""
+        self._replicas.clear()
+        self._models.clear()
+        self._directives.clear()
+        snap, records = self._journal.load()
+        if snap is None and not records:
+            return None
+        state = replay_registry_state(snap, records)
+        self._control_epoch = int(state.get("control_epoch", 0))
+        self._replicas = {j: {k: r.get(k) for k in _MEMBER_KEYS}
+                          for j, r in state.get("replicas", {}).items()}
+        self._models = {m: dict(p)
+                        for m, p in state.get("models", {}).items()}
+        self._directives = {j: [dict(d) for d in q]
+                            for j, q in state.get("directives", {}).items()
+                            if q}
+        now = time.monotonic()
+        for jobid in self._replicas:
+            # liveness grace: a restored replica gets a full heartbeat
+            # window to re-attach before the sweep declares it dead
+            self.liveness.beat(jobid)
+            self._last_beat[jobid] = now
+        self._m_replicas.set(len(self._replicas))
+        metrics.counter("fleet.registry.journal.replayed") \
+            .add(len(records))
+        log_info("fleet registry: replayed %d journal record(s) over "
+                 "%s snapshot → %d replica(s), %d model(s), epoch %d",
+                 len(records), "a" if snap else "no",
+                 len(self._replicas), len(self._models),
+                 self._control_epoch)
+        return state
+
+    def _become_primary(self) -> None:
+        """Claim (or re-claim) the fencing lease: bump the monotonic
+        ``control_epoch`` past anything the journal or lease has seen,
+        journal it, stamp the lease, and compact."""
+        lease_epoch = self._lease.current_epoch() if self._lease else 0
+        epoch = max(self._control_epoch, lease_epoch) + 1
+        self._jlog("epoch", control_epoch=epoch)
+        with self._lock:
+            self._control_epoch = epoch
+        self._fenced = False
+        if self._lease is not None:
+            self._lease.refresh(self._owner, epoch)
+        metrics.gauge("fleet.registry.control_epoch").set(epoch)
+        self._compact()
+        log_info("fleet registry %s: primary at control_epoch %d",
+                 self._owner, epoch)
+
+    def _compact(self) -> None:
+        if self._journal is None:
+            return
+        with self._jmutex:
+            with self._lock:
+                state = self._durable_state_locked()
+            state["rollouts"] = self.rollouts.durable_snapshot()
+            self._journal.compact(state)
+
+    def _fence_error(self) -> Optional[dict]:
+        """Reject writes once a standby has taken over: the on-disk
+        lease carrying a higher epoch than ours means we are the stale
+        primary.  Standbys refuse writes outright until promotion."""
+        if self._journal is None:
+            return None
+        if self.standby:
+            return {"error": "standby: not primary (reads only)",
+                    "control_epoch": self._control_epoch}
+        if not self._fenced and self._lease is not None:
+            if self._lease.current_epoch() > self._control_epoch:
+                self._fenced = True
+        if self._fenced:
+            metrics.counter("fleet.registry.fenced").add(1)
+            return {"error": f"fenced: control_epoch "
+                             f"{self._control_epoch} superseded",
+                    "control_epoch": self._control_epoch}
+        return None
+
+    def _standby_loop(self) -> None:
+        """Warm standby: poll the primary's lease; replay + take over
+        once it expires."""
+        poll = max(0.05, (self._lease.ttl_s if self._lease else 2.0) / 4.0)
+        while not self._stop_ev.wait(jittered(poll)):
+            if self._lease is None or not self._lease.expired():
+                continue
+            metrics.counter("fleet.registry.takeovers").add(1)
+            log_info("fleet registry %s: primary lease expired — "
+                     "taking over", self._owner)
+            with self._lock:
+                restored = self._restore_locked()
+            self.rollouts._restore_state(
+                (restored or {}).get("rollouts") or {})
+            self.standby = False
+            self._become_primary()
+            self._sweep_loop()
+            return
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ReplicaRegistry":
+        sweep = self._standby_loop if self.standby else self._sweep_loop
         for target, name in ((self._accept_loop, "fleet-registry-accept"),
-                             (self._sweep_loop, "fleet-registry-sweep")):
+                             (sweep, "fleet-registry-sweep")):
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -177,6 +487,10 @@ class ReplicaRegistry:
             pass
         for t in self._threads:
             t.join(timeout=5.0)
+        if self._journal is not None:
+            if not self.standby and not self._fenced:
+                self._compact()         # clean stop: snapshot + empty log
+            self._journal.close()
 
     def __enter__(self):
         return self
@@ -199,7 +513,8 @@ class ReplicaRegistry:
             for jobid, rec in self._replicas.items():
                 if model_id is not None and rec.get("model_id") != model_id:
                     continue
-                out[jobid] = {**rec, "alive": jobid not in dead,
+                out[jobid] = {**rec, **self._reports.get(jobid, {}),
+                              "alive": jobid not in dead,
                               "straggler": jobid in suspects}
             return out
 
@@ -266,6 +581,7 @@ class ReplicaRegistry:
     # -- rollout plumbing ------------------------------------------------
     def push_directive(self, jobid: str, directive: dict) -> None:
         """Queue a directive for a replica's next heartbeat reply."""
+        self._jlog("directive", jobid=jobid, directive=directive)
         with self._lock:
             self._directives.setdefault(jobid, []).append(directive)
 
@@ -275,6 +591,8 @@ class ReplicaRegistry:
 
     def set_stable_pointer(self, model_id: str, ckpt_dir: Optional[str],
                            step: Optional[int]) -> None:
+        self._jlog("model", model_id=model_id, ckpt_dir=ckpt_dir,
+                   step=step)
         with self._lock:
             self._models[model_id] = {"ckpt_dir": ckpt_dir, "step": step}
 
@@ -286,11 +604,25 @@ class ReplicaRegistry:
 
     def _sweep_loop(self) -> None:
         interval = max(0.05, self.heartbeat_timeout_s / 4.0)
+        if self._lease is not None:
+            interval = min(interval, max(0.05, self._lease.ttl_s / 3.0))
         while not self._stop_ev.wait(interval):
             for jobid, silence in self.liveness.sweep():
                 metrics.counter("fleet.registry.dead_replicas").add(1)
                 logger.warning("fleet registry: replica %r silent for "
                                "%.1fs — declaring dead", jobid, silence)
+            if self._lease is not None and not self._fenced:
+                if not self._lease.refresh(self._owner,
+                                           self._control_epoch):
+                    self._fenced = True
+                    logger.warning("fleet registry %s: fenced by a "
+                                   "standby takeover (epoch %d "
+                                   "superseded) — refusing writes",
+                                   self._owner, self._control_epoch)
+            if (self._journal is not None
+                    and self._journal.appends_since_snapshot
+                    >= self._journal_snap_every):
+                self._compact()
 
     # -- request handling ------------------------------------------------
     def _accept_loop(self) -> None:
@@ -331,7 +663,25 @@ class ReplicaRegistry:
             except OSError:
                 pass
 
+    #: commands that mutate durable state — fenced once a standby takes
+    #: over (reads keep flowing from a stale primary; writes must not)
+    _WRITE_CMDS = frozenset({"register_replica", "deregister_replica",
+                             "heartbeat", "set_model", "stage_rollout"})
+
     def _dispatch(self, msg: dict) -> dict:
+        cmd = msg.get("cmd")
+        if cmd in self._WRITE_CMDS:
+            fenced = self._fence_error()
+            if fenced is not None:
+                return fenced
+        reply = self._dispatch_cmd(msg)
+        if isinstance(reply, dict):
+            # every reply carries the fencing epoch: EndpointSet drops
+            # replies stamped lower than the highest it has seen
+            reply.setdefault("control_epoch", self._control_epoch)
+        return reply
+
+    def _dispatch_cmd(self, msg: dict) -> dict:
         cmd = msg.get("cmd")
         if cmd == "register_replica":
             return self._cmd_register(msg)
@@ -375,6 +725,7 @@ class ReplicaRegistry:
         rec = {"host": str(msg["host"]), "port": int(msg["port"]),
                "health_port": msg.get("health_port"),
                "model_id": str(msg.get("model_id") or "default")}
+        self._jlog("replica", jobid=jobid, **rec)
         with self._lock:
             self._replicas.setdefault(jobid, {}).update(rec)
             self._m_replicas.set(len(self._replicas))
@@ -389,8 +740,10 @@ class ReplicaRegistry:
 
     def _cmd_deregister(self, msg: dict) -> dict:
         jobid = str(msg["jobid"])
+        self._jlog("replica_gone", jobid=jobid)
         with self._lock:
             self._replicas.pop(jobid, None)
+            self._reports.pop(jobid, None)
             self._directives.pop(jobid, None)
             self._last_beat.pop(jobid, None)
             self._m_replicas.set(len(self._replicas))
@@ -413,8 +766,15 @@ class ReplicaRegistry:
         report = {k: msg[k] for k in _REPORT_KEYS if k in msg}
         with self._lock:
             if jobid in self._replicas:
-                self._replicas[jobid].update(report)
+                self._reports.setdefault(jobid, {}).update(report)
             directives = self._directives.pop(jobid, [])
+        if directives:
+            # journaled *after* the pop: a crash in between replays the
+            # directives (at-least-once — reloads are idempotent and
+            # acks dedup), never loses them.  count-based so a push
+            # racing this drain keeps its queue position on replay.
+            self._jlog("directives_drained", jobid=jobid,
+                       count=len(directives))
         state = msg.get("state")
         if isinstance(state, dict):
             # metric push riding the heartbeat: feeds cross-replica
@@ -440,14 +800,23 @@ class ReplicaAgent:
 
     ``report_overrides`` lets tests and operators force report fields
     (e.g. ``{"slo_breaches": 1}`` to drill the canary auto-rollback).
+
+    ``registry_addr`` accepts a single ``(host, port)`` tuple, a
+    ``"host:port,host:port"`` string, or a list of either: beats walk
+    the :class:`~dmlc_core_tpu.transport.endpoints.EndpointSet` in
+    sticky order, so a standby registry picks up the fleet's heartbeats
+    the moment it takes over (r17).
     """
 
-    def __init__(self, server: Any, registry_addr: Tuple[str, int], *,
+    def __init__(self, server: Any, registry_addr: EndpointsLike, *,
                  jobid: Optional[str] = None,
                  model_id: Optional[str] = None,
                  interval_s: Optional[float] = None):
         self.server = server
-        self.registry_addr = (str(registry_addr[0]), int(registry_addr[1]))
+        self.registry = EndpointSet(registry_addr,
+                                    env_prefix="DMLC_ROUTER",
+                                    name="fleet.agent")
+        self.registry_addr = self.registry.primary
         self.jobid = jobid or f"replica-{server.host}:{server.port}"
         self.model_id = (model_id or getattr(server, "model_id", None)
                          or "default")
@@ -513,9 +882,9 @@ class ReplicaAgent:
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ReplicaAgent":
         try:
-            fleet_rpc(self.registry_addr,
-                      {"cmd": "register_replica", **self._report()},
-                      timeout=5.0)
+            self.registry.call(lambda addr: fleet_rpc(
+                addr, {"cmd": "register_replica", **self._report()},
+                timeout=5.0))
         except (OSError, DMLCError) as e:
             # heartbeat auto-registration picks this up once the
             # registry is reachable
@@ -532,9 +901,9 @@ class ReplicaAgent:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
         try:
-            fleet_rpc(self.registry_addr,
-                      {"cmd": "deregister_replica", "jobid": self.jobid},
-                      timeout=2.0)
+            self.registry.call(lambda addr: fleet_rpc(
+                addr, {"cmd": "deregister_replica", "jobid": self.jobid},
+                timeout=2.0))
         except (OSError, DMLCError):
             pass               # registry gone — its sweep will notice
 
@@ -547,7 +916,8 @@ class ReplicaAgent:
                 if self._acks:
                     msg["applied"], self._acks = self._acks, []
             try:
-                reply = fleet_rpc(self.registry_addr, msg, timeout=5.0)
+                reply = self.registry.call(
+                    lambda addr: fleet_rpc(addr, msg, timeout=5.0))
             except (OSError, DMLCError) as e:
                 if not self._registry_down:
                     self._registry_down = True
@@ -560,3 +930,44 @@ class ReplicaAgent:
             self._registry_down = False
             for directive in reply.get("directives") or []:
                 self._apply(directive)
+
+
+def registry_main(argv=None) -> int:
+    """CLI: ``python -m dmlc_core_tpu.serving.fleet.registry [host=H]
+    [port=N] [journal=PREFIX] [standby=1] [heartbeat_timeout=S]`` —
+    serve until killed.
+
+    The chaos-drill surface, mirroring ``dispatcher_main``: the HA
+    tests run the registry as a subprocess, SIGKILL it mid-rollout, and
+    restart it (or promote a standby) on the same ``journal=`` to prove
+    the replay resumes the canary.  The bound port is printed as one
+    JSON line on stdout (``{"host": ..., "port": ...}``); SIGTERM is a
+    clean stop (journal compacted), SIGKILL is the crash the journal
+    exists for."""
+    import signal
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    kw = dict(a.split("=", 1) for a in args)
+    reg = ReplicaRegistry(
+        host=kw.get("host", "127.0.0.1"),
+        port=int(kw.get("port", 0)),
+        journal=kw.get("journal") or None,
+        standby=kw.get("standby", "") not in ("", "0", "false"),
+        heartbeat_timeout_s=(float(kw["heartbeat_timeout"])
+                             if "heartbeat_timeout" in kw else None))
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
+    reg.start()
+    print(json.dumps({"host": reg.host, "port": reg.port}), flush=True)
+    try:
+        while not done.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    reg.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(registry_main())

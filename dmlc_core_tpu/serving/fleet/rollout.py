@@ -55,7 +55,17 @@ class RolloutManager:
     model while one is in flight is an error (roll it back or let it
     bake out first).  All decisions run in a single watch thread, so
     state transitions are serialized per manager.
+
+    Rollout state is durable (r17): every transition forwards a journal
+    record through the owning registry's ``_jlog`` (a no-op without a
+    journal), so a SIGKILLed registry replays its active canaries,
+    pending acks, and ledger, and the bake resumes — the bake window
+    restarts from the replay (conservative), and the atomic
+    ``rollout_finished`` record guarantees a promote is applied exactly
+    once across restarts.
     """
+
+    _DURABLE_STATE = ("_active", "_ledger", "_seq")
 
     def __init__(self, registry: Any, *,
                  bake_s: Optional[float] = None,
@@ -81,6 +91,60 @@ class RolloutManager:
         self._seq = 0
         self._stop_ev = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    # -- durability (r17) ------------------------------------------------
+    def _jlog(self, op: str, **fields: Any) -> None:
+        """Forward a journal record to the owning registry's journal;
+        called with no locks held (the registry's compaction path takes
+        its journal mutex before the rollout lock)."""
+        reg_jlog = getattr(self.registry, "_jlog", None)
+        if reg_jlog is not None:
+            reg_jlog(op, **fields)
+
+    def durable_snapshot(self) -> Dict[str, Any]:
+        """The rollout slice of the registry's journal snapshot —
+        JSON-form active rollouts (sets as sorted lists, no monotonic
+        clocks) + ledger + the rollout-id sequence."""
+        with self._lock:
+            return {
+                "active": {
+                    m: {"id": r["id"], "model_id": r["model_id"],
+                        "ckpt_dir": r["ckpt_dir"], "step": r["step"],
+                        "canaries": list(r["canaries"]),
+                        "bake_s": r["bake_s"],
+                        "acked": sorted(r["acked"]),
+                        "failed": sorted(r["failed"])}
+                    for m, r in self._active.items()},
+                "ledger": list(self._ledger),
+                "seq": self._seq,
+            }
+
+    def _restore_state(self, state: Dict[str, Any]) -> None:
+        """Rebuild from a replayed journal state.  ``staged_at`` is a
+        monotonic clock that did not survive the crash, so the bake
+        window restarts now — a restored canary bakes a full window
+        before promoting, never a truncated one."""
+        if not state:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._active = {
+                str(m): {"id": r.get("id"), "model_id": str(m),
+                         "ckpt_dir": r.get("ckpt_dir"),
+                         "step": r.get("step"),
+                         "canaries": list(r.get("canaries") or []),
+                         "bake_s": float(r.get("bake_s") or self.bake_s),
+                         "staged_at": now,
+                         "acked": set(r.get("acked") or []),
+                         "failed": set(r.get("failed") or [])}
+                for m, r in (state.get("active") or {}).items()}
+            self._ledger.clear()
+            self._ledger.extend(state.get("ledger") or [])
+            self._seq = max(self._seq, int(state.get("seq") or 0))
+        if self._active:
+            log_info("rollout manager: restored %d active rollout(s) "
+                     "from journal — bake window restarted",
+                     len(self._active))
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -117,12 +181,16 @@ class RolloutManager:
                                  f" already in flight for {model_id!r}"}
             self._seq += 1
             rid = f"ro-{self._seq}"
+            seq = self._seq
             self._active[model_id] = {
                 "id": rid, "model_id": model_id, "ckpt_dir": ckpt_dir,
                 "step": step, "canaries": canaries, "bake_s": bake,
                 "staged_at": time.monotonic(), "acked": set(),
                 "failed": set(),
             }
+        self._jlog("rollout_staged", seq=seq, rollout={
+            "id": rid, "model_id": model_id, "ckpt_dir": ckpt_dir,
+            "step": step, "canaries": canaries, "bake_s": bake})
         for jobid in canaries:
             self.registry.push_directive(jobid, {
                 "kind": "reload", "rollout_id": rid,
@@ -148,16 +216,22 @@ class RolloutManager:
             else:
                 ro["failed"].add(jobid)
                 ro["fail_reason"] = ack.get("error")
+        self._jlog("rollout_ack", jobid=jobid, rollout_id=rid,
+                   ok=bool(ack.get("ok")), error=ack.get("error"))
 
     def on_replica_gone(self, jobid: str) -> None:
         """A canary that deregisters mid-bake stops counting toward the
         all-acked promotion condition."""
+        touched = False
         with self._lock:
             for ro in self._active.values():
                 if jobid in ro["canaries"]:
                     ro["canaries"] = [j for j in ro["canaries"]
                                       if j != jobid]
                     ro["acked"].discard(jobid)
+                    touched = True
+        if touched:
+            self._jlog("rollout_gone", jobid=jobid)
 
     # -- bake evaluation -------------------------------------------------
     def _watch_loop(self) -> None:
@@ -241,6 +315,14 @@ class RolloutManager:
             if self._active.get(model_id) is not ro:
                 return          # already finished by another path
             del self._active[model_id]
+        # one atomic journal record closes the rollout AND (on promote)
+        # moves the stable pointer: replay can never re-promote a closed
+        # rollout, which is what makes promotion exactly-once across
+        # registry crashes
+        self._jlog("rollout_finished", model_id=model_id,
+                   rollout_id=ro["id"], promoted=promoted,
+                   ckpt_dir=ro["ckpt_dir"], step=ro["step"],
+                   reason=reason)
         if promoted:
             self.registry.set_stable_pointer(model_id, ro["ckpt_dir"],
                                              ro["step"])
@@ -278,10 +360,11 @@ class RolloutManager:
     # -- ledger ----------------------------------------------------------
     def _record(self, event: str, rid: str, model_id: str,
                 **attrs: Any) -> None:
+        ev = {"ts": time.time(), "event": event, "rollout_id": rid,
+              "model_id": model_id, **attrs}
+        self._jlog("rollout_event", event=ev)
         with self._lock:
-            self._ledger.append({"ts": time.time(), "event": event,
-                                 "rollout_id": rid, "model_id": model_id,
-                                 **attrs})
+            self._ledger.append(ev)
 
     def snapshot(self) -> Dict[str, Any]:
         """The ``/rollouts`` body and the ``rollout_ledger`` flight

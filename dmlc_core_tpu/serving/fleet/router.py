@@ -43,6 +43,17 @@ Membership comes from either a static replica list or a
 ``DMLC_ROUTER_SYNC_INTERVAL``); replica ``/healthz`` bodies are polled
 directly at ``DMLC_ROUTER_HEALTH_INTERVAL`` for fresher load signal
 than heartbeat cadence provides.
+
+**Registry HA (r17).**  ``registry`` accepts an ordered endpoint list —
+a ``(host, port)`` tuple, a ``"host:port,host:port"`` string, or the
+``DMLC_ROUTER_REGISTRY`` env var — wrapped in a
+:class:`~dmlc_core_tpu.transport.endpoints.EndpointSet`: sticky
+failover with a per-endpoint circuit breaker, and client-side
+``control_epoch`` fencing so a reply from a fenced ex-primary is
+treated as a failure.  Between successful syncs the router serves the
+last-known fleet (stale-while-revalidate): requests keep flowing on the
+cached replica map while the sync loop revalidates in the background,
+and ``/healthz`` reports the cache age as ``replica_view_age_s``.
 """
 
 from __future__ import annotations
@@ -55,7 +66,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ...parallel.tracker import jittered
 from ...telemetry import trace as teltrace
+from ...transport.endpoints import EndpointSet, EndpointsLike
 from ...transport.frames import send_all
 from ...telemetry.exposition import TelemetryServer
 from ...utils.logging import DMLCError, get_logger, log_info
@@ -166,25 +179,36 @@ class ServingRouter:
     >>> router = ServingRouter(registry=reg.address).start()
     >>> client = PredictClient(router.host, router.port)
 
-    ``registry`` (a ``(host, port)`` tuple) enables dynamic membership,
-    straggler flags and the ``/rollouts`` proxy; ``replicas`` pins a
-    static fleet (items ``(host, port)`` or ``(host, port,
-    health_port)``) for registry-less deployments — both may be given,
-    the registry view then overlays the static seed.
+    ``registry`` (a ``(host, port)`` tuple, a ``"host:port,host:port"``
+    string, or a list of either — primary first, standbys after)
+    enables dynamic membership, straggler flags and the ``/rollouts``
+    proxy; when omitted, ``DMLC_ROUTER_REGISTRY`` supplies the list.
+    ``replicas`` pins a static fleet (items ``(host, port)`` or
+    ``(host, port, health_port)``) for registry-less deployments — both
+    may be given, the registry view then overlays the static seed.
     """
 
     def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
-                 registry: Optional[Tuple[str, int]] = None,
+                 registry: Optional[EndpointsLike] = None,
                  replicas: Optional[List[tuple]] = None,
                  telemetry_port: Optional[int] = None,
                  health_poll_s: Optional[float] = None,
                  sync_s: Optional[float] = None,
                  backlog: int = 64):
+        if registry is None:
+            registry = get_env("DMLC_ROUTER_REGISTRY", "") or None
         if registry is None and not replicas:
-            raise DMLCError("ServingRouter needs a registry address or "
-                            "a static replica list")
-        self.registry_addr = (None if registry is None
-                              else (str(registry[0]), int(registry[1])))
+            raise DMLCError("ServingRouter needs a registry address "
+                            "(arg or DMLC_ROUTER_REGISTRY) or a static "
+                            "replica list")
+        self._registry: Optional[EndpointSet] = (
+            None if registry is None
+            else EndpointSet(registry, env_prefix="DMLC_ROUTER",
+                             name="router.registry"))
+        # compat alias: the preferred primary as a plain tuple
+        self.registry_addr = (None if self._registry is None
+                              else self._registry.primary)
+        self._last_sync = 0.0        # time.monotonic() of last good sync
         if health_poll_s is None:
             health_poll_s = get_env("DMLC_ROUTER_HEALTH_INTERVAL", 0.5)
         if sync_s is None:
@@ -285,11 +309,17 @@ class ServingRouter:
         self.stop()
 
     # -- membership ------------------------------------------------------
+    def _registry_rpc(self, msg: dict, timeout: float = 5.0) -> dict:
+        """One registry round trip over the endpoint set: sticky
+        failover across standbys, breaker-gated, fencing-aware."""
+        assert self._registry is not None
+        return self._registry.call(
+            lambda addr: fleet_rpc(addr, msg, timeout=timeout))
+
     def sync_replicas(self) -> None:
         """One registry round trip: overlay membership, health,
         straggler and liveness flags onto the local replica map."""
-        listing = fleet_rpc(self.registry_addr, {"cmd": "list_replicas"},
-                            timeout=5.0)["replicas"]
+        listing = self._registry_rpc({"cmd": "list_replicas"})["replicas"]
         seen = set()
         with self._rlock:
             for r in listing:
@@ -317,11 +347,12 @@ class ServingRouter:
         for rep in dropped:
             log_info("router: replica %s left the registry", rep.key)
             self._kill_backend(rep)
+        self._last_sync = time.monotonic()
         metrics.gauge("serving.router.replicas").set(len(listing))
 
     def _sync_loop(self) -> None:
         down = False
-        while not self._stop_ev.wait(self.sync_s):
+        while not self._stop_ev.wait(jittered(self.sync_s)):
             try:
                 self.sync_replicas()
                 down = False
@@ -332,7 +363,7 @@ class ServingRouter:
                                    "serving last-known fleet", e)
 
     def _health_loop(self) -> None:
-        while not self._stop_ev.wait(self.health_poll_s):
+        while not self._stop_ev.wait(jittered(self.health_poll_s)):
             with self._rlock:
                 reps = list(self._replicas.values())
             for rep in reps:
@@ -655,8 +686,21 @@ class ServingRouter:
             status = "overloaded"
         with self._plock:
             inflight = len(self._pending)
-        return {"status": status, "replicas": len(reps),
-                "usable_replicas": len(usable), "inflight": inflight}
+        doc = {"status": status, "replicas": len(reps),
+               "usable_replicas": len(usable), "inflight": inflight}
+        if self._registry is not None:
+            # stale-while-revalidate: how old the cached replica view is
+            # (the router keeps serving it while the sync loop retries)
+            age = (time.monotonic() - self._last_sync
+                   if self._last_sync else -1.0)
+            metrics.gauge("serving.router.replica_view_age_s").set(
+                max(0.0, age))
+            doc["replica_view_age_s"] = round(age, 3)
+            doc["replica_view_stale"] = age > 3 * self.sync_s
+            h, p = self._registry.current()
+            doc["registry_endpoint"] = f"{h}:{p}"
+            doc["registry_control_epoch"] = self._registry.control_epoch()
+        return doc
 
     def fleet_snapshot(self) -> Dict[str, Any]:
         """Router-local ``/fleet`` body — the balancer's live view (the
@@ -679,25 +723,26 @@ class ServingRouter:
                 "replicas": replicas, "models": {}}
 
     def _rollouts_proxy(self) -> Dict[str, Any]:
-        return fleet_rpc(self.registry_addr, {"cmd": "rollouts"},
-                         timeout=5.0)
+        return self._registry_rpc({"cmd": "rollouts"})
 
 
 def router_main(argv=None) -> int:
     """CLI: ``python -m dmlc_core_tpu.serving.fleet.router
-    registry=HOST:PORT [port=N] [host=0.0.0.0]`` — run a router against
-    a replica registry until interrupted."""
+    registry=HOST:PORT[,HOST:PORT...] [port=N] [host=0.0.0.0]`` — run a
+    router against a replica registry (primary first, warm standbys
+    after; ``DMLC_ROUTER_REGISTRY`` works too) until interrupted."""
+    import os as _os
     import sys
     args = dict(a.split("=", 1) for a in (sys.argv[1:] if argv is None
                                           else argv))
-    if "registry" not in args and "replicas" not in args:
-        print("usage: serving.fleet.router registry=HOST:PORT [port=0] "
-              "[host=0.0.0.0] | replicas=H:P,H:P,...", file=sys.stderr)
+    if ("registry" not in args and "replicas" not in args
+            and not _os.environ.get("DMLC_ROUTER_REGISTRY")):
+        print("usage: serving.fleet.router registry=HOST:PORT[,H:P...] "
+              "[port=0] [host=0.0.0.0] | replicas=H:P,H:P,...",
+              file=sys.stderr)
         return 2
-    registry = None
-    if "registry" in args:
-        h, _, p = args["registry"].rpartition(":")
-        registry = (h, int(p))
+    # EndpointSet parses the comma list (and env fallback) itself
+    registry = args.get("registry")
     replicas = None
     if "replicas" in args:
         replicas = []

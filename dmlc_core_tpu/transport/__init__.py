@@ -15,8 +15,12 @@ Three capabilities live here:
 * :mod:`.plan` — the round-structured reshard transfer planner
   (holder-balanced, in-flight bytes per round bounded by
   ``DMLC_RESHARD_MAX_BYTES``).
+* :mod:`.endpoints` — ordered control-plane endpoint lists
+  (``host:port,host:port``) with per-endpoint circuit breakers, sticky
+  failover, and ``control_epoch`` fencing of stale primaries (r17).
 """
 
+from .endpoints import EndpointSet, parse_endpoints
 from .frames import (CTRL_FDPASS, CTRL_TRANSPORT, FRAME, NO_ROWS,
                      FrameWriter, available_codecs, choose_codec,
                      get_codec, negotiate_reply, pack_obj, requested_codec,
@@ -26,6 +30,7 @@ from .lane import (connect_lane, fd_passing_ok, host_token, lane_enabled,
 from .plan import Transfer, plan_rounds
 
 __all__ = [
+    "EndpointSet", "parse_endpoints",
     "CTRL_FDPASS", "CTRL_TRANSPORT", "FRAME", "NO_ROWS", "FrameWriter",
     "available_codecs", "choose_codec", "get_codec", "negotiate_reply",
     "pack_obj", "requested_codec", "send_all", "unpack_obj",

@@ -1,0 +1,170 @@
+"""Ordered control-plane endpoint lists with breaker-gated failover.
+
+Every control-plane singleton now has a warm standby (r17): the
+dispatcher, the serving-fleet registry, and the rabit tracker journal
+through :class:`~dmlc_core_tpu.utils.durable.StateJournal` and a standby
+can replay the shared journal and take over.  The client half of that
+story lives here: :class:`EndpointSet` holds the ordered
+``host:port,host:port`` list (``ServingRouter``/``ReplicaAgent``/
+``DataServiceLoader`` all accept it), dials endpoints in sticky order —
+whoever answered last answers next — and gates each endpoint behind its
+own :class:`~dmlc_core_tpu.utils.retry.CircuitBreaker` so one dead
+primary costs one breaker-threshold of probes, not a full retry
+schedule per request.
+
+Fencing rides the same path: control-plane replies are stamped with a
+monotonic ``control_epoch``, and :meth:`EndpointSet.call` remembers the
+highest epoch it has seen.  A reply carrying a *lower* epoch is from a
+fenced primary (dead but not yet aware a standby took over); the call
+treats it as a failure and fails over to the next endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, List, Tuple, Union
+
+from ..utils.logging import DMLCError, get_logger
+from ..utils.metrics import metrics
+from ..utils.retry import CircuitBreaker, CircuitOpen
+
+__all__ = ["EndpointSet", "parse_endpoints"]
+
+logger = get_logger()
+
+EndpointsLike = Union[str, Tuple[Any, Any], Iterable[Any]]
+
+
+def parse_endpoints(spec: EndpointsLike) -> List[Tuple[str, int]]:
+    """Normalize an endpoint spec to ``[(host, port), ...]``.
+
+    Accepts a single ``(host, port)`` tuple, a ``"host:port,host:port"``
+    string (the ``DMLC_ROUTER_REGISTRY`` shape; IPv6 hosts use the last
+    colon as the separator), or any iterable mixing both.  Order is
+    preserved — the first endpoint is the preferred primary — and exact
+    duplicates are dropped.
+    """
+    out: List[Tuple[str, int]] = []
+
+    def _add(host: Any, port: Any) -> None:
+        ep = (str(host), int(port))
+        if ep not in out:
+            out.append(ep)
+
+    def _one(item: Any) -> None:
+        if isinstance(item, str):
+            for part in item.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                host, sep, port = part.rpartition(":")
+                if not sep:
+                    raise DMLCError(f"endpoint {part!r} is not host:port")
+                _add(host, port)
+        elif (isinstance(item, (tuple, list)) and len(item) == 2
+                and not isinstance(item[0], (tuple, list))):
+            _add(item[0], item[1])
+        else:
+            for sub in item:
+                _one(sub)
+
+    _one(spec)
+    if not out:
+        raise DMLCError(f"endpoint spec {spec!r} names no endpoints")
+    return out
+
+
+class EndpointSet:
+    """Sticky ordered failover over a parsed endpoint list.
+
+    ``call(fn)`` invokes ``fn(addr)`` starting at the endpoint that last
+    succeeded, walking the ring on ``OSError``/:class:`DMLCError` while
+    skipping endpoints whose breaker is open.  ``env_prefix`` names the
+    breaker knob family (``<PREFIX>_BREAKER_THRESHOLD`` /
+    ``<PREFIX>_BREAKER_COOLDOWN``), matching the caller's existing
+    resilience vocabulary.
+    """
+
+    def __init__(self, endpoints: EndpointsLike, *,
+                 env_prefix: str = "DMLC_ENDPOINTS",
+                 name: str = "endpoints"):
+        self.endpoints = parse_endpoints(endpoints)
+        self.name = str(name)
+        self._breakers = [
+            CircuitBreaker.from_env(env_prefix, name=f"{name}.{h}:{p}")
+            for h, p in self.endpoints]
+        self._lock = threading.Lock()
+        self._current = 0
+        self._max_epoch = 0
+
+    def __len__(self) -> int:
+        return len(self.endpoints)
+
+    @property
+    def primary(self) -> Tuple[str, int]:
+        return self.endpoints[0]
+
+    def current(self) -> Tuple[str, int]:
+        """The endpoint the next :meth:`call` dials first."""
+        with self._lock:
+            return self.endpoints[self._current]
+
+    def control_epoch(self) -> int:
+        """Highest ``control_epoch`` seen in any reply (0 before the
+        first stamped reply)."""
+        with self._lock:
+            return self._max_epoch
+
+    # -- the failover walk ----------------------------------------------
+    def call(self, fn: Callable[[Tuple[str, int]], Any]) -> Any:
+        errors: List[str] = []
+        with self._lock:
+            start = self._current
+        n = len(self.endpoints)
+        for i in range(n):
+            idx = (start + i) % n
+            addr = self.endpoints[idx]
+            breaker = self._breakers[idx]
+            try:
+                breaker.allow()
+            except CircuitOpen as e:
+                errors.append(f"{addr[0]}:{addr[1]}: {e}")
+                continue
+            try:
+                out = fn(addr)
+            except (OSError, DMLCError) as e:
+                breaker.record_failure()
+                errors.append(f"{addr[0]}:{addr[1]}: "
+                              f"{type(e).__name__}: {e}")
+                continue
+            if self._stale_reply(addr, out):
+                breaker.record_failure()
+                errors.append(f"{addr[0]}:{addr[1]}: fenced (stale "
+                              f"control_epoch)")
+                continue
+            breaker.record_success()
+            with self._lock:
+                if self._current != idx:
+                    self._current = idx
+                    metrics.counter("transport.endpoints.failovers").add(1)
+                    logger.warning("endpoint set %r: failed over to "
+                                   "%s:%d", self.name, addr[0], addr[1])
+            return out
+        raise DMLCError(f"endpoint set {self.name!r}: all "
+                        f"{n} endpoint(s) failed: " + "; ".join(errors))
+
+    def _stale_reply(self, addr: Tuple[str, int], out: Any) -> bool:
+        """Client-side fencing: a reply stamped with a lower
+        ``control_epoch`` than the highest seen is from a fenced
+        primary — reject it and fail over."""
+        if not isinstance(out, dict):
+            return False
+        epoch = out.get("control_epoch")
+        if epoch is None:
+            return False
+        epoch = int(epoch)
+        with self._lock:
+            if epoch < self._max_epoch:
+                return True
+            self._max_epoch = epoch
+        return False

@@ -1,0 +1,611 @@
+"""Control-plane HA (r17): kill-any-singleton chaos drill.
+
+Every control-plane singleton — the serving-fleet registry, the rabit
+tracker, the data-service dispatcher — journals through the shared
+:class:`~dmlc_core_tpu.utils.durable.StateJournal` substrate and is
+killable: one subprocess harness (:func:`_spawn_singleton`) spawns each
+one's module CLI, SIGKILLs it at the worst moment, and restarts it on
+the same port + journal.
+
+Targets:
+
+* **registry mid-canary** — live serving load through the router while
+  the registry dies between canary ack and promote; zero failed
+  requests, exactly-once promote after the restart.
+* **tracker mid-epoch** — an assigned cohort's tracker dies; restarted
+  on the same journal it re-admits every worker at its old rank and the
+  current generation (no spurious reset), while a *moved* worker still
+  bumps the generation.
+* **dispatcher mid-epoch** — the journal drill from
+  ``test_data_service_v2`` rerun through the shared harness with the
+  consumer on a multi-endpoint list (dead endpoint first), proving no
+  replayed ingest frames.
+
+Plus the write-ahead property tests: any prefix of the registry journal
+replays consistent, a fenced (superseded) primary refuses writes, and a
+warm standby takes over the lease with a higher ``control_epoch``.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+from dmlc_core_tpu.data import create_parser  # noqa: E402
+from dmlc_core_tpu.parallel.tracker import (  # noqa: E402
+    recv_json, send_json)
+from dmlc_core_tpu.pipeline.data_service import (  # noqa: E402
+    DataServiceLoader, DataServiceWorker)
+from dmlc_core_tpu.pipeline.device_loader import (  # noqa: E402
+    DeviceLoader, _fused_words_meta)
+from dmlc_core_tpu.models import SparseLogReg  # noqa: E402
+from dmlc_core_tpu.serving import (  # noqa: E402
+    BucketLadder, InferenceEngine, PredictionServer, ReplicaAgent,
+    ReplicaRegistry, ServingRouter, fleet_rpc, run_load)
+from dmlc_core_tpu.serving.fleet.registry import (  # noqa: E402
+    REGISTRY_SNAP_SCHEMA, replay_registry_state)
+from dmlc_core_tpu.transport.endpoints import (  # noqa: E402
+    EndpointSet, parse_endpoints)
+from dmlc_core_tpu.utils import CheckpointManager  # noqa: E402
+from dmlc_core_tpu.utils.durable import FencedLease, StateJournal  # noqa: E402
+from dmlc_core_tpu.utils.logging import DMLCError  # noqa: E402
+from dmlc_core_tpu.utils.metrics import metrics  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+F = 2000
+BATCH_ROWS = 32
+NNZ_CAP = 1024
+
+
+def _wait_for(cond, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _free_port():
+    """A port nothing listens on (bound once, then released)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# the kill-any-singleton harness
+# ---------------------------------------------------------------------------
+
+def _spawn_singleton(module, **kw):
+    """Spawn ``python -m <module> k=v ...``; every singleton CLI prints
+    one JSON bind line on stdout.  Returns ``(proc, (host, port))``."""
+    args = [f"{k}={v}" for k, v in kw.items()]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module] + args,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    line = proc.stdout.readline()
+    assert line, f"{module} subprocess died before binding"
+    doc = json.loads(line)
+    return proc, (str(doc["host"]), int(doc["port"]))
+
+
+def _sigkill(proc):
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# endpoint sets: grammar + fencing (the client half of HA)
+# ---------------------------------------------------------------------------
+
+def test_parse_endpoints_grammar():
+    assert parse_endpoints(("h", 1)) == [("h", 1)]
+    assert parse_endpoints("a:1,b:2, a:1") == [("a", 1), ("b", 2)]
+    assert parse_endpoints([("a", 1), "b:2,c:3"]) == \
+        [("a", 1), ("b", 2), ("c", 3)]
+    # IPv6: the LAST colon separates host from port
+    assert parse_endpoints("::1:9000") == [("::1", 9000)]
+    with pytest.raises(DMLCError):
+        parse_endpoints("")
+    with pytest.raises(DMLCError):
+        parse_endpoints("noport")
+
+
+def test_endpointset_failover_and_stale_epoch_rejection():
+    es = EndpointSet("a:1,b:2", name="t")
+    calls = []
+
+    def fn_factory(replies):
+        def fn(addr):
+            calls.append(addr)
+            out = replies[addr]
+            if isinstance(out, Exception):
+                raise out
+            return out
+        return fn
+
+    # primary answers: sticky
+    assert es.call(fn_factory({("a", 1): {"ok": 1, "control_epoch": 3},
+                               ("b", 2): {"ok": 2}})) == \
+        {"ok": 1, "control_epoch": 3}
+    assert es.control_epoch() == 3
+    # primary dead → walk to b; b becomes the sticky current endpoint
+    out = es.call(fn_factory({("a", 1): OSError("down"),
+                              ("b", 2): {"ok": 2, "control_epoch": 4}}))
+    assert out == {"ok": 2, "control_epoch": 4}
+    assert es.current() == ("b", 2)
+    # a reply stamped BELOW the highest seen epoch is a fenced primary:
+    # rejected, call lands on the other endpoint
+    out = es.call(fn_factory({("b", 2): {"ok": "stale",
+                                         "control_epoch": 3},
+                              ("a", 1): {"ok": "fresh",
+                                         "control_epoch": 4}}))
+    assert out["ok"] == "fresh"
+
+
+# ---------------------------------------------------------------------------
+# registry journal: prefix-replay property
+# ---------------------------------------------------------------------------
+
+def _assert_registry_consistent(state):
+    assert int(state["control_epoch"]) >= 0
+    for jobid, rec in state["replicas"].items():
+        assert isinstance(jobid, str) and "host" in rec and "port" in rec
+    for jobid, q in state["directives"].items():
+        assert q, (jobid, "empty directive queue survived replay")
+    ro = state["rollouts"]
+    for model_id, r in ro["active"].items():
+        assert r.get("id") and r.get("model_id") == model_id
+        assert set(r.get("acked", [])) <= set(r.get("canaries", [])) | \
+            set(r.get("acked", []))       # lists of jobids, no junk
+    assert len(ro["ledger"]) <= 4096
+
+
+def test_any_registry_journal_prefix_replays_consistent(tmp_path):
+    """A crash can truncate the registry log after ANY record; every
+    prefix must replay to a consistent control-plane state with a
+    monotone ``control_epoch``."""
+    prefix = str(tmp_path / "reg" / "registry")
+    reg = ReplicaRegistry(heartbeat_timeout_s=60.0, journal=prefix)
+    reg.start()
+    try:
+        addr = reg.address
+        for i in (1, 2):
+            fleet_rpc(addr, {"cmd": "register_replica",
+                             "jobid": f"r{i}", "host": "127.0.0.1",
+                             "port": 9000 + i, "model_id": "default"})
+        fleet_rpc(addr, {"cmd": "set_model", "model_id": "default",
+                         "ckpt_dir": "/ck/v1", "step": 1})
+        staged = fleet_rpc(addr, {"cmd": "stage_rollout",
+                                  "model_id": "default",
+                                  "ckpt_dir": "/ck/v2", "step": 2,
+                                  "fraction": 0.5, "bake_s": 600.0})
+        canary = staged["canaries"][0]
+        # heartbeat drains the reload directive, then acks it
+        hb = fleet_rpc(addr, {"cmd": "heartbeat", "jobid": canary})
+        assert [d["kind"] for d in hb["directives"]] == ["reload"]
+        fleet_rpc(addr, {"cmd": "heartbeat", "jobid": canary,
+                         "applied": [{"rollout_id": staged["rollout_id"],
+                                      "kind": "reload", "ok": True}]})
+        fleet_rpc(addr, {"cmd": "deregister_replica", "jobid": "r2"})
+        # read the journal BEFORE the clean stop compacts it away
+        snap, records = StateJournal(
+            prefix, snap_schema=REGISTRY_SNAP_SCHEMA).load()
+    finally:
+        reg.stop()
+    assert len(records) >= 7          # epoch/replica/model/rollout mix
+    last_epoch = 0
+    for k in range(len(records) + 1):
+        state = replay_registry_state(snap, records[:k])
+        _assert_registry_consistent(state)
+        assert state["control_epoch"] >= last_epoch
+        last_epoch = state["control_epoch"]
+    full = replay_registry_state(snap, records)
+    assert set(full["replicas"]) == {"r1"}        # r2 deregistered
+    assert full["models"]["default"]["ckpt_dir"] == "/ck/v1"
+    ro = full["rollouts"]["active"]["default"]
+    assert ro["canaries"] == [canary] and ro["acked"] == [canary]
+
+
+# ---------------------------------------------------------------------------
+# fencing: stale primary + warm-standby takeover
+# ---------------------------------------------------------------------------
+
+def test_stale_primary_writes_rejected_by_control_epoch(tmp_path):
+    prefix = str(tmp_path / "fence" / "registry")
+    with ReplicaRegistry(heartbeat_timeout_s=60.0, journal=prefix) as reg:
+        reg.start()
+        epoch = reg._control_epoch
+        assert epoch >= 1
+        fleet_rpc(reg.address, {"cmd": "register_replica", "jobid": "r1",
+                                "host": "127.0.0.1", "port": 9001})
+        # a standby took over: the shared lease now carries a higher
+        # epoch than this (GC-paused, network-partitioned, ...) primary
+        FencedLease(prefix + ".lease", ttl_s=60.0) \
+            .refresh("usurper", epoch + 1)
+        with pytest.raises(DMLCError, match="fenced"):
+            fleet_rpc(reg.address, {"cmd": "set_model",
+                                    "model_id": "default",
+                                    "ckpt_dir": "/ck", "step": 1})
+        # reads keep flowing from the fenced primary (stale-read mode);
+        # the reply's epoch lets EndpointSet clients reject it
+        listing = fleet_rpc(reg.address, {"cmd": "list_replicas"})
+        assert listing["control_epoch"] == epoch
+
+
+def test_warm_standby_takes_over_expired_lease(tmp_path, monkeypatch):
+    monkeypatch.setenv("DMLC_CONTROL_LEASE_S", "0.4")
+    prefix = str(tmp_path / "ha" / "registry")
+    primary = ReplicaRegistry(heartbeat_timeout_s=60.0, journal=prefix)
+    primary.start()
+    fleet_rpc(primary.address, {"cmd": "register_replica", "jobid": "r1",
+                                "host": "127.0.0.1", "port": 9001,
+                                "model_id": "default"})
+    epoch1 = primary._control_epoch
+    standby = ReplicaRegistry(heartbeat_timeout_s=60.0, journal=prefix,
+                              standby=True)
+    standby.start()
+    try:
+        # a standby refuses writes outright pre-promotion
+        with pytest.raises(DMLCError, match="standby"):
+            fleet_rpc(standby.address, {"cmd": "set_model",
+                                        "model_id": "default",
+                                        "ckpt_dir": "/ck", "step": 1})
+        # crash the primary: no stop(), the lease simply stops refreshing
+        primary._stop_ev.set()
+        try:
+            primary._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        primary._srv.close()
+        primary.rollouts.stop()
+        primary._journal.close()
+        assert _wait_for(lambda: not standby.standby, timeout=10.0), \
+            "standby never took over the expired lease"
+        # the takeover replayed the shared journal and bumped the epoch
+        es = EndpointSet([primary.address, standby.address],
+                         name="ha.client")
+        listing = es.call(lambda addr: fleet_rpc(
+            addr, {"cmd": "list_replicas"}, timeout=2.0))
+        assert [r["jobid"] for r in listing["replicas"]] == ["r1"]
+        assert listing["control_epoch"] > epoch1
+        assert es.current() == standby.address
+        ok = es.call(lambda addr: fleet_rpc(
+            addr, {"cmd": "set_model", "model_id": "default",
+                   "ckpt_dir": "/ck/v2", "step": 2}, timeout=2.0))
+        assert ok["ok"] and standby.stable_pointer(
+            "default")["ckpt_dir"] == "/ck/v2"
+    finally:
+        standby.stop()
+
+
+# ---------------------------------------------------------------------------
+# drill target 1: registry SIGKILLed mid-canary
+# ---------------------------------------------------------------------------
+
+def _engine(w_scale=1.0):
+    model = SparseLogReg(num_features=F)
+    params = {"w": jnp.full((F,), w_scale, jnp.float32),
+              "b": jnp.float32(0.0)}
+    return InferenceEngine(model, params,
+                           buckets=BucketLadder([(16, 512)]))
+
+
+def _save_ckpt(directory, step, scale):
+    params = {"w": jnp.full((F,), scale, jnp.float32),
+              "b": jnp.float32(0.0)}
+    CheckpointManager(str(directory)).save(
+        step, {"params": params, "opt_state": {"count": jnp.int32(0)}},
+        meta={"model": "logreg"})
+
+
+def _req(rng, rows=4, nnz_per_row=16):
+    counts = rng.integers(1, nnz_per_row + 1, size=rows)
+    ids = rng.integers(0, F, size=int(counts.sum())).astype(np.int32)
+    vals = rng.random(len(ids), dtype=np.float32)
+    row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+    return ids, vals, row_ptr
+
+
+def test_registry_sigkilled_mid_canary_exactly_once_promote(
+        tmp_path, monkeypatch):
+    """The registry dies between canary ack and promote, under live
+    serving load.  The router serves its cached fleet through the dead
+    window (zero failed requests), the restarted registry replays the
+    canary set + pending acks, the bake re-runs, and the promote lands
+    exactly once."""
+    monkeypatch.setenv("DMLC_ROUTER_RETRIES", "6")
+    # short breaker cooldowns so agents/router re-attach promptly after
+    # the restart instead of waiting out a 5 s open circuit
+    monkeypatch.setenv("DMLC_ROUTER_BREAKER_COOLDOWN", "0.3")
+    monkeypatch.setenv("DMLC_ROUTER_BREAKER_THRESHOLD", "3")
+    ck_v1, ck_v2 = tmp_path / "v1", tmp_path / "v2"
+    _save_ckpt(ck_v1, 1, 1.0)
+    _save_ckpt(ck_v2, 2, 5.0)
+    journal = str(tmp_path / "reg" / "registry")
+    reg_proc, addr = _spawn_singleton(
+        "dmlc_core_tpu.serving.fleet.registry",
+        port=0, journal=journal, heartbeat_timeout=5.0)
+    port = addr[1]
+    fleet_rpc(addr, {"cmd": "set_model", "model_id": "default",
+                     "ckpt_dir": str(ck_v1), "step": 1})
+    pairs = []
+    router = None
+    report = {}
+    try:
+        for _ in range(2):
+            srv = PredictionServer(_engine(), metrics_port=0).start()
+            ag = ReplicaAgent(srv, addr, interval_s=0.1).start()
+            pairs.append((srv, ag))
+        assert _wait_for(lambda: len(fleet_rpc(
+            addr, {"cmd": "list_replicas"})["replicas"]) == 2)
+        # the router takes the registry as a comma-string endpoint spec
+        # (the DMLC_ROUTER_REGISTRY shape)
+        router = ServingRouter(registry=f"127.0.0.1:{port}",
+                               sync_s=0.2, health_poll_s=0.2).start()
+
+        def load():
+            report.update(run_load(
+                router.host, router.port, requests=500, concurrency=2,
+                pipeline_depth=4, rows_per_req=4, nnz_per_row=16,
+                features=F, timeout=60.0, model_id="default"))
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.3)                       # load established
+        staged = fleet_rpc(addr, {
+            "cmd": "stage_rollout", "model_id": "default",
+            "ckpt_dir": str(ck_v2), "step": 2, "fraction": 0.5,
+            "bake_s": 3.0})
+        canary = staged["canaries"][0]
+
+        def canary_acked():
+            ro = fleet_rpc(addr, {"cmd": "rollouts"},
+                           timeout=2.0)["active"].get("default")
+            return ro is not None and canary in ro["acked"]
+
+        assert _wait_for(canary_acked, timeout=15.0), \
+            "canary never acked its reload"
+        # -- the kill: acked but not yet promoted (3 s bake) ------------
+        _sigkill(reg_proc)
+        reg_proc, addr2 = _spawn_singleton(
+            "dmlc_core_tpu.serving.fleet.registry",
+            port=port, journal=journal, heartbeat_timeout=5.0)
+        assert addr2 == addr
+        # the replayed rollout carries the canary set + pending ack and
+        # the bake window restarted
+        ro = fleet_rpc(addr, {"cmd": "rollouts"})["active"]["default"]
+        assert ro["id"] == staged["rollout_id"]
+        assert ro["canaries"] == [canary] and canary in ro["acked"]
+
+        def promoted():
+            doc = fleet_rpc(addr, {"cmd": "rollouts"}, timeout=2.0)
+            return not doc["active"] and any(
+                e["event"] == "promoted" for e in doc["events"])
+
+        assert _wait_for(promoted, timeout=30.0), \
+            fleet_rpc(addr, {"cmd": "rollouts"})
+        doc = fleet_rpc(addr, {"cmd": "rollouts"})
+        events = Counter(e["event"] for e in doc["events"])
+        assert events["promoted"] == 1        # exactly-once across the kill
+        assert events["staged"] == 1
+        assert events.get("rolled_back", 0) == 0
+        assert fleet_rpc(addr, {"cmd": "models"})["models"]["default"][
+            "ckpt_dir"] == str(ck_v2)
+        # the whole fleet converges on v2 (promote reloaded the rest)
+        rng = np.random.default_rng(7)
+        ids, vals, row_ptr = _req(rng, rows=2)
+        ref = float(vals[row_ptr[0]:row_ptr[1]].sum())
+
+        def fleet_scale():
+            return sorted(round(float(
+                srv.engine.predict(ids, vals, row_ptr)[0] / ref))
+                for srv, _ in pairs)
+
+        assert _wait_for(lambda: fleet_scale() == [5, 5], timeout=20.0), \
+            fleet_scale()
+        # -- zero failed serving requests through the whole drill -------
+        t.join(timeout=180.0)
+        assert not t.is_alive(), "load generator wedged"
+        assert report["rejected"] == 0, report
+        assert report["overload"] == 0, report
+        assert report["ok"] == 500, report
+    finally:
+        if router is not None:
+            router.stop()
+        for srv, ag in pairs:
+            ag.stop()
+            srv.stop()
+        reg_proc.kill()
+        reg_proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# drill target 2: tracker SIGKILLed mid-epoch
+# ---------------------------------------------------------------------------
+
+def _tracker_cmd(addr, msg, timeout=30.0):
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.settimeout(timeout)
+        send_json(s, msg)
+        return recv_json(s.makefile("r"))
+
+
+def test_tracker_sigkilled_mid_epoch_readmits_cohort(tmp_path):
+    """An assigned cohort's tracker dies mid-epoch; restarted on the
+    same port + journal it re-admits both workers at their old ranks and
+    generation 0 (no spurious link reset), while a worker that actually
+    MOVED still bumps the generation."""
+    journal = str(tmp_path / "trk" / "tracker")
+    proc, addr = _spawn_singleton("dmlc_core_tpu.parallel.tracker",
+                                  port=0, workers=2, journal=journal)
+    port = addr[1]
+    # real listening sockets as the workers' peer ports, so the moved-
+    # worker reset notify connects instead of retrying against a corpse
+    peers = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.listen(4)
+        peers.append(s)
+    p1, p2, p3 = (s.getsockname()[1] for s in peers)
+    try:
+        replies = {}
+
+        def register(jobid, wport, cmd):
+            replies[jobid, cmd] = _tracker_cmd(addr, {
+                "cmd": cmd, "jobid": jobid,
+                "host": "127.0.0.1", "port": wport})
+
+        # "start" blocks until the full cohort is present → two threads
+        ts = [threading.Thread(target=register, args=(j, p, "start"))
+              for j, p in (("w1", p1), ("w2", p2))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30.0)
+            assert not t.is_alive(), "rendezvous wedged"
+        ranks = {j: replies[j, "start"]["rank"] for j in ("w1", "w2")}
+        assert sorted(ranks.values()) == [0, 1]
+        assert all(replies[j, "start"]["generation"] == 0
+                   for j in ("w1", "w2"))
+        # -- the kill: cohort assigned, epoch notionally in flight ------
+        _sigkill(proc)
+        proc, addr2 = _spawn_singleton("dmlc_core_tpu.parallel.tracker",
+                                       port=port, workers=2,
+                                       journal=journal)
+        assert addr2 == addr
+        # recover from an UNCHANGED address: same rank, generation 0 —
+        # the workers never died, no reset storm
+        for jobid, wport in (("w1", p1), ("w2", p2)):
+            r = _tracker_cmd(addr, {"cmd": "recover", "jobid": jobid,
+                                    "host": "127.0.0.1", "port": wport})
+            assert r["rank"] == ranks[jobid], (jobid, r)
+            assert r["generation"] == 0, (jobid, r)
+        # a worker that MOVED (new port) is a real mid-job restart:
+        # same rank, generation bumps, survivors get the reset
+        r = _tracker_cmd(addr, {"cmd": "recover", "jobid": "w2",
+                                "host": "127.0.0.1", "port": p3})
+        assert r["rank"] == ranks["w2"] and r["generation"] == 1
+    finally:
+        for s in peers:
+            s.close()
+        proc.kill()
+        proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# drill target 3: dispatcher SIGKILLed mid-epoch (multi-endpoint loader)
+# ---------------------------------------------------------------------------
+
+def _libsvm(tmp_path, rows=240):
+    rng = np.random.default_rng(13)
+    path = tmp_path / "ha.libsvm"
+    with open(path, "w") as f:
+        for i in range(rows):
+            idx = np.sort(rng.choice(np.arange(1, 300), size=6,
+                                     replace=False))
+            f.write(f"{i + 1} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    return str(path)
+
+
+def _spec(uri, num_parts):
+    return {"uri": uri, "fmt": "libsvm", "num_parts": num_parts,
+            "batch_rows": BATCH_ROWS, "nnz_cap": NNZ_CAP}
+
+
+def _frame_digest(buf, meta):
+    words = _fused_words_meta(BATCH_ROWS, int(meta))
+    return hashlib.sha1(np.asarray(buf)[:words].tobytes()).hexdigest()
+
+
+def _single_host_baseline(uri, num_parts):
+    digests = Counter()
+    for part in range(num_parts):
+        loader = DeviceLoader(
+            create_parser(uri, part, num_parts, "libsvm", nthreads=1,
+                          threaded=False),
+            batch_rows=BATCH_ROWS, nnz_cap=NNZ_CAP, emit="host")
+        try:
+            for kind, buf, meta, _rows in loader:
+                digests[_frame_digest(buf, meta)] += 1
+        finally:
+            loader.close()
+    return digests
+
+
+def test_dispatcher_sigkilled_mid_epoch_no_replayed_frames(
+        tmp_path, monkeypatch):
+    """The shared-harness dispatcher target: the consumer rides a
+    two-endpoint list whose FIRST endpoint is dead (EndpointSet walks to
+    the live one), the dispatcher is SIGKILLed after frames are in
+    flight and restarted on the same port + journal, and the epoch
+    completes with frame-sha1 parity — no replayed ingest frames."""
+    uri = _libsvm(tmp_path)
+    base_digests = _single_host_baseline(uri, 4)
+    monkeypatch.setenv("DMLC_DATA_CLIENT_RETRIES", "40")
+    monkeypatch.setenv("DMLC_DATA_CLIENT_BREAKER_THRESHOLD", "1000")
+    monkeypatch.setenv("DMLC_DS_CTRL_RETRIES", "40")
+    journal = str(tmp_path / "disp" / "dispatch")
+    proc, addr = _spawn_singleton(
+        "dmlc_core_tpu.pipeline.data_service.dispatcher",
+        port=0, journal=journal)
+    port = addr[1]
+    dead = _free_port()
+    workers = [DataServiceWorker(addr, heartbeat_interval_s=0.2).start()
+               for _ in range(2)]
+    frames_seen = threading.Event()
+    result = {}
+
+    def consume():
+        # dead endpoint first: every control RPC walks the list
+        ldr = DataServiceLoader(f"127.0.0.1:{dead},127.0.0.1:{port}",
+                                _spec(uri, 4))
+        assert ldr.dispatcher == ("127.0.0.1", dead)   # compat alias
+        digests = Counter()
+        try:
+            for kind, buf, meta, _rows in ldr:
+                digests[_frame_digest(buf, meta)] += 1
+                ldr.recycle(buf)
+                frames_seen.set()
+                time.sleep(0.05)
+        finally:
+            ldr.close()
+        result["digests"] = digests
+
+    t = threading.Thread(target=consume, daemon=True)
+    try:
+        t.start()
+        assert frames_seen.wait(timeout=60.0), "no frames before the kill"
+        _sigkill(proc)                        # mid-epoch, leases granted
+        proc, addr2 = _spawn_singleton(
+            "dmlc_core_tpu.pipeline.data_service.dispatcher",
+            port=port, journal=journal)
+        assert addr2 == addr
+        t.join(timeout=180.0)
+        assert not t.is_alive(), "consumer stuck after failover"
+    finally:
+        for w in workers:
+            w.kill()
+        proc.kill()
+        proc.wait()
+    assert result["digests"] == base_digests   # every frame exactly once
+    assert max(result["digests"].values()) == 1
+    assert metrics.counter("transport.endpoints.failovers").value >= 1

@@ -298,6 +298,30 @@ def test_lock_clean_and_locked_convention(tmp_path):
     assert findings == []
 
 
+# -- durable-state ----------------------------------------------------------
+
+def test_durable_delete_flagged_and_journaled(tmp_path):
+    # the r17 extension: `del` on a durable table is a mutation too — a
+    # replay that misses the removal resurrects the entry
+    findings, _, _ = _lint_snippet(tmp_path, """\
+        class Reg:
+            _DURABLE_STATE = ("_active",)
+
+            def forget(self, k):
+                del self._active[k]
+
+            def finish(self, k):
+                self._jlog("gone", k=k)
+                del self._active[k]
+
+            def _restore_state(self, st):
+                del self._active["replayed"]    # replay applies, exempt
+    """, rules=["durable-state"])
+    assert len(findings) == 1
+    assert findings[0].line == 5
+    assert "without journaling" in findings[0].message
+
+
 # -- atomic-write -----------------------------------------------------------
 
 def test_atomic_write_flagged_and_fixed(tmp_path):
