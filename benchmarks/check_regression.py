@@ -76,6 +76,14 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 #  The ha family (ISSUE 17, BENCH_ha_r*.json): registry_failover_s /
 #  tracker_failover_s — SIGKILL→journal-replayed singleton serving its
 #  control RPCs again — both gate lower-better via "failover".
+#  The trace family (ISSUE 18, BENCH_trace_r*.json): three layered
+#  trace_*_qps_overhead_pct keys gate lower-better via "overhead"
+#  (all = span instrumentation vs untraced; sampler = buffer/decide
+#  machinery at floor 1.0 vs no sampler; tail = dropping at floor 0.01
+#  vs keeping everything), and trace_budget_ok (1 while the tail layer
+#  stays < 1% — dropping must never cost more than keeping) gates
+#  higher-better via "ok" — a budget miss reads as a 100% drop, which
+#  fails the gate.
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
                  "errors", "misses", "padding_ratio", "truncated",

@@ -1,0 +1,130 @@
+"""wide-event-vocabulary: wide-event fields match the docs; one writer.
+
+Motivating bug class (r18): the wide event is the canonical log line —
+post-incident analytics group by its field names, so a field that
+drifts from the ``docs/observability.md`` table (or a site that invents
+an undocumented dimension) silently breaks every query written against
+the vocabulary.  ``telemetry.wide_events.FIELDS`` is the closed set;
+this rule keeps three parties agreeing:
+
+* every **keyword** passed at a ``wide_event(...)`` call site must be a
+  documented field (the table whose header column is ``Field``);
+* the documented field set must mirror ``FIELDS`` exactly — a stale doc
+  row and an undocumented code field both fail;
+* span/event records reach the ring through ``trace.py`` /
+  ``sampling.py`` only: a raw ``recorder.record(...)`` append anywhere
+  else bypasses the tail sampler and un-counts drops, so it is flagged.
+
+``wide_event`` is the single sanctioned emission spelling precisely so
+this rule can find every call site; ``**kwargs`` spreads are skipped
+per-site, same as dynamic metric names.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, dotted,
+                   lint_rule)
+
+#: modules allowed to append to the span ring directly
+_RECORD_OK = ("telemetry/trace.py", "telemetry/sampling.py")
+
+_DOC_TOKEN = re.compile(r"`([a-z][a-z0-9_]*)`")
+
+
+@lint_rule("wide-event-vocabulary",
+           description="wide_event() keyword fields are documented in the "
+                       "docs/observability.md field table (which mirrors "
+                       "wide_events.FIELDS), and nothing outside trace.py/"
+                       "sampling.py appends to the span recorder directly")
+class WideEventVocabularyRule(LintRule):
+
+    def __init__(self) -> None:
+        #: field name → repo-relative files using it at a wide_event site
+        self._field_sites: Dict[str, Set[str]] = {}
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            callee = name.rsplit(".", 1)[-1]
+            if callee == "wide_event" or name.endswith("wide_log.emit"):
+                for kw in node.keywords:
+                    if kw.arg is None:       # **spread — dynamic, skip
+                        continue
+                    self._field_sites.setdefault(kw.arg, set()).add(mod.rel)
+            elif callee == "record" and name.endswith("recorder.record") \
+                    and not mod.rel.replace(os.sep, "/").endswith(_RECORD_OK):
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno, node.col_offset,
+                    "raw recorder.record() append bypasses the tail "
+                    "sampler — emit through span()/add_event() (or do it "
+                    "in telemetry/trace.py / telemetry/sampling.py)"))
+        return out
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not getattr(ctx, "full_run", False):
+            return []
+        doc_path = os.path.join(ctx.docs_dir, "observability.md")
+        rel = os.path.relpath(doc_path, ctx.repo_root)
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc = f.read()
+        except OSError:
+            return [Finding(self.name, rel, 0, 0,
+                            "docs/observability.md unreadable — the "
+                            "wide-event vocabulary has no contract to "
+                            "check against")]
+        documented = _doc_field_vocabulary(doc)
+        from ..telemetry.wide_events import FIELDS
+        out: List[Finding] = []
+        for name in sorted(set(FIELDS) - documented):
+            out.append(Finding(
+                self.name, rel, 0, 0,
+                f"wide-event field {name!r} (wide_events.FIELDS) has no "
+                f"row in the docs/observability.md field table — "
+                f"document it"))
+        for name in sorted(documented - set(FIELDS)):
+            out.append(Finding(
+                self.name, rel, 0, 0,
+                f"documented wide-event field {name!r} is not in "
+                f"wide_events.FIELDS — delete the stale doc row (or add "
+                f"the field)"))
+        for name in sorted(self._field_sites):
+            if name in FIELDS:
+                continue
+            sites = ", ".join(sorted(self._field_sites[name])[:3])
+            out.append(Finding(
+                self.name, rel, 0, 0,
+                f"wide_event() field {name!r} ({sites}) is outside the "
+                f"closed vocabulary — it would be dropped at emit time; "
+                f"add it to FIELDS + the docs table or rename it"))
+        return out
+
+
+def _doc_field_vocabulary(doc: str) -> Set[str]:
+    """Backticked tokens in the first column of tables whose header has
+    a ``Field`` column (the wide-event table's signature — metric/span/
+    knob tables key on other headers, so vocabularies stay disjoint)."""
+    fields: Set[str] = set()
+    in_table = False
+    for line in doc.splitlines():
+        if not line.lstrip().startswith("|"):
+            in_table = False
+            continue
+        cells = line.split("|")
+        if any(c.strip() == "Field" for c in cells):
+            in_table = True
+            continue
+        if not in_table or len(cells) < 3:
+            continue
+        for m in _DOC_TOKEN.finditer(cells[1]):
+            fields.add(m.group(1))
+    return fields

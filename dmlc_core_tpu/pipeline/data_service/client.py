@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ...telemetry import sampling as telsampling
 from ...telemetry import trace as teltrace
 from ...transport import frames as _wire
 from ...transport import lane as _lane
@@ -107,6 +108,9 @@ class DataServiceLoader:
         # request (lease partitioning) and consumer_stats (liveness) —
         # the dispatcher's affinity machinery keys on it
         self.consumer = _default_consumer_id()
+        # consumer tier of the fleet-wide tail-sampling config (exact
+        # no-op unless DMLC_TRACE_SAMPLE is set)
+        telsampling.maybe_install_from_env()
         self._depth = max(2, int(prefetch))
         self._pool = _BufPool(cap=2 * self._depth + 2)
         self._closed = False
@@ -205,8 +209,22 @@ class DataServiceLoader:
             try:
                 with teltrace.activate(state.get("trace")), \
                         teltrace.span("data_service.client.stream",
-                                      worker=jobid, epoch=state["epoch"]):
-                    breaker.call(self._stream_once, state, jobid, addr, cap)
+                                      worker=jobid, epoch=state["epoch"]) \
+                        as sp:
+                    try:
+                        breaker.call(self._stream_once, state, jobid,
+                                     addr, cap)
+                    except (OSError, DMLCError):
+                        # a transport break AFTER close() is the loader
+                        # tearing its own socket down, not a worker
+                        # fault — end the span clean so routine
+                        # shutdown never taints the trace as an error
+                        # (the tail sampler would keep every epoch)
+                        with cv:
+                            stopped = state["stop"]
+                        if not stopped:
+                            raise
+                        sp.attrs["teardown"] = True
             finally:
                 self._publish_breaker_gauges()
 

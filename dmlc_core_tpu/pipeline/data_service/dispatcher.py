@@ -66,6 +66,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ...parallel.tracker import LivenessBoard, recv_json, send_json
 from ...telemetry import flight as flight_mod
+from ...telemetry import sampling as sampling_mod
 from ...telemetry import trace as teltrace
 from ...telemetry.aggregate import ResetGuard, merge_states, state_to_snapshot
 from ...telemetry.anomaly import StragglerBoard
@@ -258,6 +259,9 @@ class Dispatcher:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "Dispatcher":
+        # same DMLC_TRACE_SAMPLE config as workers and consumers — the
+        # consistent hash floor needs no coordination beyond the env
+        sampling_mod.maybe_install_from_env()
         for target, name in ((self._accept_loop, "dispatcher-accept"),
                              (self._sweep_loop, "dispatcher-sweep")):
             t = threading.Thread(target=target, name=name, daemon=True)

@@ -34,7 +34,9 @@ import time
 from typing import Optional, Tuple
 
 from ...parallel.tracker import jittered, recv_json, send_json
+from ...telemetry import sampling as telsampling
 from ...telemetry import trace as teltrace
+from ...telemetry.wide_events import wide_event
 from ...transport import frames as _wire
 from ...transport import lane as _lane
 from ...utils.faults import FaultInjected, fault_point
@@ -120,6 +122,10 @@ class DataServiceWorker:
         self._uds_srv = _lane.bind_lane(self.jobid)
         self.uds_path = (_lane.lane_path(self.jobid)
                          if self._uds_srv is not None else None)
+        # worker tier joins the fleet-wide tail-sampling config: the
+        # consistent hash floor makes its verdicts agree with the
+        # dispatcher's and the consumer's without coordination
+        telsampling.maybe_install_from_env()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -442,10 +448,14 @@ class DataServiceWorker:
         # to kill() in the connection handler
         fault_point("data_service.lease")
         loader = None
+        t0 = time.monotonic()
+        sp_ref: Optional[teltrace.Span] = None
+        outcome = "OK"
         try:
             with teltrace.span("data_service.serve_shard", part=part,
                                lease_epoch=lease_epoch,
                                worker=self.jobid) as sp:
+                sp_ref = sp
                 if not spec.get("snapshot"):
                     # build-once/serve-many: a shard a fleet peer on this
                     # host already packed serves from its page file — the
@@ -528,6 +538,7 @@ class DataServiceWorker:
             # send failure is a lease failure, not a process death (only
             # the data_service.lease probe above models a crash), so the
             # re-raise is converted off the FaultInjected type
+            outcome = "FAILED"
             logger.warning("worker %s: shard %d send failed (%r) — "
                            "failing lease", self.jobid, part, e)
             try:
@@ -542,6 +553,17 @@ class DataServiceWorker:
         finally:
             if loader is not None:
                 loader.close()
+            # the canonical log line for this lease — emitted after the
+            # span ended, so a worker-rooted trace already carries its
+            # tail-sampling verdict; frame/byte facts come off the span
+            wide_event(
+                "data_service.lease", worker=self.jobid, key=key,
+                part=part, lease_epoch=lease_epoch, outcome=outcome,
+                frames=(sp_ref.attrs.get("frames") if sp_ref else None),
+                bytes=(sp_ref.attrs.get("bytes") if sp_ref else None),
+                dur_ms=round((time.monotonic() - t0) * 1e3, 3),
+                trace_id=(teltrace.format_id(sp_ref.trace_id)
+                          if sp_ref is not None else None))
         self._ctrl_retry.call(
             dispatcher_rpc, self.dispatcher,
             {"cmd": "complete_lease", "key": key, "part": part,

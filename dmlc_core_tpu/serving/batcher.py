@@ -231,6 +231,8 @@ class MicroBatcher:
             for p in batch:
                 if p.deadline < now:
                     self._m_expired.add(1)
+                    p.future.wide = {
+                        "queue_ms": round((now - p.t_enq) * 1e3, 3)}
                     p.future.set_exception(DeadlineExceeded(
                         f"request expired after "
                         f"{now - p.t_enq:.3f}s in queue"))
@@ -269,8 +271,11 @@ class MicroBatcher:
                                                          for p in live)))
                     fl.dump_incident("engine_failure",
                                      error=f"{type(e).__name__}: {e}")
+                fail_t = time.monotonic()
                 for p in live:
                     if not p.future.done():
+                        p.future.wide = {
+                            "queue_ms": round((fail_t - p.t_enq) * 1e3, 3)}
                         p.future.set_exception(e)
                 continue
             self._m_batches.add(1)
@@ -279,8 +284,18 @@ class MicroBatcher:
             self._m_nnz.observe(len(ids))
             self._m_fill.set(len(ids) / max(1, self.max_batch_nnz))
             done_t = time.monotonic()
+            batch_rows = sum(p.rows for p in live)
             r0 = 0
             for p in live:
+                # canonical-log-line facts only the batcher knows (queue
+                # residency, the shared batch's size) ride the Future to
+                # the server's completion callback, which folds them into
+                # the request's wide event
+                p.future.wide = {
+                    "queue_ms": round((done_t - p.t_enq) * 1e3, 3),
+                    "batch_rows": batch_rows,
+                    "batch_nnz": len(ids),
+                }
                 p.future.set_result(scores[r0:r0 + p.rows])
                 r0 += p.rows
                 self._m_latency.observe(done_t - p.t_enq)

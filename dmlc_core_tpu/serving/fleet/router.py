@@ -67,7 +67,9 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ...parallel.tracker import jittered
+from ...telemetry import sampling as telsampling
 from ...telemetry import trace as teltrace
+from ...telemetry.wide_events import wide_event
 from ...transport.endpoints import EndpointSet, EndpointsLike
 from ...transport.frames import send_all
 from ...telemetry.exposition import TelemetryServer
@@ -121,7 +123,8 @@ class _Pending:
 
     __slots__ = ("bid", "client", "client_req_id", "trace_id",
                  "parent_span", "rows", "nnz", "tail", "attempts",
-                 "tried", "replica_key", "span")
+                 "tried", "replica_key", "span", "hedges", "failovers",
+                 "t0")
 
     def __init__(self, bid: int, client: _ClientConn, client_req_id: int,
                  trace_id: int, parent_span: int, rows: int, nnz: int,
@@ -138,6 +141,9 @@ class _Pending:
         self.tried: set = set()
         self.replica_key: Optional[str] = None
         self.span = span
+        self.hedges = 0          # status-triggered resubmits (shed/shutdown)
+        self.failovers = 0       # conn-lost / transport-walk replacements
+        self.t0 = time.monotonic()
 
 
 class _Replica:
@@ -238,6 +244,9 @@ class ServingRouter:
         self._m_retries = metrics.counter("serving.router.retries")
         self._m_sheds = metrics.counter("serving.router.sheds")
         self._m_inflight = metrics.gauge("serving.router.inflight")
+        # same tail-sampling config as the replicas behind us: the hash
+        # floor is consistent on trace_id, so verdicts agree tier-to-tier
+        telsampling.maybe_install_from_env()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -478,10 +487,19 @@ class ServingRouter:
             rep.breaker.record_success()
         elif status == STATUS_OVERLOADED:
             self._m_sheds.add(1)
+        outcome = STATUS_NAMES.get(status, str(status))
         if pend.span is not None:
-            pend.span.end(status=STATUS_NAMES.get(status, str(status)),
-                          attempts=pend.attempts, replica=rep.key)
+            pend.span.end(status=outcome, attempts=pend.attempts,
+                          replica=rep.key)
         pend.client.respond(pend.client_req_id, status, payload)
+        wide_event("serving.route", model=pend.client.model_id,
+                   replica=rep.key, req_id=pend.client_req_id,
+                   rows=pend.rows, nnz=pend.nnz, outcome=outcome,
+                   attempts=pend.attempts, hedges=pend.hedges,
+                   failovers=pend.failovers,
+                   dur_ms=round((time.monotonic() - pend.t0) * 1e3, 3),
+                   trace_id=(teltrace.format_id(pend.trace_id)
+                             if pend.trace_id else None))
 
     def _on_backend_lost(self, rep: _Replica, sock: socket.socket,
                          exc: BaseException) -> None:
@@ -529,6 +547,14 @@ class ServingRouter:
             pend.span.end(status="OVERLOADED", attempts=pend.attempts)
         pend.client.respond(pend.client_req_id, STATUS_OVERLOADED,
                             msg.encode("utf-8", "replace"))
+        wide_event("serving.route", model=pend.client.model_id,
+                   req_id=pend.client_req_id, rows=pend.rows,
+                   nnz=pend.nnz, outcome="OVERLOADED",
+                   attempts=pend.attempts, hedges=pend.hedges,
+                   failovers=pend.failovers,
+                   dur_ms=round((time.monotonic() - pend.t0) * 1e3, 3),
+                   trace_id=(teltrace.format_id(pend.trace_id)
+                             if pend.trace_id else None))
 
     def _try_failover(self, pend: _Pending, failed: _Replica, *,
                       reason: Optional[str],
@@ -544,8 +570,19 @@ class ServingRouter:
         if target is None:
             return False
         self._m_retries.add(1)
+        # name the two resubmit flavours apart: a status-triggered
+        # resubmit (OVERLOADED/SHUTDOWN — the replica did no work) is a
+        # *hedge*; a lost connection is a *failover* proper.  Both carry
+        # endpoint labels, and the replacement attempt reuses
+        # pend.parent_span, so every attempt re-parents under the one
+        # original serving.router.request span.
+        kind = "failover" if reason == "conn_lost" else "hedge"
+        if kind == "hedge":
+            pend.hedges += 1
+        else:
+            pend.failovers += 1
         if pend.span is not None:
-            pend.span.event("failover", frm=failed.key, to=target.key,
+            pend.span.event(kind, frm=failed.key, to=target.key,
                             reason=reason)
         return self._dispatch(pend, target)
 
@@ -580,6 +617,10 @@ class ServingRouter:
                 if nxt is None:
                     return False
                 self._m_retries.add(1)
+                pend.failovers += 1
+                if pend.span is not None:
+                    pend.span.event("failover", frm=rep.key, to=nxt.key,
+                                    reason=type(e).__name__)
                 rep = nxt
 
     # -- frontend --------------------------------------------------------

@@ -50,8 +50,10 @@ import numpy as np
 from ..telemetry import anomaly as telanomaly
 from ..transport.frames import send_all
 from ..telemetry import flight as telflight
+from ..telemetry import sampling as telsampling
 from ..telemetry import trace as teltrace
 from ..telemetry.exposition import TelemetryServer
+from ..telemetry.wide_events import wide_event
 from ..utils.faults import FaultInjected, fault_point
 from ..utils.logging import DMLCError, log_info, log_warning
 from ..utils.metrics import metrics
@@ -192,6 +194,7 @@ class PredictionServer:
         # unset): flight recorder arms on DMLC_FLIGHT_DIR; the SLO
         # monitor compiles DMLC_SLO_SPEC and starts on server start
         telflight.maybe_arm_from_env()
+        telsampling.maybe_install_from_env()
         self.slo_monitor: Optional[telanomaly.SloMonitor] = \
             telanomaly.maybe_monitor_from_env(autostart=False)
 
@@ -437,8 +440,8 @@ class PredictionServer:
             except OSError:
                 pass                   # client gone; reader will notice
 
-        def on_done(req_id: int, fut,
-                    span: Optional[teltrace.Span]) -> None:
+        def on_done(req_id: int, fut, span: Optional[teltrace.Span],
+                    rows: int, nnz: int, t0: float) -> None:
             with self._inflight_lock:
                 self._inflight -= 1
                 self._m_inflight.set(self._inflight)
@@ -446,6 +449,7 @@ class PredictionServer:
             if exc is None:
                 scores = np.ascontiguousarray(fut.result(),
                                               dtype=np.float32)
+                outcome = "OK"
                 if span is not None:
                     span.end(status="OK")
                 respond(req_id, STATUS_OK, scores.tobytes())
@@ -453,10 +457,24 @@ class PredictionServer:
                 status = _status_of(exc)
                 if status == STATUS_OVERLOADED:
                     metrics.counter("serving.server.shed").add(1)
+                outcome = STATUS_NAMES.get(status, str(status))
                 if span is not None:
-                    span.end(status=STATUS_NAMES.get(status, str(status)))
+                    span.end(status=outcome)
                 respond(req_id, status,
                         str(exc).encode("utf-8", "replace"))
+            # the canonical log line: one wide event per served request,
+            # emitted AFTER span.end so a server-rooted trace already has
+            # its tail-sampling verdict.  Batch/queue facts ride in on
+            # the future (see MicroBatcher._run).
+            wide_event("serving.request", model=self.model_id, conn=cid,
+                       req_id=req_id, rows=rows, nnz=nnz,
+                       dur_ms=round((time.monotonic() - t0) * 1e3, 3),
+                       outcome=outcome,
+                       trace_id=(teltrace.format_id(span.trace_id)
+                                 if span is not None else None),
+                       debug=(bool(span.trace_id & telsampling.DEBUG_BIT)
+                              if span is not None else None),
+                       **getattr(fut, "wide", {}))
 
         try:
             while True:
@@ -518,11 +536,17 @@ class PredictionServer:
                     metrics.counter("serving.server.shed").add(1)
                     if span is not None:
                         span.end(status="OVERLOADED", injected=True)
+                    wide_event("serving.request", model=self.model_id,
+                               conn=cid, req_id=req_id, rows=rows,
+                               nnz=nnz, outcome="OVERLOADED",
+                               trace_id=(teltrace.format_id(span.trace_id)
+                                         if span is not None else None))
                     respond(req_id, STATUS_OVERLOADED, str(e).encode())
                     continue
                 with self._inflight_lock:
                     self._inflight += 1
                     self._m_inflight.set(self._inflight)
+                t_req = time.monotonic()
                 try:
                     fut = self.batcher.submit(ids, vals,
                                               row_ptr.astype(np.int64),
@@ -534,7 +558,8 @@ class PredictionServer:
                         self._m_inflight.set(self._inflight)
                     raise
                 fut.add_done_callback(
-                    lambda f, rid=req_id, sp=span: on_done(rid, f, sp))
+                    lambda f, rid=req_id, sp=span, r=rows, z=nnz,
+                    t0=t_req: on_done(rid, f, sp, r, z, t0))
         except OSError as e:
             log_info("serving: connection %d ended: %r", cid, e)
         finally:

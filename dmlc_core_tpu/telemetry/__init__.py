@@ -5,6 +5,11 @@ Layers (see ``docs/observability.md``):
 
 * :mod:`telemetry.trace` — ``TraceContext`` / ``span()`` propagation and
   the process-global span ring buffer.
+* :mod:`telemetry.sampling` — tail-based trace sampling: buffer whole
+  traces, keep errors/slow/SLO-breach/debug plus a consistent-hash
+  floor, coordination-free across tiers.
+* :mod:`telemetry.wide_events` — one canonical wide event per serving
+  request / data-service lease, served at ``/events``.
 * :mod:`telemetry.chrome_trace` — export recorded spans as Chrome
   trace-event JSON (open in Perfetto).
 * :mod:`telemetry.exposition` — Prometheus text rendering and the
@@ -37,14 +42,22 @@ from .anomaly import (SloMonitor, SloRule, SloSpecError, StallDetector,
                       parse_slo_spec)
 from .chrome_trace import to_chrome_trace, write_chrome_trace
 from .exposition import (TelemetryServer, maybe_start_from_env,
-                         render_prometheus, render_series)
+                         render_openmetrics, render_prometheus,
+                         render_series)
 from .flight import (FlightRecorder, dump_incident, flight_recorder,
                      maybe_arm_from_env, register_contributor,
                      unregister_contributor)
 from .profiling import SamplingProfiler, incident_profile, profile_for
+from .sampling import (TailSampler, TraceBuffer, debug_trace_id, hash_keep,
+                       is_debug, mark_debug, maybe_install_from_env,
+                       was_kept)
+from .sampling import install as install_sampler
+from .sampling import uninstall as uninstall_sampler
 from .trace import (Span, SpanRecorder, TraceContext, activate, add_event,
                     current, current_trace_id, format_id, new_trace_id,
                     recorder, span, start_span)
+from .wide_events import FIELDS as WIDE_EVENT_FIELDS
+from .wide_events import WideEventLog, events_doc, wide_event, wide_log
 from .xla_introspect import RetraceWatchdog, sample_memory, watchdog
 
 __all__ = [
@@ -52,8 +65,13 @@ __all__ = [
     "start_span", "activate", "add_event", "current", "current_trace_id",
     "new_trace_id", "format_id",
     "to_chrome_trace", "write_chrome_trace",
-    "render_prometheus", "render_series", "TelemetryServer",
-    "maybe_start_from_env",
+    "render_prometheus", "render_series", "render_openmetrics",
+    "TelemetryServer", "maybe_start_from_env",
+    "TailSampler", "TraceBuffer", "hash_keep", "is_debug", "mark_debug",
+    "debug_trace_id", "was_kept", "maybe_install_from_env",
+    "install_sampler", "uninstall_sampler",
+    "WideEventLog", "wide_log", "wide_event", "events_doc",
+    "WIDE_EVENT_FIELDS",
     "merge_states", "state_to_snapshot", "render_fleet",
     "dump_artifacts",
     "FlightRecorder", "flight_recorder", "dump_incident",

@@ -15,6 +15,15 @@ Prometheus text format 0.0.4 — no client library, just the format:
 rank plus a merged fleet view) into one page with each ``# TYPE`` header
 emitted once per family, which is what the tracker's ``/metrics`` serves.
 
+:func:`render_openmetrics` is the OpenMetrics 1.0 sibling
+(``/metrics?format=openmetrics``): histograms become native cumulative
+buckets (synthesised at the reservoir's p50/p95/p99 edges) so retained
+exemplars — ``(value, trace_id, ts)`` triples captured by
+``Histogram.observe`` — can ride the ``_bucket`` lines in standard
+``# {trace_id="..."}`` syntax.  When a tail sampler is installed only
+exemplars whose traces were *kept* are rendered, so every exemplar on
+the page is followable into ``/spans``.
+
 :class:`TelemetryServer` is a daemon-thread ``ThreadingHTTPServer``
 mounting ``/metrics``, ``/healthz``, and ``/spans``.  The serving server
 mounts one when ``metrics_port`` / ``DMLC_METRICS_PORT`` is set, the
@@ -36,8 +45,8 @@ from ..utils.logging import log_info, log_warning
 from ..utils.parameter import get_env
 from . import trace as _trace
 
-__all__ = ["render_prometheus", "render_series", "render_fleet_board",
-           "TelemetryServer", "maybe_start_from_env"]
+__all__ = ["render_prometheus", "render_series", "render_openmetrics",
+           "render_fleet_board", "TelemetryServer", "maybe_start_from_env"]
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -219,6 +228,108 @@ def render_prometheus(snapshot: Dict[str, Dict[str, Any]],
                          help_map=help_map)
 
 
+def _exemplar_kept(trace_hex: Optional[str]) -> bool:
+    """Should this exemplar's trace be shown?  With no tail sampler
+    installed everything is recorded, so every trace is followable;
+    with one, only a kept verdict (not drop, not unknown) qualifies."""
+    if not trace_hex:
+        return False
+    from . import sampling as _sampling
+    s = _sampling.get_sampler()
+    if s is None:
+        return True
+    was = getattr(s, "was_kept", None)
+    if was is None:
+        return True
+    return was(trace_hex) is True
+
+
+def _registry_exemplars(metric: Optional[str] = None
+                        ) -> Dict[str, List[Dict[str, Any]]]:
+    """Kept-trace exemplars held by live registry histograms, keyed by
+    metric name (optionally restricted to one metric)."""
+    from ..utils.metrics import metrics as _registry
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for name, snap in _registry.snapshot().items():
+        if metric is not None and name != metric:
+            continue
+        exs = [e for e in (snap.get("exemplars") or [])
+               if _exemplar_kept(e.get("trace_id"))]
+        if exs:
+            out[name] = exs
+    return out
+
+
+def _openmetrics_histogram(base: str, snap: Dict[str, Any],
+                           lab: Callable[..., str]) -> List[str]:
+    """Native-histogram lines with exemplars.  The reservoir stores
+    quantiles, not buckets, so cumulative buckets are synthesised at the
+    p50/p95/p99 edges — coarse, but enough structure for exemplars to
+    attach where the spec allows them (``_bucket`` samples only)."""
+    count = int(snap.get("count", 0))
+    mean = float(snap.get("mean", 0.0))
+    exs = [e for e in (snap.get("exemplars") or [])
+           if _exemplar_kept(e.get("trace_id"))]
+    exs.sort(key=lambda e: float(e.get("value", 0.0)))
+    lines: List[str] = []
+    idx = 0
+    for hi, frac in ((float(snap.get("p50", 0.0)), 0.50),
+                     (float(snap.get("p95", 0.0)), 0.95),
+                     (float(snap.get("p99", 0.0)), 0.99),
+                     (None, 1.0)):
+        c = count if hi is None else int(round(count * frac))
+        le = "+Inf" if hi is None else _fmt_val(hi)
+        line = f"{base}_bucket{lab({'le': le})} {c}"
+        if idx < len(exs) and (hi is None or
+                               float(exs[idx].get("value", 0.0)) <= hi):
+            e = exs[idx]
+            idx += 1
+            tid = _escape_label_value(e.get("trace_id", ""))
+            line += (f' # {{trace_id="{tid}"}}'
+                     f' {_fmt_val(e.get("value", 0.0))}'
+                     f' {_fmt_val(e.get("ts", 0.0))}')
+        lines.append(line)
+    lines.append(f"{base}_sum{lab()} {_fmt_val(mean * count)}")
+    lines.append(f"{base}_count{lab()} {count}")
+    return lines
+
+
+def render_openmetrics(snapshot: Dict[str, Dict[str, Any]],
+                       labels: Optional[Dict[str, str]] = None,
+                       prefix: str = "dmlc",
+                       help_map: Optional[Dict[str, str]] = None) -> str:
+    """OpenMetrics 1.0 text for one registry snapshot, ``# EOF``
+    terminated.  Counters drop the ``_total`` suffix from the *family*
+    name (the sample keeps it, per spec); histograms render as native
+    cumulative buckets carrying kept-trace exemplars."""
+    if help_map is None:
+        help_map = _help_catalog()
+    lab = lambda extra=None: _fmt_labels(labels, extra)  # noqa: E731
+    out: List[str] = []
+    for name in sorted(snapshot):
+        snap = snapshot[name]
+        help_text = help_map.get(name)
+        if snap.get("type") == "histogram":
+            base = (f"{prefix}_{_sanitize(name)}" if prefix
+                    else _sanitize(name))
+            if help_text:
+                out.append(f"# HELP {base} {_escape_help(help_text)}")
+            out.append(f"# TYPE {base} histogram")
+            out.extend(_openmetrics_histogram(base, snap, lab))
+            continue
+        for fam, ptype, lines in _family_samples(name, snap, labels,
+                                                 prefix):
+            om_fam = (fam[:-len("_total")]
+                      if ptype == "counter" and fam.endswith("_total")
+                      else fam)
+            if help_text:
+                out.append(f"# HELP {om_fam} {_escape_help(help_text)}")
+            out.append(f"# TYPE {om_fam} {ptype}")
+            out.extend(lines)
+    out.append("# EOF")
+    return "\n".join(out) + "\n"
+
+
 def _text_table(headers: List[str], rows: List[List[str]]) -> List[str]:
     widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
               for i, h in enumerate(headers)]
@@ -314,9 +425,12 @@ def render_fleet_board(doc: Dict[str, Any], html: bool = False) -> str:
 
 
 class TelemetryServer:
-    """Daemon-thread HTTP exporter: ``/metrics`` (Prometheus text),
+    """Daemon-thread HTTP exporter: ``/metrics`` (Prometheus text;
+    ``?format=openmetrics`` adds exemplar-bearing OpenMetrics),
     ``/healthz`` (JSON status, 503 when overloaded), ``/spans`` (recent
-    span records as JSON), ``/flight`` (on-demand incident bundle),
+    span records as JSON, with the ring's eviction count), ``/events``
+    (wide-event audit ring, ``?since=<seq>`` cursor),
+    ``/flight`` (on-demand incident bundle),
     ``/stragglers`` (tracker only — cross-rank straggler board JSON),
     ``/profile?seconds=N`` (collapsed-stack sampling profile of this
     process), ``/timeline?metric=&since=&format=json|text`` (the
@@ -438,6 +552,14 @@ class TelemetryServer:
 
     @_endpoint("/metrics")
     def _ep_metrics(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        if query.get("format") == "openmetrics":
+            # exemplar-bearing rendering needs the raw snapshot, so this
+            # branch serves the process-local registry (a tracker's
+            # injected merged view stays on the default format)
+            from ..utils.metrics import metrics as _registry
+            return (200, "application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8",
+                    render_openmetrics(_registry.snapshot()))
         return (200, "text/plain; version=0.0.4; charset=utf-8",
                 self._metrics_fn())
 
@@ -452,7 +574,19 @@ class TelemetryServer:
 
     @_endpoint("/spans")
     def _ep_spans(self, query: Dict[str, str]) -> Tuple[int, str, str]:
-        return self._json({"spans": self._spans_fn()})
+        # the ring is lossy: stamp how many records it has evicted so a
+        # consumer can tell a quiet process from a saturated window
+        return self._json({"spans": self._spans_fn(),
+                           "dropped": _trace.recorder.dropped})
+
+    @_endpoint("/events")
+    def _ep_events(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        try:
+            since = int(query.get("since", "0") or 0)
+        except ValueError:
+            since = 0
+        from . import wide_events as _wide
+        return self._json(_wide.events_doc(since))
 
     @_endpoint("/flight")
     def _ep_flight(self, query: Dict[str, str]) -> Tuple[int, str, str]:
@@ -520,6 +654,12 @@ class TelemetryServer:
         if query.get("format") == "text":
             return (200, "text/plain; charset=utf-8",
                     _timeseries.render_timeline_text(doc))
+        exs = _registry_exemplars(metric)
+        if exs:
+            # exemplar trace ids bridge the aggregate view to /spans:
+            # "the p99 spiked" → "this trace is the p99"
+            doc = dict(doc)
+            doc["exemplars"] = exs
         return self._json(doc)
 
     @_endpoint("/analyze")
@@ -533,6 +673,10 @@ class TelemetryServer:
             from . import critical_path as _critical_path
             return (200, "text/plain; charset=utf-8",
                     _critical_path.render_text(doc))
+        exs = _registry_exemplars()
+        if exs:
+            doc = dict(doc)
+            doc["exemplars"] = exs
         return self._json(doc)
 
     def start(self) -> "TelemetryServer":
@@ -547,6 +691,11 @@ class TelemetryServer:
             from . import timeseries as _timeseries
             _timeseries.maybe_start_sampler()
             self._timeline_fn = _timeseries.history.timeline
+        # same gesture arms tail sampling (exact no-op unless
+        # DMLC_TRACE_SAMPLE is set) so every tier that mounts an
+        # exporter shares one coordination-free sampling config
+        from . import sampling as _sampling
+        _sampling.maybe_install_from_env()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -613,8 +762,10 @@ def maybe_start_from_env() -> Optional[TelemetryServer]:
     """
     from . import anomaly as _anomaly
     from . import flight as _flight
+    from . import sampling as _sampling
     _flight.maybe_arm_from_env()
     _anomaly.maybe_monitor_from_env()
+    _sampling.maybe_install_from_env()
     port = get_env("DMLC_METRICS_PORT", -1)
     if port < 0:
         return None

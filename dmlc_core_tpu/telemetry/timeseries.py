@@ -18,7 +18,8 @@ are flattened into scalar series per metric type:
   instead of emitting a huge negative spike, counted in
   ``telemetry.counter_resets``)
 * gauge        → ``<name>``
-* histogram    → ``<name>.p50`` / ``<name>.p99`` / ``<name>.rate``
+* histogram    → ``<name>.p50`` / ``<name>.p95`` / ``<name>.p99`` /
+  ``<name>.rate``
 * throughput   → ``<name>.rate`` (the meter's windowed rate)
 * stage        → ``<name>.mean_s`` (incremental: Δtotal/Δcount, so a
   late regression is not diluted by healthy history) + ``<name>.rate``
@@ -192,7 +193,9 @@ class HistoryStore:
                 if isinstance(v, (int, float)):
                     points[name] = float(v)
             elif t == "histogram":
-                for f in ("p50", "p99"):
+                # p95 joined p50/p99 for the tail sampler's adaptive
+                # keep-slow threshold (live p95 of the root span name)
+                for f in ("p50", "p95", "p99"):
                     v = snap.get(f)
                     if isinstance(v, (int, float)):
                         points[f"{name}.{f}"] = float(v)

@@ -40,12 +40,14 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Union
 
+from ..utils.metrics import metrics
 from ..utils.parameter import get_env
 
 __all__ = [
     "TraceContext", "Span", "SpanRecorder", "recorder", "current",
     "current_trace_id", "new_trace_id", "start_span", "span", "activate",
-    "add_event", "format_id", "wire_ids", "from_wire",
+    "add_event", "format_id", "wire_ids", "from_wire", "set_sampler",
+    "get_sampler",
 ]
 
 
@@ -69,9 +71,11 @@ _id_lock = threading.Lock()
 
 
 def new_trace_id() -> int:
-    """Random non-zero 64-bit id (zero is the wire's 'untraced' marker)."""
+    """Random non-zero 63-bit id (zero is the wire's 'untraced' marker;
+    bit 63 is reserved as the tail-sampling ``debug=1`` force-keep flag
+    — see ``telemetry.sampling`` — so it is never minted by accident)."""
     with _id_lock:
-        return _id_rng.randrange(1, 1 << 64)
+        return _id_rng.randrange(1, 1 << 63)
 
 
 class SpanRecorder:
@@ -87,18 +91,33 @@ class SpanRecorder:
     def __init__(self, capacity: int = 4096) -> None:
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=max(1, int(capacity)))
+        self._dropped = 0
 
     def record(self, rec: Dict[str, Any]) -> None:
         with self._lock:
+            evicted = len(self._buf) == self._buf.maxlen
+            if evicted:
+                self._dropped += 1
             self._buf.append(rec)
+        if evicted:
+            # eviction at maxlen used to be invisible — consumers of a
+            # lossy /spans window must be able to see that it is lossy
+            metrics.counter("telemetry.spans_dropped").add(1)
 
     def snapshot(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._buf)
 
+    @property
+    def dropped(self) -> int:
+        """Records evicted by the ring since construction/clear()."""
+        with self._lock:
+            return self._dropped
+
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
+            self._dropped = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -107,6 +126,24 @@ class SpanRecorder:
 
 #: process-global recorder (the /spans endpoint and Chrome export read it)
 recorder = SpanRecorder(capacity=get_env("DMLC_SPAN_BUFFER", 4096))
+
+# Optional tail sampler (telemetry.sampling.TailSampler) interposed
+# between span completion and the recorder.  None (the default) keeps
+# the record-everything behaviour; ``sampling.install()`` swaps it in.
+# This module stays import-light — it never imports sampling itself.
+_sampler: Optional[Any] = None
+
+
+def set_sampler(sampler: Optional[Any]) -> None:
+    """Install (or with None, remove) the tail-sampling hook.  The
+    sampler must expose ``on_start(trace_id)``, ``on_end(trace_id,
+    rec)`` and ``on_event(trace_id_or_none, rec)``."""
+    global _sampler
+    _sampler = sampler
+
+
+def get_sampler() -> Optional[Any]:
+    return _sampler
 
 # The active node of the logical call chain: a live Span in-process, or a
 # bare TraceContext re-activated after crossing a thread/wire boundary.
@@ -199,7 +236,7 @@ class Span:
         self._ended = True
         if attrs:
             self.attrs.update(attrs)
-        recorder.record({
+        rec = {
             "kind": "span",
             "name": self.name,
             "trace_id": format_id(self.trace_id),
@@ -213,7 +250,12 @@ class Span:
             "thread": self._thread,
             "attrs": _jsonable(self.attrs),
             "events": self.events,
-        })
+        }
+        s = _sampler
+        if s is not None:
+            s.on_end(self.trace_id, rec)
+        else:
+            recorder.record(rec)
 
 
 def _jsonable(attrs: Dict[str, Any]) -> Dict[str, Any]:
@@ -243,6 +285,9 @@ def start_span(name: str, parent: Optional[TraceContext] = None,
         trace_id, parent_id = new_trace_id(), None
     else:
         trace_id, parent_id = parent.trace_id, parent.span_id
+    s = _sampler
+    if s is not None:
+        s.on_start(trace_id)
     return Span(name, trace_id, new_trace_id(), parent_id, _jsonable(attrs))
 
 
@@ -294,7 +339,7 @@ def add_event(name: str, **attrs: Any) -> None:
         return
     ctx = _ids_of(node)
     t = threading.current_thread()
-    recorder.record({
+    rec = {
         "kind": "event",
         "name": name,
         "trace_id": format_id(ctx.trace_id) if ctx else None,
@@ -304,4 +349,9 @@ def add_event(name: str, **attrs: Any) -> None:
         "tid": t.ident or 0,
         "thread": t.name,
         "attrs": _jsonable(attrs),
-    })
+    }
+    s = _sampler
+    if s is not None:
+        s.on_event(ctx.trace_id if ctx else None, rec)
+    else:
+        recorder.record(rec)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Iterable, List, Tuple, Union
 
+from ..telemetry import trace as teltrace
 from ..utils.logging import DMLCError, get_logger
 from ..utils.metrics import metrics
 from ..utils.retry import CircuitBreaker, CircuitOpen
@@ -143,12 +144,21 @@ class EndpointSet:
                               f"control_epoch)")
                 continue
             breaker.record_success()
+            failed_over = False
             with self._lock:
                 if self._current != idx:
+                    prev = self.endpoints[self._current]
                     self._current = idx
+                    failed_over = True
                     metrics.counter("transport.endpoints.failovers").add(1)
                     logger.warning("endpoint set %r: failed over to "
                                    "%s:%d", self.name, addr[0], addr[1])
+            if failed_over:
+                # annotate the caller's trace (event outside the lock):
+                # which endpoint the walk abandoned and which answered
+                teltrace.add_event("failover", set=self.name,
+                                   frm=f"{prev[0]}:{prev[1]}",
+                                   to=f"{addr[0]}:{addr[1]}")
             return out
         raise DMLCError(f"endpoint set {self.name!r}: all "
                         f"{n} endpoint(s) failed: " + "; ".join(errors))

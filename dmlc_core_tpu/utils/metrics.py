@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import math
 import random
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
@@ -98,6 +99,28 @@ class Gauge:
         return {"type": "gauge", "value": max(vals) if vals else 0.0}
 
 
+#: exemplar slots per histogram — one per value region (well below
+#: half the mean, below the mean, up to 2x the mean, the tail beyond)
+_EXEMPLAR_SLOTS = 4
+
+
+def _active_trace_hex() -> Optional[str]:
+    """Hex trace id of the ambient trace context, or None.
+
+    Resolved through ``sys.modules`` so this module never imports the
+    telemetry package (which imports it back): if tracing was never
+    imported there are no traces to reference, and the probe costs one
+    dict lookup.
+    """
+    tr = sys.modules.get("dmlc_core_tpu.telemetry.trace")
+    if tr is None:
+        return None
+    try:
+        return tr.current_trace_id()
+    except Exception:
+        return None
+
+
 class Histogram:
     """Value distribution with quantile estimation (thread-safe).
 
@@ -106,6 +129,13 @@ class Histogram:
     quantiles stay unbiased over unbounded streams at O(1) memory while
     count/sum/min/max remain exact.  The reservoir RNG is seeded, so a
     replayed stream reports identical quantiles.
+
+    When an observation happens inside an active trace context, the
+    (value, trace_id, ts) triple is retained as an *exemplar* in one of
+    :data:`_EXEMPLAR_SLOTS` slots bucketed by value region relative to
+    the running mean — so the tail slot always references a concrete
+    slow request.  Exemplars ride :meth:`snapshot` (key absent when none
+    exist) and render in the OpenMetrics exposition format.
     """
 
     def __init__(self, max_samples: int = 8192, seed: int = 0) -> None:
@@ -118,10 +148,12 @@ class Histogram:
         self._min = math.inf
         self._max = -math.inf
         self._rng = random.Random(seed)
+        self._exemplars: List[Any] = [None] * _EXEMPLAR_SLOTS
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         v = float(v)
+        tid = _active_trace_hex()
         with self._lock:
             self._count += 1
             self._sum += v
@@ -135,6 +167,12 @@ class Histogram:
                 j = self._rng.randrange(self._count)
                 if j < self._cap:
                     self._samples[j] = v
+            if tid is not None:
+                mean = self._sum / self._count
+                slot = (0 if v <= 0.5 * mean else
+                        1 if v <= mean else
+                        2 if v <= 2.0 * mean else 3)
+                self._exemplars[slot] = (v, tid, time.time())
 
     @contextlib.contextmanager
     def time(self, clock: Callable[[], float] = time.monotonic
@@ -202,10 +240,18 @@ class Histogram:
             mn = self._min if count else 0.0
             mx = self._max if count else 0.0
             s = sorted(self._samples)
+            ex = [{"value": val, "trace_id": t, "ts": ts}
+                  for (val, t, ts) in
+                  (e for e in self._exemplars if e is not None)]
         p50, p95, p99 = self._interp(s, [0.5, 0.95, 0.99])
-        return {"type": "histogram", "count": count,
+        snap = {"type": "histogram", "count": count,
                 "mean": sum_ / count if count else 0.0, "min": mn, "max": mx,
                 "p50": p50, "p95": p95, "p99": p99}
+        if ex:
+            # additive key: absent when no traced observation happened,
+            # so snapshot consumers that never see traces are unchanged
+            snap["exemplars"] = ex
+        return snap
 
     def state(self) -> Dict[str, Any]:
         """Serialized reservoir state — exact moments + the sample set —

@@ -1,0 +1,848 @@
+"""Production tracing at scale (ISSUE 18): tail-based sampling units
+(hash floor, debug bit, token bucket, trace buffer), metric exemplars
+through the OpenMetrics exposition, wide-event audit ring + /events,
+the span-ring eviction counter, router hedge/failover span events, and
+the cross-tier chaos drills (serving router→replica and data-service
+consumer→worker→dispatcher) — all on CPU."""
+
+import json
+import re
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.telemetry import exposition
+from dmlc_core_tpu.telemetry import sampling as telsampling
+from dmlc_core_tpu.telemetry import trace as teltrace
+from dmlc_core_tpu.telemetry import wide_events
+from dmlc_core_tpu.telemetry.sampling import (
+    DEBUG_BIT, TailSampler, TraceBuffer, _TokenBucket, debug_trace_id,
+    hash_keep, is_debug, mark_debug, _mix)
+from dmlc_core_tpu.telemetry.wide_events import FIELDS, wide_event, wide_log
+from dmlc_core_tpu.utils import clear_faults, inject_faults
+from dmlc_core_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telsampling.uninstall()
+    teltrace.recorder.clear()
+    clear_faults()
+    yield
+    telsampling.uninstall()
+    teltrace.recorder.clear()
+    clear_faults()
+
+
+def _c(name):
+    return metrics.counter(name).value
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def _wait_for(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _rec(name, tid, *, span_id="s1", parent_id=None, dur_us=1000,
+         status="OK", error=None, kind="span"):
+    attrs = {"status": status}
+    if error is not None:
+        attrs["error"] = error
+    return {"kind": kind, "name": name,
+            "trace_id": teltrace.format_id(tid), "span_id": span_id,
+            "parent_id": parent_id, "dur_us": dur_us, "attrs": attrs,
+            "events": []}
+
+
+def _feed(sampler, tid, **kw):
+    """One single-span trace through the sampler's hook surface."""
+    sampler.on_start(tid)
+    sampler.on_end(tid, _rec(kw.pop("name", "op"), tid, **kw))
+
+
+def _sampler(**kw):
+    """A NOT-installed sampler over a private recorder (unit tests)."""
+    kw.setdefault("floor", 0.0)
+    kw.setdefault("keep_per_s", 0.0)
+    kw.setdefault("keep_slow_ms", 1e9)
+    kw.setdefault("decide_timeout_s", 60.0)
+    kw.setdefault("recorder", teltrace.SpanRecorder(capacity=4096))
+    return TailSampler(**kw)
+
+
+# ---------------------------------------------------------------------------
+# hash floor + debug bit + token bucket
+# ---------------------------------------------------------------------------
+
+def test_mix_and_hash_keep_deterministic():
+    ids = [teltrace.new_trace_id() for _ in range(4000)]
+    assert all(_mix(i) == _mix(i) for i in ids[:32])
+    # shortcuts: 1.0 keeps everything, 0.0 keeps nothing
+    assert all(hash_keep(i, 1.0) for i in ids[:32])
+    assert not any(hash_keep(i, 0.0) for i in ids[:32])
+    # the floor is a rate: ~25% of random ids land under 0.25
+    frac = sum(hash_keep(i, 0.25) for i in ids) / len(ids)
+    assert 0.20 < frac < 0.30
+    # the debug bit is masked out of the hash, so a debug-marked id
+    # lands on the same side of the floor on every tier
+    for i in ids[:64]:
+        assert hash_keep(i, 0.25) == hash_keep(i | DEBUG_BIT, 0.25)
+
+
+def test_debug_bit_marking():
+    # new_trace_id mints 63-bit ids — bit 63 is never set by accident
+    assert all(teltrace.new_trace_id() < DEBUG_BIT for _ in range(256))
+    ctx = teltrace.TraceContext(teltrace.new_trace_id(),
+                                teltrace.new_trace_id())
+    marked = mark_debug(ctx)
+    assert is_debug(marked.trace_id) and not is_debug(ctx.trace_id)
+    assert marked.span_id == ctx.span_id
+    assert (marked.trace_id & ~DEBUG_BIT) == ctx.trace_id
+    assert is_debug(debug_trace_id())
+
+
+def test_token_bucket_force_and_debt():
+    b = _TokenBucket(2.0)               # burst = max(1, rate) = 2
+    t = b._t                            # anchor the injected clock
+    assert b.take(now=t) and b.take(now=t)
+    assert not b.take(now=t)            # budget spent
+    assert b.take(force=True, now=t)    # forced keep always passes...
+    # ...but debits into debt: one second refills 2 tokens, only one of
+    # which is spendable (the other paid the debt back)
+    assert b.take(now=t + 1.0)
+    assert not b.take(now=t + 1.0)
+    assert _TokenBucket(0.0).take()     # rate <= 0 = unlimited
+
+
+# ---------------------------------------------------------------------------
+# TraceBuffer
+# ---------------------------------------------------------------------------
+
+def test_buffer_decides_when_local_refcount_hits_zero():
+    done = []
+    b = TraceBuffer(lambda g, timed_out: done.append((g, timed_out)),
+                    max_spans=64, decide_timeout_s=60.0)
+    tid = teltrace.new_trace_id()
+    b.on_start(tid)
+    b.on_start(tid)                     # nested child
+    b.on_end(tid, _rec("child", tid, span_id="c"))
+    assert not done                     # root still open
+    assert b.attach(tid, _rec("ev", tid, kind="event"))
+    b.on_end(tid, _rec("root", tid, span_id="r"))
+    assert len(done) == 1
+    g, timed_out = done[0]
+    assert not timed_out
+    assert [r["name"] for r in g.records] == ["child", "ev", "root"]
+    assert len(b) == 0
+    # no group open → attach refuses, caller falls back to the verdict
+    assert not b.attach(tid, _rec("late", tid, kind="event"))
+
+
+def test_buffer_unknown_span_is_its_own_group():
+    """A span whose start predates the sampler decides immediately as a
+    single-record group (sampler installed mid-span)."""
+    done = []
+    b = TraceBuffer(lambda g, timed_out: done.append(g))
+    tid = teltrace.new_trace_id()
+    b.on_end(tid, _rec("orphan", tid))
+    assert len(done) == 1 and len(done[0].records) == 1
+
+
+def test_buffer_timeout_flush_counts():
+    done = []
+    b = TraceBuffer(lambda g, timed_out: done.append((g, timed_out)),
+                    decide_timeout_s=0.05)
+    tid = teltrace.new_trace_id()
+    b.on_start(tid)
+    b.on_start(tid)
+    b.on_end(tid, _rec("child", tid))   # root never ends locally
+    t0 = _c("telemetry.sampling.timeouts")
+    assert b.flush_expired(now=time.monotonic() + 10.0) == 1
+    assert done and done[0][1] is True
+    assert _c("telemetry.sampling.timeouts") - t0 == 1
+
+
+def test_buffer_overflow_evicts_oldest_whole_trace():
+    done = []
+    b = TraceBuffer(lambda g, timed_out: done.append(g),
+                    max_spans=4, decide_timeout_s=60.0)
+    t1, t2 = teltrace.new_trace_id(), teltrace.new_trace_id()
+    o0 = _c("telemetry.sampling.overflow")
+    for _ in range(4):
+        b.on_start(t1)
+    for i in range(3):
+        b.on_end(t1, _rec(f"a{i}", t1, span_id=f"a{i}"))
+    for _ in range(3):
+        b.on_start(t2)
+    b.on_end(t2, _rec("b0", t2, span_id="b0"))
+    assert not done                     # 4 buffered spans: at capacity
+    b.on_end(t2, _rec("b1", t2, span_id="b1"))   # 5th: evict oldest
+    assert _c("telemetry.sampling.overflow") - o0 == 1
+    assert len(done) == 1 and done[0].trace_id == t1
+    assert len(done[0].records) == 3    # the whole trace, not one span
+
+
+# ---------------------------------------------------------------------------
+# TailSampler verdicts
+# ---------------------------------------------------------------------------
+
+def test_error_trace_kept_despite_zero_floor():
+    s = _sampler()
+    k0, e0 = _c("telemetry.sampling.kept"), _c("telemetry.sampling.keep_error")
+    tid = teltrace.new_trace_id()
+    _feed(s, tid, status="OVERLOADED")
+    assert s.verdict(tid) is True
+    assert [r["trace_id"] for r in s.recorder.snapshot()] == \
+        [teltrace.format_id(tid)]
+    assert _c("telemetry.sampling.kept") - k0 == 1
+    assert _c("telemetry.sampling.keep_error") - e0 == 1
+    # an attrs["error"] marker is an error trace too
+    tid2 = teltrace.new_trace_id()
+    _feed(s, tid2, error="ValueError: boom")
+    assert s.verdict(tid2) is True
+
+
+def test_healthy_trace_dropped_and_counted():
+    s = _sampler()
+    d0 = _c("telemetry.sampling.dropped")
+    ds0 = _c("telemetry.sampling.dropped_spans")
+    tid = teltrace.new_trace_id()
+    _feed(s, tid)
+    assert s.verdict(tid) is False
+    assert len(s.recorder) == 0
+    assert _c("telemetry.sampling.dropped") - d0 == 1
+    assert _c("telemetry.sampling.dropped_spans") - ds0 == 1
+
+
+def test_slow_keep_explicit_threshold():
+    s = _sampler(keep_slow_ms=50.0)
+    sl0 = _c("telemetry.sampling.keep_slow")
+    fast, slow = teltrace.new_trace_id(), teltrace.new_trace_id()
+    _feed(s, fast, dur_us=10_000)       # 10ms < 50ms
+    _feed(s, slow, dur_us=100_000)      # 100ms > 50ms
+    assert s.verdict(fast) is False
+    assert s.verdict(slow) is True
+    assert _c("telemetry.sampling.keep_slow") - sl0 == 1
+
+
+def test_adaptive_slow_threshold_from_live_p95():
+    s = _sampler(keep_slow_ms=0.0)      # 0 = adaptive
+    name = "adaptive.op.r18"
+    for _ in range(60):                 # build the p95 (needs >= 50 obs)
+        _feed(s, teltrace.new_trace_id(), dur_us=10_000, name=name)
+    s._thr_cache.clear()                # drop the 1s TTL cache: the
+    sl0 = _c("telemetry.sampling.keep_slow")   # 60 feeds ran within it
+    outlier = teltrace.new_trace_id()
+    _feed(s, outlier, dur_us=200_000, name=name)   # 200ms vs p95 ~10ms
+    assert s.verdict(outlier) is True
+    assert _c("telemetry.sampling.keep_slow") - sl0 == 1
+
+
+def test_floor_keep_matches_hash_and_caches_verdict():
+    s = _sampler(floor=0.5)
+    f0 = _c("telemetry.sampling.keep_floor")
+    ids = [teltrace.new_trace_id() for _ in range(64)]
+    for tid in ids:
+        _feed(s, tid)
+    for tid in ids:
+        assert s.verdict(tid) == hash_keep(tid, 0.5)
+    kept = sum(1 for tid in ids if s.verdict(tid))
+    assert _c("telemetry.sampling.keep_floor") - f0 == kept
+    assert 0 < kept < len(ids)
+
+
+def test_debug_bit_forces_keep():
+    s = _sampler()
+    db0 = _c("telemetry.sampling.keep_debug")
+    tid = debug_trace_id()
+    _feed(s, tid)
+    assert s.verdict(tid) is True
+    assert _c("telemetry.sampling.keep_debug") - db0 == 1
+
+
+def test_slo_breach_keeps_trace():
+    g = metrics.gauge("slo.active_breaches")
+    g.set(1)
+    try:
+        s = _sampler()
+        s0 = _c("telemetry.sampling.keep_slo")
+        tid = teltrace.new_trace_id()
+        _feed(s, tid)
+        assert s.verdict(tid) is True
+        assert _c("telemetry.sampling.keep_slo") - s0 == 1
+    finally:
+        g.set(0)
+
+
+def test_token_bucket_caps_floor_keeps_not_error_keeps():
+    s = _sampler(floor=1.0, keep_per_s=2.0)   # burst 2
+    k0, t0 = _c("telemetry.sampling.kept"), _c("telemetry.sampling.throttled")
+    for _ in range(30):
+        _feed(s, teltrace.new_trace_id())
+    kept = _c("telemetry.sampling.kept") - k0
+    assert kept <= 4                    # burst + at most a refill tick
+    assert _c("telemetry.sampling.throttled") - t0 >= 26
+    # error keeps force through an empty bucket
+    for _ in range(5):
+        _feed(s, teltrace.new_trace_id(), status="FAILED")
+    assert _c("telemetry.sampling.kept") - k0 == kept + 5
+
+
+def test_sticky_verdicts_route_late_spans():
+    s = _sampler()
+    kept_tid, drop_tid = teltrace.new_trace_id(), teltrace.new_trace_id()
+    _feed(s, kept_tid, status="FAILED")            # kept
+    _feed(s, drop_tid)                             # dropped
+    n = len(s.recorder)
+    k0 = _c("telemetry.sampling.kept")
+    ds0 = _c("telemetry.sampling.dropped_spans")
+    # a late span of a kept trace records directly — no fresh decision
+    s.on_start(kept_tid)
+    s.on_end(kept_tid, _rec("late", kept_tid, span_id="late"))
+    assert len(s.recorder) == n + 1
+    assert _c("telemetry.sampling.kept") == k0
+    # a late span of a dropped trace is dropped and counted
+    s.on_start(drop_tid)
+    s.on_end(drop_tid, _rec("late2", drop_tid, span_id="late2"))
+    assert len(s.recorder) == n + 1
+    assert _c("telemetry.sampling.dropped_spans") - ds0 == 1
+    # standalone events follow the verdict; untraced events always land
+    s.on_event(drop_tid, _rec("ev", drop_tid, kind="event"))
+    assert len(s.recorder) == n + 1
+    s.on_event(None, _rec("untraced", 1, kind="event"))
+    assert len(s.recorder) == n + 2
+
+
+def test_was_kept_lookup_and_module_level():
+    assert telsampling.was_kept("deadbeefdeadbeef") is None  # no sampler
+    s = telsampling.install(_sampler())
+    try:
+        tid = teltrace.new_trace_id()
+        hexid = teltrace.format_id(tid)
+        assert telsampling.was_kept(hexid) is None     # undecided
+        _feed(s, tid, status="FAILED")
+        assert telsampling.was_kept(hexid) is True
+        assert s.was_kept("not-hex") is None
+        assert s.was_kept(None) is None
+    finally:
+        telsampling.uninstall()
+
+
+def test_flush_decides_pending_groups():
+    s = _sampler()
+    tid = teltrace.new_trace_id()
+    s.on_start(tid)
+    s.on_start(tid)
+    s.on_end(tid, _rec("child", tid, status="FAILED"))
+    assert s.verdict(tid) is None
+    s.flush()
+    assert s.verdict(tid) is True
+
+
+def test_maybe_install_from_env_gates_on_knob(monkeypatch):
+    monkeypatch.delenv("DMLC_TRACE_SAMPLE", raising=False)
+    assert telsampling.maybe_install_from_env() is None
+    assert telsampling.get_sampler() is None
+    monkeypatch.setenv("DMLC_TRACE_SAMPLE", "0.25")
+    try:
+        s = telsampling.maybe_install_from_env()
+        assert s is not None and s.floor == 0.25
+        assert telsampling.get_sampler() is s
+        # idempotent: a second tier's startup reuses the installed one
+        assert telsampling.maybe_install_from_env() is s
+    finally:
+        telsampling.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# exemplars + OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+def test_histogram_retains_exemplar_from_active_trace():
+    h = metrics.histogram("test.exemplar.capture_ms")
+    with teltrace.span("exemplar-op") as s:
+        h.observe(42.0)
+    snap = h.snapshot()
+    (ex,) = snap["exemplars"]
+    assert ex["value"] == 42.0
+    assert ex["trace_id"] == teltrace.format_id(s.trace_id)
+    assert ex["ts"] > 0
+    # untraced observations never attach exemplars
+    h2 = metrics.histogram("test.exemplar.untraced_ms")
+    h2.observe(1.0)
+    assert "exemplars" not in h2.snapshot()
+
+
+def test_openmetrics_renders_only_kept_trace_exemplars():
+    s = telsampling.install(_sampler())
+    h = metrics.histogram("test.exemplar.filter_ms")
+    with pytest.raises(ValueError):
+        with teltrace.span("ex-err-op") as sp_err:
+            h.observe(5.0)
+            raise ValueError("boom")
+    kept_hex = teltrace.format_id(sp_err.trace_id)
+    with teltrace.span("ex-ok-op") as sp_ok:
+        h.observe(500.0)
+    dropped_hex = teltrace.format_id(sp_ok.trace_id)
+    assert s.verdict(sp_err.trace_id) is True
+    assert s.verdict(sp_ok.trace_id) is False
+    text = exposition.render_openmetrics(metrics.snapshot())
+    assert text.endswith("# EOF\n")
+    assert "# TYPE dmlc_test_exemplar_filter_ms histogram" in text
+    buckets = [ln for ln in text.splitlines()
+               if ln.startswith("dmlc_test_exemplar_filter_ms_bucket")]
+    assert any('le="+Inf"' in ln for ln in buckets)
+    # exemplar syntax per OpenMetrics: `... # {trace_id="..."} value ts`
+    ex_lines = [ln for ln in buckets if " # {" in ln]
+    assert ex_lines
+    for ln in ex_lines:
+        assert re.search(r'# \{trace_id="[0-9a-f]{16}"\} \S+ \S+$', ln)
+    assert kept_hex in text             # followable into /spans
+    assert dropped_hex not in text      # dropped trace never referenced
+
+
+def test_exporter_openmetrics_timeline_analyze_exemplars():
+    h = metrics.histogram("test.exemplar.endpoint_ms")
+    with teltrace.span("endpoint-op") as sp:
+        h.observe(7.0)
+    hexid = teltrace.format_id(sp.trace_id)
+    srv = exposition.TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, ctype, body = _get(base + "/metrics?format=openmetrics")
+        assert code == 200 and "openmetrics-text" in ctype
+        assert body.endswith("# EOF\n")
+        assert f'# {{trace_id="{hexid}"}}' in body
+        # /timeline and /analyze bridge aggregates to concrete traces
+        code, _, body = _get(base + "/timeline")
+        exs = json.loads(body)["exemplars"]
+        assert any(e["trace_id"] == hexid
+                   for e in exs["test.exemplar.endpoint_ms"])
+        code, _, body = _get(base + "/analyze")
+        exs = json.loads(body)["exemplars"]
+        assert any(e["trace_id"] == hexid
+                   for e in exs["test.exemplar.endpoint_ms"])
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# wide events
+# ---------------------------------------------------------------------------
+
+def test_wide_event_closed_vocabulary_and_seq():
+    wide_log.reset(capacity=4)
+    try:
+        u0 = _c("telemetry.wide_events.unknown_fields")
+        e0 = _c("telemetry.wide_events.emitted")
+        ev = wide_event("serving.request", model="m", bogus=1, rows=4)
+        assert "bogus" not in ev
+        assert set(ev) <= FIELDS
+        assert _c("telemetry.wide_events.unknown_fields") - u0 == 1
+        ev2 = wide_event("serving.request", model="m")
+        assert ev2["seq"] == ev["seq"] + 1
+        assert _c("telemetry.wide_events.emitted") - e0 == 2
+        assert wide_log.snapshot(since=ev["seq"]) == [ev2]
+        # ring overflow is counted, never silent
+        for _ in range(6):
+            wide_event("serving.request", model="m")
+        doc = wide_log.doc()
+        assert len(doc["events"]) == 4
+        assert doc["dropped"] >= 2
+        assert doc["schema"] == "dmlc.telemetry.wide_events/1"
+    finally:
+        wide_log.reset()
+
+
+def test_wide_event_stamps_trace_identity_and_verdict():
+    wide_log.reset()
+    s = telsampling.install(_sampler())
+    try:
+        with teltrace.span("we-op") as sp:
+            ev = wide_event("serving.request", model="m")
+        assert ev["trace_id"] == teltrace.format_id(sp.trace_id)
+        assert ev.get("debug") is False
+        ctx = mark_debug(teltrace.TraceContext(teltrace.new_trace_id(),
+                                               teltrace.new_trace_id()))
+        with teltrace.activate(ctx):
+            ev = wide_event("serving.request", model="m")
+        assert ev["debug"] is True
+        # a decided trace's verdict rides along as `sampled`
+        tid = teltrace.new_trace_id()
+        _feed(s, tid, status="FAILED")
+        ev = wide_event("serving.request", model="m",
+                        trace_id=teltrace.format_id(tid))
+        assert ev["sampled"] is True
+    finally:
+        telsampling.uninstall()
+        wide_log.reset()
+
+
+def test_wide_event_file_mirror(tmp_path):
+    path = tmp_path / "audit.jsonl"
+    wide_log.reset(capacity=64, path=str(path))
+    try:
+        wide_event("serving.request", model="m", rows=4, outcome="OK")
+        wide_event("data_service.lease", worker="w0", part=1,
+                   outcome="OK")
+        lines = path.read_text().splitlines()
+        assert len(lines) == 2
+        docs = [json.loads(ln) for ln in lines]
+        assert [d["kind"] for d in docs] == ["serving.request",
+                                             "data_service.lease"]
+        for d in docs:
+            assert set(d) <= FIELDS
+    finally:
+        wide_log.reset()
+
+
+def test_events_endpoint_serves_since_cursor():
+    wide_log.reset()
+    srv = exposition.TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        evs = [wide_event("serving.request", model="m", req_id=i)
+               for i in range(3)]
+        base = f"http://127.0.0.1:{srv.port}"
+        code, _, body = _get(base + "/events")
+        doc = json.loads(body)
+        assert code == 200
+        assert [e["req_id"] for e in doc["events"]] == [0, 1, 2]
+        assert doc["last_seq"] == evs[-1]["seq"]
+        code, _, body = _get(base + f"/events?since={evs[1]['seq']}")
+        doc = json.loads(body)
+        assert [e["req_id"] for e in doc["events"]] == [2]
+    finally:
+        srv.stop()
+        wide_log.reset()
+
+
+# ---------------------------------------------------------------------------
+# satellite: span-ring eviction is visible
+# ---------------------------------------------------------------------------
+
+def test_recorder_eviction_bumps_drop_counter():
+    d0 = _c("telemetry.spans_dropped")
+    r = teltrace.SpanRecorder(capacity=2)
+    for i in range(5):
+        r.record({"name": str(i)})
+    assert r.dropped == 3
+    assert _c("telemetry.spans_dropped") - d0 == 3
+    r.clear()
+    assert r.dropped == 0
+
+
+def test_spans_endpoint_stamps_dropped_count():
+    with teltrace.span("spans-dropped-probe"):
+        pass
+    srv = exposition.TelemetryServer(port=0, host="127.0.0.1").start()
+    try:
+        code, _, body = _get(f"http://127.0.0.1:{srv.port}/spans")
+        doc = json.loads(body)
+        assert code == 200
+        assert doc["dropped"] == teltrace.recorder.dropped
+        assert isinstance(doc["dropped"], int)
+        assert any(s["name"] == "spans-dropped-probe"
+                   for s in doc["spans"])
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: router hedge/failover span events
+# ---------------------------------------------------------------------------
+
+def test_router_failover_events_reparent_under_request_span(monkeypatch):
+    pytest.importorskip("jax")
+    from dmlc_core_tpu.serving import ServingRouter
+    from dmlc_core_tpu.serving.fleet import router as router_mod
+
+    monkeypatch.setenv("DMLC_ROUTER_RETRIES", "4")
+    r = ServingRouter(replicas=[("127.0.0.1", 1), ("127.0.0.1", 2)])
+    try:
+        a = r._replicas["127.0.0.1:1"]
+        b = r._replicas["127.0.0.1:2"]
+        span = teltrace.start_span("serving.router.request", req_id=1)
+        pend = router_mod._Pending(
+            1, SimpleNamespace(model_id="default"), 1, span.trace_id,
+            span.context.span_id, 4, 16, b"", span)
+        dispatched = []
+        monkeypatch.setattr(r, "_pick", lambda model, tried: b)
+        monkeypatch.setattr(
+            r, "_dispatch", lambda p, rep: dispatched.append(p) or True)
+        # a status-triggered resubmit is a hedge; conn loss a failover
+        assert r._try_failover(pend, a, reason="OVERLOADED")
+        assert r._try_failover(pend, a, reason="conn_lost",
+                               already_released=True)
+        assert pend.hedges == 1 and pend.failovers == 1
+        assert [e["name"] for e in span.events] == ["hedge", "failover"]
+        for e in span.events:
+            assert e["attrs"]["frm"] == "127.0.0.1:1"
+            assert e["attrs"]["to"] == "127.0.0.1:2"
+        # the replacement attempt reuses the original pend and its span:
+        # every attempt re-parents under the one router request span
+        assert all(p is pend and p.span is span for p in dispatched)
+        span.end(status="OK")
+    finally:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving fleet harness (drill + end-to-end hedge)
+# ---------------------------------------------------------------------------
+
+F = 5000
+
+
+def _fleet_stack(n, monkeypatch):
+    jnp = pytest.importorskip("jax").numpy
+    from dmlc_core_tpu.models import SparseLogReg
+    from dmlc_core_tpu.serving import (BucketLadder, InferenceEngine,
+                                       PredictClient, PredictionServer,
+                                       ReplicaAgent, ReplicaRegistry,
+                                       ServingRouter)
+
+    def engine():
+        model = SparseLogReg(num_features=F)
+        params = {"w": jnp.full((F,), 1.0, jnp.float32),
+                  "b": jnp.float32(0.0)}
+        return InferenceEngine(model, params,
+                               buckets=BucketLadder([(16, 512)]))
+
+    monkeypatch.setenv("DMLC_ROUTER_RETRIES", "4")
+    reg = ReplicaRegistry(heartbeat_timeout_s=2.0).start()
+    pairs = []
+    for _ in range(n):
+        srv = PredictionServer(engine(), metrics_port=0).start()
+        ag = ReplicaAgent(srv, reg.address, interval_s=0.1).start()
+        pairs.append((srv, ag))
+    router = ServingRouter(registry=reg.address, sync_s=0.1,
+                           health_poll_s=0.1).start()
+    cli = PredictClient(router.host, router.port, model_id="default")
+    return reg, pairs, router, cli
+
+
+def _fleet_teardown(reg, pairs, router, cli):
+    cli.close()
+    router.stop()
+    for srv, ag in pairs:
+        ag.stop()
+        srv.stop()
+    reg.stop()
+
+
+def _predict_req(rng, cli, rows=4, nnz_per_row=16):
+    counts = rng.integers(1, nnz_per_row + 1, size=rows)
+    ids = rng.integers(0, F, size=int(counts.sum())).astype(np.int32)
+    vals = rng.random(len(ids), dtype=np.float32)
+    row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+    return cli.predict(ids, vals, row_ptr, timeout=15.0)
+
+
+def test_router_hedge_keeps_both_attempts_in_one_trace(monkeypatch):
+    """The injected shed and its hedged resubmit are ONE trace: both
+    replica attempts parent under the single router request span, which
+    carries the hedge event with endpoint labels."""
+    reg, pairs, router, cli = _fleet_stack(2, monkeypatch)
+    try:
+        rng = np.random.default_rng(0)
+        with inject_faults("serving.server.admit:error=1.0:times=1"):
+            _predict_req(rng, cli)
+
+        def hedged_router_span():
+            return next(
+                (r for r in teltrace.recorder.snapshot()
+                 if r["name"] == "serving.router.request"
+                 and any(e["name"] == "hedge" for e in r["events"])),
+                None)
+
+        assert _wait_for(lambda: hedged_router_span() is not None)
+        rt = hedged_router_span()
+        ev = next(e for e in rt["events"] if e["name"] == "hedge")
+        assert ev["attrs"]["frm"] and ev["attrs"]["to"]
+        assert ev["attrs"]["frm"] != ev["attrs"]["to"]
+        assert ev["attrs"]["reason"] == "OVERLOADED"
+        assert _wait_for(lambda: len(
+            [r for r in teltrace.recorder.snapshot()
+             if r["name"] == "serving.server.request"
+             and r["trace_id"] == rt["trace_id"]]) == 2)
+        servers = [r for r in teltrace.recorder.snapshot()
+                   if r["name"] == "serving.server.request"
+                   and r["trace_id"] == rt["trace_id"]]
+        assert {s["attrs"]["status"] for s in servers} == \
+            {"OVERLOADED", "OK"}
+        assert all(s["parent_id"] == rt["span_id"] for s in servers)
+    finally:
+        _fleet_teardown(reg, pairs, router, cli)
+
+
+# ---------------------------------------------------------------------------
+# satellite: chaos drills
+# ---------------------------------------------------------------------------
+
+def test_drill_serving_error_trace_kept_complete_on_all_tiers(monkeypatch):
+    """Router→replica drill: with a zero hash floor only the injected
+    error trace survives, and it survives COMPLETE — client, router,
+    both replica attempts, engine — while healthy traffic is dropped."""
+    reg, pairs, router, cli = _fleet_stack(2, monkeypatch)
+    sampler = telsampling.install(_sampler(recorder=teltrace.recorder))
+    try:
+        rng = np.random.default_rng(1)
+        e0 = _c("telemetry.sampling.keep_error")
+        d0 = _c("telemetry.sampling.dropped")
+        with inject_faults("serving.server.admit:error=1.0:times=1"):
+            _predict_req(rng, cli)
+        for _ in range(11):
+            _predict_req(rng, cli)
+        assert _wait_for(
+            lambda: _c("telemetry.sampling.keep_error") - e0 >= 1)
+        assert _wait_for(
+            lambda: _c("telemetry.sampling.dropped") - d0 >= 11)
+        recs = teltrace.recorder.snapshot()
+        err_tids = {r["trace_id"] for r in recs
+                    if r["name"] == "serving.server.request"
+                    and r["attrs"].get("status") == "OVERLOADED"}
+        assert len(err_tids) == 1
+        (etid,) = err_tids
+        names = {r["name"] for r in recs if r["trace_id"] == etid}
+        assert {"serving.client.predict", "serving.router.request",
+                "serving.server.request",
+                "serving.engine.forward"} <= names
+        assert telsampling.was_kept(etid) is True
+        # NOTHING from the 11 healthy traces leaked into the ring
+        client_tids = {r["trace_id"] for r in recs
+                       if r["name"] == "serving.client.predict"}
+        assert client_tids == {etid}
+    finally:
+        telsampling.uninstall()
+        _fleet_teardown(reg, pairs, router, cli)
+
+
+def test_drill_hash_floor_verdicts_agree_across_tiers():
+    """Three tiers (router / replica / worker), three INDEPENDENT
+    samplers, zero coordination: identical keep sets at the hash floor,
+    100% of error traces and 100% of slow traces kept on every tier."""
+    tiers = ("router", "replica", "worker")
+
+    def trio(**kw):
+        return {t: _sampler(**dict(kw)) for t in tiers}
+
+    # hash floor: same verdict everywhere, ~floor keep rate
+    floored = trio(floor=0.25)
+    ids = [teltrace.new_trace_id() for _ in range(600)]
+    for tid in ids:
+        for t in tiers:
+            _feed(floored[t], tid, name=f"{t}.op", span_id=f"{t}-span")
+    kept = {t: {tid for tid in ids if floored[t].verdict(tid)}
+            for t in tiers}
+    assert kept["router"] == kept["replica"] == kept["worker"]
+    assert all(hash_keep(tid, 0.25) for tid in kept["router"])
+    assert 0.15 < len(kept["router"]) / len(ids) < 0.35
+    # kept traces are complete per tier; dropped ones leave nothing
+    for t in tiers:
+        ring = {int(r["trace_id"], 16)
+                for r in floored[t].recorder.snapshot()}
+        assert ring == kept[t]
+    # error and slow traces: kept on every tier regardless of the floor
+    drilled = trio(floor=0.0, keep_slow_ms=50.0)
+    err_ids = [teltrace.new_trace_id() for _ in range(20)]
+    slow_ids = [teltrace.new_trace_id() for _ in range(40)]
+    fast_ids = [teltrace.new_trace_id() for _ in range(40)]
+    for t in tiers:
+        for tid in err_ids:
+            _feed(drilled[t], tid, status="FAILED", name=f"{t}.op")
+        for tid in slow_ids:
+            _feed(drilled[t], tid, dur_us=200_000, name=f"{t}.op")
+        for tid in fast_ids:
+            _feed(drilled[t], tid, dur_us=1_000, name=f"{t}.op")
+    for t in tiers:
+        assert all(drilled[t].verdict(tid) for tid in err_ids)    # 100%
+        n_slow = sum(1 for tid in slow_ids if drilled[t].verdict(tid))
+        assert n_slow >= 0.95 * len(slow_ids)                     # >=95%
+        assert not any(drilled[t].verdict(tid) for tid in fast_ids)
+
+
+def test_drill_data_service_error_trace_kept_complete(tmp_path):
+    """Consumer→worker→dispatcher drill: an errored consumer epoch is
+    kept with all three tiers' spans in one trace (plus its lease wide
+    event); a healthy epoch at a zero floor is dropped on all tiers."""
+    pytest.importorskip("jax")
+    from dmlc_core_tpu.pipeline.data_service import (
+        DataServiceLoader, DataServiceWorker, Dispatcher)
+
+    rng = np.random.default_rng(7)
+    path = tmp_path / "drill.libsvm"
+    with open(path, "w") as f:
+        for i in range(120):
+            idx = np.sort(rng.choice(np.arange(1, 300), size=6,
+                                     replace=False))
+            f.write(f"{i + 1} " + " ".join(
+                f"{j}:{rng.random():.3f}" for j in idx) + "\n")
+    spec = {"uri": str(path), "fmt": "libsvm", "num_parts": 2,
+            "batch_rows": 32, "nnz_cap": 1024}
+
+    def drain_epoch():
+        ldr = DataServiceLoader(d.address, spec)
+        try:
+            for _kind, buf, _meta, _rows in ldr:
+                ldr.recycle(buf)
+        finally:
+            ldr.close()
+
+    wide_log.reset()
+    sampler = telsampling.install(_sampler(recorder=teltrace.recorder))
+    try:
+        with Dispatcher(lease_ttl_s=10.0, heartbeat_timeout_s=10.0) as d:
+            d.start()
+            with DataServiceWorker(d.address) as w:
+                w.start()
+                e0 = _c("telemetry.sampling.keep_error")
+                with pytest.raises(RuntimeError):
+                    with teltrace.span("consumer.epoch") as root:
+                        err_tid = root.trace_id
+                        drain_epoch()
+                        raise RuntimeError("injected: epoch audit")
+                assert _wait_for(
+                    lambda: sampler.verdict(err_tid) is not None)
+                assert sampler.verdict(err_tid) is True
+                assert _c("telemetry.sampling.keep_error") - e0 >= 1
+                hexid = teltrace.format_id(err_tid)
+                names = {r["name"]
+                         for r in teltrace.recorder.snapshot()
+                         if r["trace_id"] == hexid}
+                # all three tiers present in the one kept trace
+                assert {"consumer.epoch",                 # consumer
+                        "data_service.client.stream",
+                        "data_service.serve_shard",       # worker
+                        "data_service.dispatcher.rpc",    # dispatcher
+                        } <= names
+                # the lease audit line references the same trace
+                leases = [e for e in wide_log.snapshot()
+                          if e["kind"] == "data_service.lease"
+                          and e.get("trace_id") == hexid]
+                assert leases
+                assert all(e["outcome"] == "OK" for e in leases)
+                # a healthy epoch at floor 0 drops on every tier
+                with teltrace.span("consumer.epoch") as root2:
+                    ok_tid = root2.trace_id
+                    drain_epoch()
+                assert _wait_for(
+                    lambda: sampler.verdict(ok_tid) is not None)
+                assert sampler.verdict(ok_tid) is False
+                ok_hex = teltrace.format_id(ok_tid)
+                assert not any(r["trace_id"] == ok_hex
+                               for r in teltrace.recorder.snapshot())
+    finally:
+        telsampling.uninstall()
+        wide_log.reset()
