@@ -41,9 +41,20 @@ heartbeat re-attach for the registry, rank re-admission at the current
 generation for the tracker).  Both gate lower-better in
 ``check_regression.py`` via the "failover" token.
 
+``--c10k`` swaps the sweep for the connection-fabric ladder (committed
+as BENCH_c10k_r{N}.json): a router runs as a subprocess (so
+``/proc/<pid>/status`` gives honest VmRSS and Threads numbers) in
+reactor mode at 1k/5k/20k mostly-idle connections (clamped to the
+``ulimit -n`` headroom, with a note when clamped) with a live traffic
+subset per rung, plus a thread-per-connection baseline at 1k.
+Headlines: ``idle_conns_held`` (higher-better), ``mem_per_conn_kb`` and
+``resident_threads`` (both lower-better) — the reactor's thread count
+must be O(loops + executor), not O(connections).
+
 Usage: python benchmarks/bench_serving.py [out.json]
                                           [--telemetry-out PREFIX]
                                           [--router] [--timeline] [--ha]
+                                          [--c10k]
 Env:   DMLC_SERVE_REQUESTS (default 2000), DMLC_SERVE_FEATURES (2^16),
        DMLC_SERVE_MODEL (fm), DMLC_SERVE_DIM (16),
        DMLC_TELEMETRY_OUT (same as --telemetry-out)
@@ -387,6 +398,231 @@ def _tracker_failover() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def c10k_bench(model, params, *, requests: int, features: int):
+    """The connection-fabric ladder (r19): how many mostly-idle
+    connections one router process holds, and what each costs in RSS
+    and resident threads — reactor vs thread-per-connection, measured
+    on a real OS process via ``/proc/<pid>/status``."""
+    import resource
+    import socket
+    import subprocess
+
+    from dmlc_core_tpu.serving import (InferenceEngine, PredictionServer,
+                                       run_load)
+
+    nofile = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    # the bench process holds every idle socket itself, plus the live
+    # load's connections and the interpreter's own fds — leave headroom
+    cap = max(1000, int(nofile) - 4096)
+    notes = []
+    ladder = []
+    for n in (1000, 5000, 20000):
+        if n > cap:
+            notes.append(f"rung {n} clamped to {cap} (ulimit -n {nofile})")
+            n = cap
+        if n not in ladder:
+            ladder.append(n)
+    cores = os.cpu_count() or 1
+    if cores < 2:
+        notes.append(f"host has {cores} core(s): threaded baseline run "
+                     f"at 1k only; p99 numbers measure GIL contention "
+                     f"as much as the fabric")
+
+    def proc_status(pid):
+        rss = threads = None
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = int(line.split()[1])          # kB
+                elif line.startswith("Threads:"):
+                    threads = int(line.split()[1])
+        return rss, threads
+
+    def spawn_router(replica_addr, reactor):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+               "DMLC_SERVE_REACTOR": "1" if reactor else "0"}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dmlc_core_tpu.serving.fleet.router",
+             f"replicas={replica_addr}", "host=127.0.0.1", "port=0"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, bufsize=1)
+        line = proc.stdout.readline()
+        if not line.startswith("routing on "):
+            proc.kill()
+            raise RuntimeError(f"router subprocess died: {line!r}")
+        host, port = line.split()[-1].rsplit(":", 1)
+        return proc, host, int(port)
+
+    def open_idle(host, port, n):
+        conns, failed = [], 0
+        for i in range(n):
+            try:
+                s = socket.create_connection((host, port), timeout=10)
+                s.setblocking(False)
+                conns.append(s)
+            except OSError:
+                failed += 1
+            if i % 256 == 255:
+                time.sleep(0.02)        # let accept batches drain
+        return conns, failed
+
+    def sample_still_open(conns, sample=128):
+        """A held connection shows EAGAIN, not EOF — spot-check."""
+        if not conns:
+            return 0, 0
+        step = max(1, len(conns) // sample)
+        ok = checked = 0
+        for s in conns[::step]:
+            checked += 1
+            try:
+                if s.recv(1) != b"":
+                    ok += 1             # stray data still means open
+            except (BlockingIOError, InterruptedError):
+                ok += 1
+            except OSError:
+                pass
+        return ok, checked
+
+    engine = InferenceEngine(model, params, postprocess="sigmoid")
+    srv = PredictionServer(engine, warmup=True, metrics_port=0).start()
+    addr = f"{srv.host}:{srv.port}"
+    # the live subset is a LIGHT closed loop — the C10k shape is
+    # thousands of parked connections and a handful of live ones.  A
+    # saturating load here would measure how the OS scheduler shares
+    # one core between three processes, not the fabric (a single loop
+    # thread gets a smaller CFS share than 1000 parked-but-runnable
+    # conn threads).  Best-of-3 per rung bounds co-tenant noise, same
+    # discipline as --timeline.
+    live_requests = min(requests, 800)
+    out = {}
+
+    def live_load(host, port):
+        return min((run_load(host, port, requests=live_requests,
+                             features=features, concurrency=2,
+                             pipeline_depth=2) for _ in range(3)),
+                   key=lambda r: r["latency_ms"]["p99"])
+
+    # warm the bench process itself (client paths, jax dispatch caches)
+    # against the bare replica so the first rung isn't the one paying it
+    run_load(srv.host, srv.port, requests=live_requests,
+             features=features, concurrency=2, pipeline_depth=2)
+
+    def hold(reactor, n):
+        """Spawn a router, warm it, park n idle connections on it, and
+        measure the cost — returns the live-load-ready handle."""
+        proc, host, port = spawn_router(addr, reactor)
+        # pay one-time costs (imports, backend link, first frames)
+        # before the RSS baseline so the delta is the connections'
+        run_load(host, port, requests=200, features=features,
+                 concurrency=2, pipeline_depth=4)
+        time.sleep(0.5)
+        rss0, thr0 = proc_status(proc.pid)
+        t0 = time.monotonic()
+        conns, failed = open_idle(host, port, n)
+        # threaded mode needs the per-connection threads actually
+        # spawned before Threads: means anything
+        deadline = time.monotonic() + 30
+        while not reactor and time.monotonic() < deadline:
+            if proc_status(proc.pid)[1] >= thr0 + len(conns) - 8:
+                break
+            time.sleep(0.2)
+        time.sleep(1.0)
+        return {"mode": "reactor" if reactor else "threaded",
+                "proc": proc, "host": host, "port": port, "conns": conns,
+                "failed": failed, "rss0": rss0,
+                "connect_wall_s": round(time.monotonic() - t0, 3)}
+
+    def finish(h, n, live):
+        rss1, thr1 = proc_status(h["proc"].pid)
+        ok_s, checked = sample_still_open(h["conns"])
+        held = int(len(h["conns"]) * ok_s / max(1, checked))
+        rep = {
+            "mode": h["mode"], "target_conns": n,
+            "idle_conns_held": held, "connect_failed": h["failed"],
+            "connect_wall_s": h["connect_wall_s"],
+            "rss_kb_base": h["rss0"], "rss_kb_loaded": rss1,
+            "mem_per_conn_kb": round((rss1 - h["rss0"]) / max(1, held), 2),
+            "resident_threads": thr1,
+            "live_qps": live["qps"],
+            "live_latency_ms": live["latency_ms"],
+            "live_ok": live["ok"], "live_rejected": live["rejected"],
+        }
+        out[f"{h['mode']}_{n}"] = rep
+        log(f"{h['mode']}_{n}: held={held}/{n} "
+            f"mem/conn={rep['mem_per_conn_kb']:.1f}kB "
+            f"threads={thr1} live_p99="
+            f"{live['latency_ms']['p99']:.2f}ms")
+
+    def release(h):
+        for s in h["conns"]:
+            try:
+                s.close()
+            except OSError:
+                pass
+        h["proc"].kill()
+        h["proc"].wait()
+
+    try:
+        # the 1k comparison rung: both fabrics alive AT THE SAME TIME,
+        # live reps interleaved — back-to-back arms on a busy host bias
+        # whichever runs later (the box quiets as caches warm), and this
+        # pair is the p99-parity acceptance number.  The threaded
+        # baseline stops at 1k: one thread (and its stack) per held
+        # connection — higher rungs would just be slower proof.
+        h_r = hold(True, 1000)
+        h_t = hold(False, 1000)
+        try:
+            reps_r, reps_t = [], []
+            for _ in range(3):
+                reps_r.append(run_load(h_r["host"], h_r["port"],
+                                       requests=live_requests,
+                                       features=features, concurrency=2,
+                                       pipeline_depth=2))
+                reps_t.append(run_load(h_t["host"], h_t["port"],
+                                       requests=live_requests,
+                                       features=features, concurrency=2,
+                                       pipeline_depth=2))
+            p99 = lambda r: r["latency_ms"]["p99"]  # noqa: E731
+            finish(h_r, 1000, min(reps_r, key=p99))
+            finish(h_t, 1000, min(reps_t, key=p99))
+        finally:
+            release(h_r)
+            release(h_t)
+        # the ladder proper: reactor only, one rung at a time
+        for n in ladder[1:]:
+            h = hold(True, n)
+            try:
+                finish(h, n, live_load(h["host"], h["port"]))
+            finally:
+                release(h)
+    finally:
+        srv.stop()
+
+    top = f"reactor_{ladder[-1]}"
+    headlines = {
+        "idle_conns_held": out[top]["idle_conns_held"],
+        "mem_per_conn_kb": out[top]["mem_per_conn_kb"],
+        "resident_threads": out[top]["resident_threads"],
+        "threaded_mem_per_conn_kb": out["threaded_1000"]["mem_per_conn_kb"],
+        "threaded_resident_threads": out["threaded_1000"]["resident_threads"],
+        "live_p99_ms_reactor_1k":
+            out["reactor_1000"]["live_latency_ms"]["p99"],
+        "live_p99_ms_threaded_1k":
+            out["threaded_1000"]["live_latency_ms"]["p99"],
+        "mem_ratio_threaded_over_reactor": round(
+            out["threaded_1000"]["mem_per_conn_kb"]
+            / max(out[top]["mem_per_conn_kb"], 1e-9), 2),
+        "host_cores": cores, "nofile_ulimit": int(nofile),
+    }
+    log(f"c10k: reactor holds {headlines['idle_conns_held']} conns at "
+        f"{headlines['mem_per_conn_kb']:.1f}kB/conn on "
+        f"{headlines['resident_threads']} threads; threaded costs "
+        f"{headlines['threaded_mem_per_conn_kb']:.1f}kB/conn "
+        f"({headlines['mem_ratio_threaded_over_reactor']:.0f}x) on "
+        f"{headlines['threaded_resident_threads']} threads at 1k")
+    return out, headlines, notes
+
+
 def ha_bench(model, params, *, features: int):
     """The control-plane HA sweep: one SIGKILL drill per journaled
     singleton (the dispatcher's equivalent lives in bench_suite's
@@ -425,6 +661,9 @@ def main() -> int:
     ha_mode = "--ha" in argv
     if ha_mode:
         argv.remove("--ha")
+    c10k_mode = "--c10k" in argv
+    if c10k_mode:
+        argv.remove("--c10k")
     telemetry_prefix = os.environ.get("DMLC_TELEMETRY_OUT")
     if "--telemetry-out" in argv:
         i = argv.index("--telemetry-out")
@@ -446,12 +685,28 @@ def main() -> int:
         "bench": ("router" if router_mode
                   else "timeline" if timeline_mode
                   else "trace" if trace_mode
-                  else "ha" if ha_mode else "serving"),
+                  else "ha" if ha_mode
+                  else "c10k" if c10k_mode else "serving"),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "backend": jax.default_backend(), "model": model_name,
         "features": features, "dim": dim, "requests": requests,
         "scenarios": {},
     }
+
+    if c10k_mode:
+        scenarios, headlines, notes = c10k_bench(model, params,
+                                                 requests=requests,
+                                                 features=features)
+        report["scenarios"] = scenarios
+        report.update(headlines)
+        report["notes"] = notes
+        blob = json.dumps(report, indent=2)
+        print(blob)
+        if argv:
+            with open(argv[0], "w") as f:
+                f.write(blob + "\n")
+            log(f"wrote {argv[0]}")
+        return 0
 
     if ha_mode:
         scenarios, headlines = ha_bench(model, params, features=features)

@@ -49,7 +49,8 @@ _BENCH_RE = re.compile(r"^BENCH_(?:(?P<family>.+)_)?r(?P<round>\d+)"
                        r"(?P<partial>_partial)?\.json$")
 
 _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
-                  "goodput", "ok", "hits", "speedup", "mfu", "fill")
+                  "goodput", "ok", "hits", "speedup", "mfu", "fill",
+                  "conns_held")
 # padding_ratio (padded-nnz / true-nnz, ISSUE 6 ragged path): 1.0 is the
 # floor, every point above it is padding tax — lower is better.  The
 # ragged scenario families (ingest_ragged, *_ragged serving scenarios)
@@ -84,11 +85,19 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 #  stays < 1% — dropping must never cost more than keeping) gates
 #  higher-better via "ok" — a budget miss reads as a 100% drop, which
 #  fails the gate.
+#  The c10k family (ISSUE 19, BENCH_c10k_r*.json): the connection-fabric
+#  ladder gates idle_conns_held higher-better via "conns_held" (how many
+#  mostly-idle connections one router process holds), and
+#  mem_per_conn_kb / resident_threads lower-better — RSS per held
+#  connection and the process thread count, which the reactor keeps at
+#  O(loops + executor) instead of O(connections); the live-subset p99
+#  keys gate lower-better via "p99" as usual.
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
                  "errors", "misses", "padding_ratio", "truncated",
                  "epochs_to_converge", "bytes_per_row",
-                 "shed_pct", "rolling_restart_p99_ms", "failover")
+                 "shed_pct", "rolling_restart_p99_ms", "failover",
+                 "mem_per_conn", "resident_threads")
 
 
 def _direction(key: str) -> Optional[str]:

@@ -1,0 +1,98 @@
+"""reactor-discipline: migrated tiers stay on the connection fabric.
+
+Motivating change: the r19 reactor port.  The serving router and the
+data-service dispatcher were moved off thread-per-connection onto the
+:mod:`..transport.reactor` event loop (with the threaded path kept as a
+fallback that routes through :mod:`..transport.listener`).  The failure
+mode this rule fences: a later patch "just adds" a raw blocking
+``sock.accept()`` loop or a per-connection ``Thread(...)`` to one of
+the migrated tiers, silently reintroducing the O(connections) thread
+model the port retired — it works fine at 10 connections in a unit test
+and falls over at 10k in production.
+
+Heuristic, scoped to the migrated tiers (``serving/fleet/router.py``,
+``serving/fleet/reactor_router.py``,
+``pipeline/data_service/dispatcher.py``):
+
+* flagged: any call whose dotted name ends in ``.accept`` — accepts
+  belong to :class:`transport.listener.Listener` (threaded fallback,
+  EMFILE-hardened) or :meth:`transport.reactor.Reactor.add_listener`;
+* flagged: any ``threading.Thread(...)`` / ``Thread(...)`` whose
+  ``name`` is **not** a string constant, or that has no ``name`` at all
+  — a dynamic (f-string) or anonymous name is the per-connection-spawn
+  signature.  Per-connection work in the threaded fallback routes
+  through :func:`transport.listener.serve_connection` (which counts
+  ``transport.conn_threads``); named lifecycle threads (health poller,
+  sweeper) stay legal.
+
+The baseline is empty tree-wide and ``benchmarks/check_lint.py`` keeps
+it that way.  A genuinely scale-bounded exception carries a
+``# dmlclint: disable=reactor-discipline`` with the justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import (Finding, LintContext, LintRule, ParsedModule,
+                   call_name, lint_rule)
+
+#: the tiers ported to the reactor in r19; grow this set as tiers
+#: migrate (the rule is the migration's ratchet)
+MIGRATED_TIERS = (
+    "serving/fleet/router.py",
+    "serving/fleet/reactor_router.py",
+    "pipeline/data_service/dispatcher.py",
+)
+
+
+def _is_migrated(rel: str) -> bool:
+    norm = rel.replace("\\", "/")
+    return any(norm.endswith(t) for t in MIGRATED_TIERS)
+
+
+@lint_rule("reactor-discipline",
+           description="migrated tiers (router, dispatcher) accept via "
+                       "transport.listener/reactor and never spawn "
+                       "per-connection threads — no raw sock.accept() "
+                       "or dynamically-named Thread(...)")
+class ReactorDisciplineRule(LintRule):
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        if not _is_migrated(mod.rel):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name:
+                continue
+            if name == "accept" or name.endswith(".accept"):
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno, node.col_offset,
+                    f"{name}(...) blocks on a raw listening socket in a "
+                    f"reactor-migrated tier — accept via "
+                    f"transport.listener.Listener (threaded fallback) "
+                    f"or Reactor.add_listener, or suppress with a "
+                    f"justification"))
+            elif name in ("Thread", "threading.Thread"):
+                kw = {k.arg: k.value for k in node.keywords
+                      if k.arg is not None}
+                tname = kw.get("name")
+                if tname is None or not (isinstance(tname, ast.Constant)
+                                         and isinstance(tname.value,
+                                                        str)):
+                    out.append(Finding(
+                        self.name, mod.rel, node.lineno,
+                        node.col_offset,
+                        "Thread(...) without a constant name in a "
+                        "reactor-migrated tier looks like a "
+                        "per-connection spawn — route it through "
+                        "transport.listener.serve_connection (counted "
+                        "on transport.conn_threads), give a lifecycle "
+                        "thread a constant name, or suppress with a "
+                        "justification"))
+        return out

@@ -65,6 +65,8 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ...parallel.tracker import LivenessBoard, recv_json, send_json
+from ...transport.listener import Listener, serve_connection
+from ...transport.reactor import Reactor, reactor_opt_in
 from ...telemetry import flight as flight_mod
 from ...telemetry import sampling as sampling_mod
 from ...telemetry import trace as teltrace
@@ -178,7 +180,8 @@ class Dispatcher:
                  heartbeat_timeout_s: Optional[float] = None,
                  telemetry_port: Optional[int] = None,
                  journal: Optional[str] = None,
-                 sharing: Optional[str] = None):
+                 sharing: Optional[str] = None,
+                 reactor: Optional[bool] = None):
         if lease_ttl_s is None:
             lease_ttl_s = get_env("DMLC_LEASE_TTL", 30.0)
         if heartbeat_timeout_s is None:
@@ -221,11 +224,11 @@ class Dispatcher:
         self.straggler_board = StragglerBoard()
         self._stop_ev = threading.Event()
         self._threads: List[threading.Thread] = []
-        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(64)
-        self.host, self.port = self._srv.getsockname()[:2]
+        self._reactor_mode = reactor_opt_in(reactor)
+        self._reactor: Optional[Reactor] = None
+        self._listener = Listener(host, port, backlog=64)
+        self._srv = self._listener.sock     # compat alias
+        self.host, self.port = self._listener.host, self._listener.port
         if telemetry_port is None:
             p = get_env("DMLC_DISPATCHER_METRICS_PORT", -1)
             telemetry_port = p if p >= 0 else None
@@ -262,11 +265,22 @@ class Dispatcher:
         # same DMLC_TRACE_SAMPLE config as workers and consumers — the
         # consistent hash floor needs no coordination beyond the env
         sampling_mod.maybe_install_from_env()
-        for target, name in ((self._accept_loop, "dispatcher-accept"),
-                             (self._sweep_loop, "dispatcher-sweep")):
-            t = threading.Thread(target=target, name=name, daemon=True)
-            t.start()
-            self._threads.append(t)
+        if self._reactor_mode:
+            # RPC plane on one event loop: JSON-line requests reassemble
+            # in per-connection buffers; lease math + journal fsyncs hop
+            # to the bounded executor so a slow disk never blocks accept
+            self._reactor = Reactor("dispatcher-reactor")
+            self._reactor.add_listener(self._listener.sock,
+                                       self._on_rpc_conn)
+            self._reactor.start()
+        else:
+            self._threads.append(self._listener.spawn(
+                self._on_conn, name="dispatcher-accept",
+                stopping=self._stop_ev.is_set))
+        t = threading.Thread(target=self._sweep_loop,
+                             name="dispatcher-sweep", daemon=True)
+        t.start()
+        self._threads.append(t)
         if self.telemetry is not None:
             self.telemetry.start()
             self.history.start()
@@ -294,16 +308,11 @@ class Dispatcher:
         self.history.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
-        # shutdown() before close(): close() alone does not wake a thread
-        # blocked inside accept() (see PredictionServer.stop)
-        try:
-            self._srv.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        # shutdown() before close() inside Listener.close(): close()
+        # alone does not wake a thread blocked inside accept()
+        self._listener.close()
+        if self._reactor is not None:
+            self._reactor.stop()
         for t in self._threads:
             t.join(timeout=5.0)
 
@@ -604,14 +613,23 @@ class Dispatcher:
                             self._regrant(ds.key, ls, "ttl expired")
 
     # -- request handling -----------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._stop_ev.is_set():
-            try:
-                conn, _addr = self._srv.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+    def _on_conn(self, conn: socket.socket, _addr) -> None:
+        serve_connection(self._handle, conn, name="dispatcher-rpc")
+
+    def _handle_msg(self, msg: dict) -> dict:
+        """One parsed RPC → one reply dict: trace re-entry + the command
+        table.  Transport-free, so the threaded handler and the reactor
+        executor share it verbatim."""
+        ctx = teltrace.from_wire(msg.get("trace_id"),
+                                 msg.get("parent_span"))
+        if ctx is not None:
+            # traced caller: handle under a span parented to it, so
+            # the grant/complete shows up inside the consumer's trace
+            with teltrace.activate(ctx), \
+                    teltrace.span("data_service.dispatcher.rpc",
+                                  cmd=msg.get("cmd")):
+                return self._dispatch(msg)
+        return self._dispatch(msg)
 
     def _handle(self, conn: socket.socket) -> None:
         try:
@@ -619,18 +637,7 @@ class Dispatcher:
             msg = recv_json(conn.makefile("r"))
             if msg is None:
                 return
-            ctx = teltrace.from_wire(msg.get("trace_id"),
-                                     msg.get("parent_span"))
-            if ctx is not None:
-                # traced caller: handle under a span parented to it, so
-                # the grant/complete shows up inside the consumer's trace
-                with teltrace.activate(ctx), \
-                        teltrace.span("data_service.dispatcher.rpc",
-                                      cmd=msg.get("cmd")):
-                    reply = self._dispatch(msg)
-            else:
-                reply = self._dispatch(msg)
-            send_json(conn, reply)
+            send_json(conn, self._handle_msg(msg))
         except (OSError, ValueError, KeyError, TypeError) as e:
             logger.warning("dispatcher connection error: %s", e)
             try:
@@ -642,6 +649,48 @@ class Dispatcher:
                 conn.close()
             except OSError:
                 pass
+
+    # -- reactor RPC plane (loop thread unless noted) --------------------
+    def _on_rpc_conn(self, sock: socket.socket, _addr) -> None:
+        # same one-request-per-connection contract as the threaded path;
+        # idle_s mirrors the threaded settimeout(30) read deadline
+        conn = self._reactor.add_connection(sock, self._on_rpc_data,
+                                            idle_s=30.0)
+        conn.data = bytearray()         # JSON-line reassembly buffer
+
+    def _on_rpc_data(self, conn, view) -> None:
+        buf: bytearray = conn.data
+        if buf is None:                 # request already in flight
+            return
+        buf += view
+        nl = buf.find(b"\n")
+        if nl < 0:
+            if len(buf) > (1 << 22):    # 4 MB with no newline: not ours
+                conn.kill(ValueError("oversized RPC line"))
+            return
+        line = bytes(buf[:nl])
+        conn.data = None                # one request per connection
+        conn.idle_s = 0.0               # read deadline met; the command
+        #                                 may legitimately run long
+        try:
+            msg = json.loads(line)
+        except ValueError as e:
+            conn.write((json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}) + "\n").encode())
+            conn.close_after_flush()
+            return
+        # the command body (lease math, journal fsync) runs on the
+        # executor; the loop keeps accepting and parsing meanwhile
+        self._reactor.executor.submit(
+            lambda: self._handle_msg(msg),
+            lambda reply, exc: self._rpc_done(conn, reply, exc))
+
+    def _rpc_done(self, conn, reply, exc) -> None:
+        if exc is not None:
+            logger.warning("dispatcher connection error: %s", exc)
+            reply = {"error": f"{type(exc).__name__}: {exc}"}
+        conn.write((json.dumps(reply) + "\n").encode())
+        conn.close_after_flush()
 
     def _dispatch(self, msg: dict) -> dict:
         cmd = msg.get("cmd")

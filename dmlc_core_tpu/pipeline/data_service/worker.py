@@ -39,6 +39,7 @@ from ...telemetry import trace as teltrace
 from ...telemetry.wide_events import wide_event
 from ...transport import frames as _wire
 from ...transport import lane as _lane
+from ...transport.listener import accept_loop, serve_connection
 from ...utils.faults import FaultInjected, fault_point
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
@@ -238,17 +239,16 @@ class DataServiceWorker:
         self._accept_on(self._uds_srv, uds=True)
 
     def _accept_on(self, srv: socket.socket, *, uds: bool) -> None:
-        while not self._stop_ev.is_set():
-            try:
-                conn, addr = srv.accept()
-            except OSError:
-                return
-            if not uds:
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        def on_conn(conn: socket.socket, addr) -> None:
             with self._conn_lock:
                 self._conns.append(conn)
-            threading.Thread(target=self._serve_conn,
-                             args=(conn, addr, uds), daemon=True).start()
+            serve_connection(self._serve_conn, conn, addr, uds,
+                             name="ds-worker-conn")
+
+        # accept_loop retries (jittered) on fd exhaustion instead of
+        # letting EMFILE masquerade as the shutdown OSError
+        accept_loop(srv, on_conn, stopping=self._stop_ev.is_set,
+                    tcp_nodelay=not uds)
 
     def _serve_conn(self, conn: socket.socket, addr,
                     uds: bool = False) -> None:

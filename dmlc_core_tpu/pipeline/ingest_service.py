@@ -48,6 +48,7 @@ from ..utils.parameter import env_int, get_env
 from ..utils.retry import RetryPolicy
 from ..transport import frames as _wire
 from ..transport.lane import recv_exact_into as _wire_recv
+from ..transport.listener import Listener, accept_once
 from .device_loader import _BufPool, _fused_words_meta, _put_fused_buf
 
 __all__ = ["serve_ingest", "stream_epoch_frames", "RemoteIngestLoader",
@@ -168,14 +169,12 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
     from . import autotune as autotune_mod
     from . import fingerprint as fingerprint_mod
 
-    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind((host, port))
-    srv.listen(4)
+    listener = Listener(host, port, backlog=4)
+    srv = listener.sock
     if ready_event is not None:
         ready_event.set()
     log_info("ingest worker: part %d/%d of %s on :%d", part, nparts, uri,
-             srv.getsockname()[1])
+             listener.port)
     served = 0
     try:
         cores = len(os.sched_getaffinity(0))
@@ -197,14 +196,18 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
     stall = StallDetector("ingest.frame")
     try:
         while not max_epochs or served < max_epochs:
-            conn, addr = srv.accept()
+            # accept_once retries (jittered, counted) on fd exhaustion
+            # instead of crashing the partition server; None = closed
+            got = accept_once(srv)
+            if got is None:
+                break
+            conn, addr = got            # TCP_NODELAY already set
             loader = None
             epoch_ok = False
             cfg = tuner.begin_epoch() if tuner is not None else {}
             sent_bytes = 0
             t_epoch = time.monotonic()
             try:
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 from .device_loader import DeviceLoader
                 # core-aware parser config (the root bench's rule): a
                 # serial worker host skips the extra parse thread, which
@@ -262,7 +265,7 @@ def serve_ingest(uri: str, part: int, nparts: int, fmt: str,
                         tuner.abort_epoch()
             served += 1
     finally:
-        srv.close()
+        listener.close()
 
 
 class RemoteIngestLoader:

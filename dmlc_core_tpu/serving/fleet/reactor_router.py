@@ -1,0 +1,370 @@
+"""Reactor-backed connection fabric for :class:`ServingRouter`.
+
+The threaded router burns one thread per client connection plus one per
+backend link — ~8 MB of stack each, so 10k mostly-idle predict
+connections cost ~80 GB of address space and a scheduler meltdown long
+before any socket limit.  This fabric re-plumbs *transport only*:
+
+* **Upstream** predict connections become reactor-managed state
+  machines (:class:`~...transport.reactor.FrameAssembler` over the
+  unchanged ``REQ_HEADER`` wire layout) spread across
+  ``DMLC_REACTOR_LOOPS`` loops (``SO_REUSEPORT``-sharded listeners).
+* **Downstream** replica links are pooled — one connection per replica,
+  multiplexed by backend req_id, all owned by the primary loop — so a
+  hedge or failover is a queue move, not a new thread.
+* **Policy stays in router.py**: replica selection (``_pick``), the
+  retry budget and hedge/failover bookkeeping (``_hedge_target``),
+  response finishing, spans and wide events are the same code the
+  threaded path runs; :meth:`ServingRouter._dispatch_any` routes only
+  the transport step here.  Byte layout on both legs is identical, so
+  ``PredictClient`` and the replicas can't tell the fabrics apart.
+
+Threading contract: all fabric state (``_RBackend`` maps, queues) is
+touched only on the **primary** loop; frontend loops and the health /
+sync threads funnel dispatch through ``call_soon``.  Replies travel
+back via :meth:`Connection.write`, which is safe from any thread.
+Blocking backend connects run on the reactor's bounded executor (one
+connect per replica link, not per request).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, List, Optional
+
+from ...telemetry import trace as teltrace
+from ...transport.listener import Listener
+from ...transport.reactor import (Connection, FrameAssembler, Reactor,
+                                  ReactorGroup, reactor_loops)
+from ...utils.logging import get_logger, log_info
+from ...utils.metrics import metrics
+from ...utils.parameter import get_env
+from ...utils.retry import CircuitOpen
+from ..server import (HELLO_REQ_ID, REQ_HEADER, RSP_HEADER, STATUS_OK,
+                      _MAX_NNZ, _MAX_ROWS, pack_hello)
+
+__all__ = ["RouterFabric"]
+
+logger = get_logger()
+
+STATUS_BAD_REQUEST = 5          # mirror of server.STATUS_BAD_REQUEST
+
+
+class _RClient:
+    """Reactor-side client connection — duck-typed to ``_ClientConn``
+    (``respond``/``model_id``/``alive``), so ``_Pending`` and the
+    response/wide-event path in router.py need no mode branches."""
+
+    __slots__ = ("cid", "conn", "model_id", "alive")
+
+    def __init__(self, cid: int, conn: Connection):
+        self.cid = cid
+        self.conn = conn
+        self.model_id = "default"
+        self.alive = True
+
+    def respond(self, req_id: int, status: int, payload: bytes) -> None:
+        n = len(payload) // 4 if status == STATUS_OK else len(payload)
+        self.conn.write(RSP_HEADER.pack(req_id, status, n) + payload)
+
+
+class _RBackend:
+    """One pooled replica link: ``idle`` (no socket) → ``connecting``
+    (executor dial in flight, frames queue) → ``up`` (hello sent,
+    queue flushed).  Primary-loop state only."""
+
+    __slots__ = ("rep", "state", "conn", "queue")
+
+    def __init__(self, rep):
+        self.rep = rep
+        self.state = "idle"
+        self.conn: Optional[Connection] = None
+        self.queue: List[bytes] = []    # frames awaiting connect
+
+
+class RouterFabric:
+    """Owns the reactor group and both protocol legs for one router."""
+
+    def __init__(self, router, listeners: List[Listener]):
+        self._r = router
+        self._listeners = listeners
+        n = reactor_loops()
+        self.group = ReactorGroup(
+            n, "router-reactor",
+            executor_workers=int(get_env("DMLC_REACTOR_EXECUTOR", 2)),
+            idle_s=float(get_env("DMLC_REACTOR_IDLE_S", 0.0)))
+        self.primary: Reactor = self.group.primary
+        self._backends: Dict[str, _RBackend] = {}   # primary loop only
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "RouterFabric":
+        if len(self._listeners) != len(self.group.loops):
+            # loops were env-resolved after bind (single listener): every
+            # loop still works, but only loop 0 accepts
+            loops = self.group.loops[:len(self._listeners)] or \
+                [self.primary]
+        else:
+            loops = self.group.loops
+        for r, lst in zip(loops, self._listeners):
+            r.add_listener(
+                lst.sock,
+                lambda sock, addr, _r=r: self._on_client(_r, sock))
+        self.group.start()
+        log_info("router fabric: %d loop(s), %d listener(s)",
+                 len(self.group), len(self._listeners))
+        return self
+
+    def stop(self) -> None:
+        for lst in self._listeners:
+            lst.close()
+        self.group.stop()
+
+    # -- frontend (any loop) ---------------------------------------------
+    def _on_client(self, reactor: Reactor, sock: socket.socket) -> None:
+        with self._r._conn_lock:
+            cid = self._r._next_conn
+            self._r._next_conn += 1
+        asm = FrameAssembler(REQ_HEADER.size, self._front_header,
+                             self._front_frame)
+        conn = reactor.add_connection(
+            sock, lambda c, view: asm.feed(c, view),
+            on_close=self._front_closed)
+        conn.data = _RClient(cid, conn)
+
+    def _front_closed(self, conn: Connection,
+                      exc: Optional[BaseException]) -> None:
+        rc: _RClient = conn.data
+        if rc is not None:
+            rc.alive = False
+
+    def _front_header(self, conn: Connection,
+                      header: bytes) -> Optional[int]:
+        req_id, trace_id, parent_span, rows, nnz = REQ_HEADER.unpack(
+            header)
+        if req_id == HELLO_REQ_ID:
+            return nnz
+        if rows == 0 or rows > _MAX_ROWS or nnz > _MAX_NNZ:
+            rc: _RClient = conn.data
+            rc.respond(req_id, STATUS_BAD_REQUEST,
+                       f"bad header rows={rows} nnz={nnz}".encode())
+            conn.close_after_flush()
+            return None
+        return 4 * (rows + 1) + 8 * nnz
+
+    def _front_frame(self, conn: Connection, header: bytes,
+                     payload: bytes) -> None:
+        rc: _RClient = conn.data
+        req_id, trace_id, parent_span, rows, nnz = REQ_HEADER.unpack(
+            header)
+        if req_id == HELLO_REQ_ID:
+            rc.model_id = payload.decode("utf-8", "replace") or "default"
+            return
+        r = self._r
+        r._m_requests.add(1)
+        span = None
+        if trace_id:
+            span = teltrace.start_span(
+                "serving.router.request",
+                parent=teltrace.TraceContext(trace_id, parent_span),
+                req_id=req_id, rows=rows, model=rc.model_id)
+        with r._plock:
+            bid = r._next_bid
+            r._next_bid += 1
+        pend = r._make_pending(bid, rc, req_id, trace_id, parent_span,
+                               rows, nnz, payload, span)
+        if span is not None:
+            pend.trace_id = span.context.trace_id
+            pend.parent_span = span.context.span_id
+        with r._plock:
+            r._pending[bid] = pend
+            r._m_inflight.set(len(r._pending))
+        target = r._pick(rc.model_id, pend.tried)
+        if target is None:
+            with r._plock:
+                r._pending.pop(bid, None)
+            r._respond_shed(pend, f"no replica available for model "
+                                  f"{rc.model_id!r}")
+            return
+        self.dispatch(pend, target)
+
+    # -- dispatch (funnelled to the primary loop) ------------------------
+    def dispatch(self, pend, rep) -> bool:
+        """Transport step for one (pend, replica) decision.  Always
+        True: queued-while-connecting counts as dispatched, and the
+        loop-side walk owns the shed on ultimate failure."""
+        if self.primary.in_loop():
+            self._dispatch_on_loop(pend, rep)
+        else:
+            self.primary.call_soon(self._dispatch_on_loop, pend, rep)
+        return True
+
+    def _dispatch_on_loop(self, pend, rep) -> None:
+        """Mirror of the threaded ``_dispatch`` candidate walk, with the
+        blocking send replaced by a queue move on the pooled link."""
+        r = self._r
+        while True:
+            pend.attempts += 1
+            pend.tried.add(rep.key)
+            pend.replica_key = rep.key
+            try:
+                rep.breaker.allow()
+            except CircuitOpen:
+                nxt = None
+                if pend.attempts < r._retry.max_attempts:
+                    nxt = r._pick(pend.client.model_id, pend.tried)
+                if nxt is None:
+                    with r._plock:
+                        r._pending.pop(pend.bid, None)
+                    r._respond_shed(pend, f"no replica available for "
+                                          f"model "
+                                          f"{pend.client.model_id!r}")
+                    return
+                r._m_retries.add(1)
+                pend.failovers += 1
+                if pend.span is not None:
+                    pend.span.event("failover", frm=rep.key, to=nxt.key,
+                                    reason="CircuitOpen")
+                rep = nxt
+                continue
+            with rep.lock:
+                rep.outstanding.add(pend.bid)
+                rep.inflight += 1
+            frame = REQ_HEADER.pack(pend.bid, pend.trace_id,
+                                    pend.parent_span, pend.rows,
+                                    pend.nnz) + pend.tail
+            be = self._backends.get(rep.key)
+            if be is None or be.rep is not rep:
+                be = _RBackend(rep)
+                self._backends[rep.key] = be
+            if be.state == "up":
+                be.conn.write(frame)
+            else:
+                be.queue.append(frame)
+                if be.state == "idle":
+                    self._start_connect(be)
+            return
+
+    # -- backend link (primary loop) -------------------------------------
+    def _start_connect(self, be: _RBackend) -> None:
+        be.state = "connecting"
+        rep = be.rep
+
+        def dial() -> socket.socket:
+            sock = socket.create_connection((rep.host, rep.port),
+                                            timeout=5.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+
+        def on_done(sock, exc) -> None:
+            if exc is not None:
+                self._connect_failed(be, exc)
+            else:
+                self._connected(be, sock)
+
+        self.primary.executor.submit(dial, on_done)
+
+    def _connected(self, be: _RBackend, sock: socket.socket) -> None:
+        rep = be.rep
+        if self._backends.get(rep.key) is not be or self._r._stopping:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        asm = FrameAssembler(
+            RSP_HEADER.size,
+            lambda conn, head: self._back_header(be, conn, head),
+            lambda conn, head, payload: self._back_frame(be, head,
+                                                         payload))
+        conn = self.primary.add_connection(
+            sock, lambda c, view: asm.feed(c, view),
+            on_close=lambda c, exc: self._backend_lost(be, exc),
+            idle_s=0.0)             # pooled links never idle out
+        be.conn = conn
+        be.state = "up"
+        rep.fabric_connected = True
+        # model hello first, then everything queued while connecting —
+        # same first-frame discipline as the threaded _ensure_backend
+        conn.write(pack_hello(rep.model_id))
+        queued, be.queue = be.queue, []
+        for frame in queued:
+            conn.write(frame)
+
+    def _connect_failed(self, be: _RBackend,
+                        exc: BaseException) -> None:
+        rep = be.rep
+        if self._backends.get(rep.key) is be:
+            self._backends.pop(rep.key, None)
+        be.state = "idle"
+        rep.breaker.record_failure()
+        be.queue.clear()
+        self._refan(rep, exc)
+
+    def _back_header(self, be: _RBackend, conn: Connection,
+                     head: bytes) -> Optional[int]:
+        bid, status, n = RSP_HEADER.unpack(head)
+        return 4 * n if status == STATUS_OK else n
+
+    def _back_frame(self, be: _RBackend, head: bytes,
+                    payload: bytes) -> None:
+        bid, status, n = RSP_HEADER.unpack(head)
+        if bid == HELLO_REQ_ID:
+            logger.warning("router fabric: replica %s refused model "
+                           "hello: %s", be.rep.key,
+                           payload.decode("utf-8", "replace"))
+            if be.conn is not None:
+                be.conn.kill()
+            return
+        # policy unchanged: hedge-on-shed, breaker bookkeeping, span
+        # end, wide event — router.py owns all of it
+        self._r._on_backend_response(be.rep, bid, status, payload)
+
+    def _backend_lost(self, be: _RBackend,
+                      exc: Optional[BaseException]) -> None:
+        rep = be.rep
+        if self._backends.get(rep.key) is be:
+            self._backends.pop(rep.key, None)
+        be.state = "idle"
+        be.conn = None
+        be.queue.clear()
+        rep.fabric_connected = False
+        if self._r._stopping:
+            with rep.lock:
+                rep.outstanding.clear()
+                rep.inflight = 0
+            return
+        rep.breaker.record_failure()
+        self._refan(rep, exc or ConnectionError("replica link closed"))
+
+    def _refan(self, rep, exc: BaseException) -> None:
+        """Mirror of the threaded ``_on_backend_lost`` orphan path."""
+        r = self._r
+        with rep.lock:
+            orphans = list(rep.outstanding)
+            rep.outstanding.clear()
+            rep.inflight = 0
+        if not orphans:
+            return
+        logger.warning("router: lost replica %s (%s) — refanning %d "
+                       "in-flight request(s)", rep.key, exc,
+                       len(orphans))
+        for bid in orphans:
+            with r._plock:
+                pend = r._pending.get(bid)
+            if pend is None:
+                continue
+            metrics.counter("serving.router.failovers").add(1)
+            if not r._try_failover(pend, rep, reason="conn_lost",
+                                   already_released=True):
+                with r._plock:
+                    r._pending.pop(bid, None)
+                r._respond_shed(pend, f"replica {rep.key} lost: {exc}")
+
+    def drop_backend(self, rep) -> None:
+        """Registry said the replica left: close its pooled link (loop-
+        side; safe from the sync thread)."""
+        def do() -> None:
+            be = self._backends.get(rep.key)
+            if be is not None and be.rep is rep and be.conn is not None:
+                be.conn.kill()
+        self.primary.call_soon(do)

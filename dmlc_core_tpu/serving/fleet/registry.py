@@ -70,6 +70,7 @@ from ...telemetry.anomaly import StragglerBoard
 from ...telemetry.exposition import TelemetryServer
 from ...telemetry.timeseries import HistoryStore
 from ...transport.endpoints import EndpointSet, EndpointsLike
+from ...transport.listener import Listener, serve_connection
 from ...utils.durable import FencedLease, StateJournal
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
@@ -273,11 +274,9 @@ class ReplicaRegistry:
         self._stop_ev = threading.Event()
         self._threads: List[threading.Thread] = []
         self._m_replicas = metrics.gauge("fleet.registry.replicas")
-        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(64)
-        self.host, self.port = self._srv.getsockname()[:2]
+        self._listener = Listener(host, port, backlog=64)
+        self._srv = self._listener.sock     # compat alias
+        self.host, self.port = self._listener.host, self._listener.port
         # -- durable control plane (r17) --------------------------------
         if journal is None:
             journal = get_env("DMLC_REGISTRY_JOURNAL", "") or None
@@ -451,11 +450,13 @@ class ReplicaRegistry:
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ReplicaRegistry":
         sweep = self._standby_loop if self.standby else self._sweep_loop
-        for target, name in ((self._accept_loop, "fleet-registry-accept"),
-                             (sweep, "fleet-registry-sweep")):
-            t = threading.Thread(target=target, name=name, daemon=True)
-            t.start()
-            self._threads.append(t)
+        self._threads.append(self._listener.spawn(
+            self._on_conn, name="fleet-registry-accept",
+            stopping=self._stop_ev.is_set))
+        t = threading.Thread(target=sweep, name="fleet-registry-sweep",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
         self.rollouts.start()
         if self.telemetry is not None:
             self.telemetry.start()
@@ -475,16 +476,9 @@ class ReplicaRegistry:
         self.rollouts.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
-        # shutdown() before close(): close() alone does not wake a thread
-        # blocked inside accept() (see PredictionServer.stop)
-        try:
-            self._srv.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        # Listener.close() is shutdown()-before-close(): close() alone
+        # does not wake a thread blocked inside accept()
+        self._listener.close()
         for t in self._threads:
             t.join(timeout=5.0)
         if self._journal is not None:
@@ -625,15 +619,8 @@ class ReplicaRegistry:
                 self._compact()
 
     # -- request handling ------------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._stop_ev.is_set():
-            try:
-                conn, _addr = self._srv.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._handle, args=(conn,),
-                             name="fleet-registry-rpc",
-                             daemon=True).start()
+    def _on_conn(self, conn: socket.socket, _addr) -> None:
+        serve_connection(self._handle, conn, name="fleet-registry-rpc")
 
     def _handle(self, conn: socket.socket) -> None:
         try:

@@ -72,6 +72,9 @@ from ...telemetry import trace as teltrace
 from ...telemetry.wide_events import wide_event
 from ...transport.endpoints import EndpointSet, EndpointsLike
 from ...transport.frames import send_all
+from ...transport.listener import Listener, reuseport_group, \
+    serve_connection
+from ...transport.reactor import reactor_loops, reactor_opt_in
 from ...telemetry.exposition import TelemetryServer
 from ...utils.logging import DMLCError, get_logger, log_info
 from ...utils.metrics import metrics
@@ -173,6 +176,7 @@ class _Replica:
         self.lock = threading.Lock()
         self.wlock = threading.Lock()
         self.sock: Optional[socket.socket] = None
+        self.fabric_connected = False   # reactor-mode pooled-link flag
         self.outstanding: set = set()   # backend ids, under self.lock
 
     def load_score(self) -> float:
@@ -200,7 +204,8 @@ class ServingRouter:
                  telemetry_port: Optional[int] = None,
                  health_poll_s: Optional[float] = None,
                  sync_s: Optional[float] = None,
-                 backlog: int = 64):
+                 backlog: int = 64,
+                 reactor: Optional[bool] = None):
         if registry is None:
             registry = get_env("DMLC_ROUTER_REGISTRY", "") or None
         if registry is None and not replicas:
@@ -247,11 +252,20 @@ class ServingRouter:
         # same tail-sampling config as the replicas behind us: the hash
         # floor is consistent on trace_id, so verdicts agree tier-to-tier
         telsampling.maybe_install_from_env()
-        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(backlog)
-        self.host, self.port = self._srv.getsockname()[:2]
+        # the fabric switch must resolve *before* bind: N reactor loops
+        # need N SO_REUSEPORT siblings, and that option only works when
+        # set pre-bind
+        self._reactor_mode = reactor_opt_in(reactor)
+        n_loops = reactor_loops() if self._reactor_mode else 1
+        if self._reactor_mode and n_loops > 1:
+            self._listeners = reuseport_group(host, port, n_loops,
+                                              backlog=backlog)
+        else:
+            self._listeners = [Listener(host, port, backlog=backlog)]
+        self._srv = self._listeners[0].sock     # compat alias
+        self.host, self.port = (self._listeners[0].host,
+                                self._listeners[0].port)
+        self._fabric = None     # RouterFabric once start()ed (reactor mode)
         if telemetry_port is None:
             p = get_env("DMLC_ROUTER_METRICS_PORT", -1)
             telemetry_port = p if p >= 0 else None
@@ -266,19 +280,30 @@ class ServingRouter:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ServingRouter":
-        loops = [(self._accept_loop, "router-accept"),
-                 (self._health_loop, "router-health")]
         if self.registry_addr is not None:
             self.sync_replicas()           # first sync before serving
-            loops.append((self._sync_loop, "router-sync"))
-        for target, name in loops:
-            t = threading.Thread(target=target, name=name, daemon=True)
+        if self._reactor_mode:
+            from .reactor_router import RouterFabric
+            self._fabric = RouterFabric(self, self._listeners)
+            self._fabric.start()
+        else:
+            self._threads.append(self._listeners[0].spawn(
+                self._on_client_conn, name="router-accept",
+                stopping=lambda: self._stopping))
+        t = threading.Thread(target=self._health_loop,
+                             name="router-health", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.registry_addr is not None:
+            t = threading.Thread(target=self._sync_loop,
+                                 name="router-sync", daemon=True)
             t.start()
             self._threads.append(t)
         if self.telemetry is not None:
             self.telemetry.start()
-        log_info("serving router on %s:%d over %d replica(s)",
-                 self.host, self.port, len(self._replicas))
+        log_info("serving router on %s:%d over %d replica(s)%s",
+                 self.host, self.port, len(self._replicas),
+                 " [reactor]" if self._reactor_mode else "")
         return self
 
     def stop(self) -> None:
@@ -286,14 +311,10 @@ class ServingRouter:
         self._stop_ev.set()
         if self.telemetry is not None:
             self.telemetry.stop()
-        try:
-            self._srv.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        for lst in self._listeners:
+            lst.close()
+        if self._fabric is not None:
+            self._fabric.stop()     # closes client + pooled replica conns
         with self._conn_lock:
             conns = list(self._conns.values())
             self._conns.clear()
@@ -430,13 +451,13 @@ class ServingRouter:
         # BAD_REQUEST and drops the link, which surfaces as a failover
         with rep.wlock:
             send_all(sock, pack_hello(rep.model_id))
-        threading.Thread(target=self._backend_read_loop,
-                         args=(rep, sock),
-                         name=f"router-backend-{rep.key}",
-                         daemon=True).start()
+        serve_connection(self._backend_read_loop, rep, sock,
+                         name=f"router-backend-{rep.key}")
         return sock
 
     def _kill_backend(self, rep: _Replica) -> None:
+        if self._fabric is not None:
+            self._fabric.drop_backend(rep)
         with rep.lock:
             sock, rep.sock = rep.sock, None
         if sock is not None:
@@ -556,19 +577,21 @@ class ServingRouter:
                    trace_id=(teltrace.format_id(pend.trace_id)
                              if pend.trace_id else None))
 
-    def _try_failover(self, pend: _Pending, failed: _Replica, *,
+    def _hedge_target(self, pend: _Pending, failed: _Replica, *,
                       reason: Optional[str],
-                      already_released: bool = False) -> bool:
-        """Resubmit ``pend`` to a different replica if the budget and
-        the candidate set allow; True when the request found a new home
-        (or was re-queued), False when the caller must answer."""
+                      already_released: bool = False
+                      ) -> Optional[_Replica]:
+        """Budget check + replacement pick + hedge/failover bookkeeping
+        — the transport-free half of a resubmit, shared by the threaded
+        and reactor dispatch paths.  ``None`` means the caller answers
+        the client itself."""
         if not already_released:
             self._release(failed, pend.bid)
         if pend.attempts >= self._retry.max_attempts:
-            return False
+            return None
         target = self._pick(pend.client.model_id, pend.tried)
         if target is None:
-            return False
+            return None
         self._m_retries.add(1)
         # name the two resubmit flavours apart: a status-triggered
         # resubmit (OVERLOADED/SHUTDOWN — the replica did no work) is a
@@ -584,7 +607,34 @@ class ServingRouter:
         if pend.span is not None:
             pend.span.event(kind, frm=failed.key, to=target.key,
                             reason=reason)
-        return self._dispatch(pend, target)
+        return target
+
+    def _try_failover(self, pend: _Pending, failed: _Replica, *,
+                      reason: Optional[str],
+                      already_released: bool = False) -> bool:
+        """Resubmit ``pend`` to a different replica if the budget and
+        the candidate set allow; True when the request found a new home
+        (or was re-queued), False when the caller must answer."""
+        target = self._hedge_target(pend, failed, reason=reason,
+                                    already_released=already_released)
+        if target is None:
+            return False
+        return self._dispatch_any(pend, target)
+
+    def _dispatch_any(self, pend: _Pending, rep: _Replica) -> bool:
+        """Route the transport step to whichever fabric is live."""
+        if self._fabric is not None:
+            return self._fabric.dispatch(pend, rep)
+        return self._dispatch(pend, rep)
+
+    def _make_pending(self, bid: int, client, client_req_id: int,
+                      trace_id: int, parent_span: int, rows: int,
+                      nnz: int, tail: bytes, span) -> _Pending:
+        """Factory for the reactor fabric (``_Pending`` is module-
+        private; the duck-typed ``client`` just needs ``respond``/
+        ``model_id``/``alive``)."""
+        return _Pending(bid, client, client_req_id, trace_id,
+                        parent_span, rows, nnz, tail, span)
 
     def _dispatch(self, pend: _Pending, rep: _Replica) -> bool:
         """Send ``pend`` to ``rep``; on transport failure walk the
@@ -623,28 +673,16 @@ class ServingRouter:
                                     reason=type(e).__name__)
                 rep = nxt
 
-    # -- frontend --------------------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._stopping:
-            try:
-                sock, _addr = self._srv.accept()
-            except OSError:
-                return
-            if self._stopping:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                return
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._conn_lock:
-                cid = self._next_conn
-                self._next_conn += 1
-                conn = _ClientConn(cid, sock)
-                self._conns[cid] = conn
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             name=f"router-conn-{cid}",
-                             daemon=True).start()
+    # -- frontend (threaded fallback; reactor mode lives in
+    # reactor_router.RouterFabric) ---------------------------------------
+    def _on_client_conn(self, sock: socket.socket, _addr) -> None:
+        with self._conn_lock:
+            cid = self._next_conn
+            self._next_conn += 1
+            conn = _ClientConn(cid, sock)
+            self._conns[cid] = conn
+        serve_connection(self._serve_conn, conn,
+                         name=f"router-conn-{cid}")
 
     def _serve_conn(self, conn: _ClientConn) -> None:
         sock = conn.sock
@@ -751,7 +789,8 @@ class ServingRouter:
         replicas = {}
         for r in reps:
             with r.lock:
-                inflight, connected = r.inflight, r.sock is not None
+                inflight = r.inflight
+                connected = r.sock is not None or r.fabric_connected
             replicas[r.jobid] = {
                 "addr": r.key, "model_id": r.model_id, "health": r.state,
                 "alive": r.alive, "straggler": r.straggler,

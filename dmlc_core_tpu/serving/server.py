@@ -49,6 +49,7 @@ import numpy as np
 
 from ..telemetry import anomaly as telanomaly
 from ..transport.frames import send_all
+from ..transport.listener import Listener, serve_connection
 from ..telemetry import flight as telflight
 from ..telemetry import sampling as telsampling
 from ..telemetry import trace as teltrace
@@ -145,11 +146,9 @@ class PredictionServer:
         self.batcher = MicroBatcher(
             engine, max_delay_s=max_delay_s, max_queue=max_queue,
             default_deadline_s=default_deadline_s)
-        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind((host, port))
-        self._srv.listen(backlog)
-        self.host, self.port = self._srv.getsockname()[:2]
+        self._listener = Listener(host, port, backlog=backlog)
+        self._srv = self._listener.sock     # compat alias
+        self.host, self.port = self._listener.host, self._listener.port
         self._conns: Dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
         self._next_conn = 0
@@ -200,9 +199,9 @@ class PredictionServer:
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "PredictionServer":
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="serving-accept", daemon=True)
-        self._accept_thread.start()
+        self._accept_thread = self._listener.spawn(
+            self._on_conn, name="serving-accept",
+            stopping=lambda: self._stopping)
         if self.telemetry is not None:
             self.telemetry.start()
         if self.slo_monitor is not None:
@@ -225,20 +224,13 @@ class PredictionServer:
             self.slo_monitor.stop()
         if self.telemetry is not None:
             self.telemetry.stop()
-        # shutdown() before close(): the accept thread blocked inside
-        # accept() holds a kernel reference to the listening socket, so a
-        # bare close() leaves the port ACCEPTING — a reconnecting client
-        # would land on this half-dead server and get SHUTDOWN answers
-        # instead of a refused dial it can retry against the restarted
-        # replica
-        try:
-            self._srv.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        # Listener.close() is shutdown()-before-close(): the accept
+        # thread blocked inside accept() holds a kernel reference to the
+        # listening socket, so a bare close() would leave the port
+        # ACCEPTING — a reconnecting client would land on this half-dead
+        # server and get SHUTDOWN answers instead of a refused dial it
+        # can retry against the restarted replica
+        self._listener.close()
         self.batcher.close(drain=drain, timeout=timeout)
         with self._conn_lock:
             conns = list(self._conns.values())
@@ -396,27 +388,14 @@ class PredictionServer:
         self._watcher.start()
 
     # -- connection handling --------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._stopping:
-            try:
-                conn, addr = self._srv.accept()
-            except OSError:
-                return
-            if self._stopping:         # raced the listener teardown
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._conn_lock:
-                cid = self._next_conn
-                self._next_conn += 1
-                self._conns[cid] = conn
-                self._m_conns.set(len(self._conns))
-            threading.Thread(target=self._serve_conn, args=(cid, conn),
-                             name=f"serving-conn-{cid}",
-                             daemon=True).start()
+    def _on_conn(self, conn: socket.socket, _addr) -> None:
+        with self._conn_lock:
+            cid = self._next_conn
+            self._next_conn += 1
+            self._conns[cid] = conn
+            self._m_conns.set(len(self._conns))
+        serve_connection(self._serve_conn, cid, conn,
+                         name=f"serving-conn-{cid}")
 
     def _drop_conn(self, cid: int, conn: socket.socket) -> None:
         with self._conn_lock:

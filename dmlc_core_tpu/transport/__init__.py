@@ -18,9 +18,20 @@ Three capabilities live here:
 * :mod:`.endpoints` — ordered control-plane endpoint lists
   (``host:port,host:port``) with per-endpoint circuit breakers, sticky
   failover, and ``control_epoch`` fencing of stale primaries (r17).
+* :mod:`.listener` — the one copy of the bind / accept-loop / stop
+  skeleton every server used to hand-roll, with EMFILE-safe accept
+  backoff (r19).
+* :mod:`.reactor` — the event-driven connection fabric: a stdlib
+  ``selectors`` loop (optionally N ``SO_REUSEPORT``-sharded loops) with
+  per-connection frame state machines, a timer wheel for idle/read
+  deadlines, and a bounded handoff executor (r19).
 """
 
 from .endpoints import EndpointSet, parse_endpoints
+from .listener import (Listener, accept_loop, accept_once,
+                       reuseport_group, serve_connection)
+from .reactor import (Connection, FrameAssembler, Reactor, ReactorGroup,
+                      TimerWheel, reactor_loops, reactor_opt_in)
 from .frames import (CTRL_FDPASS, CTRL_TRANSPORT, FRAME, NO_ROWS,
                      FrameWriter, available_codecs, choose_codec,
                      get_codec, negotiate_reply, pack_obj, requested_codec,
@@ -37,4 +48,8 @@ __all__ = [
     "connect_lane", "fd_passing_ok", "host_token", "lane_enabled",
     "lane_path", "recv_exact_into", "send_with_fds",
     "Transfer", "plan_rounds",
+    "Listener", "accept_loop", "accept_once", "reuseport_group",
+    "serve_connection",
+    "Connection", "FrameAssembler", "Reactor", "ReactorGroup",
+    "TimerWheel", "reactor_loops", "reactor_opt_in",
 ]

@@ -1,0 +1,195 @@
+"""Shared TCP/UDS listener skeleton: bind, accept loop, shutdown wakeup.
+
+Every server in the tree used to hand-roll the same three fragments —
+the ``SO_REUSEADDR`` bind block, the accept-thread loop whose ``except
+OSError: return`` doubles as its shutdown path, and the
+``shutdown(SHUT_RDWR)``-before-``close()`` stop idiom that wakes a
+thread blocked inside ``accept()`` (a bare ``close()`` leaves the port
+half-dead and ACCEPTING).  Six copies of that boilerplate lived in
+``serving/server.py``, ``serving/fleet/registry.py``,
+``serving/fleet/router.py``, ``data_service/dispatcher.py``,
+``data_service/worker.py`` and ``pipeline/ingest_service.py`` — and
+none of them survived fd exhaustion: an ``EMFILE`` out of ``accept()``
+looked exactly like the closed-socket shutdown signal and silently
+killed the accept thread while thousands of clients kept dialing.
+
+This module is the one copy.  The accept helpers distinguish the two
+``OSError`` flavours: **fd exhaustion** (``EMFILE``/``ENFILE``/
+``ENOBUFS``/``ENOMEM``) sleeps with jitter and retries (counted on
+``transport.accept_backoffs``); anything else is the listener going
+away and ends the loop as before.  :func:`serve_connection` is the
+sanctioned per-connection thread spawn for the tiers that stay
+threaded (counted on ``transport.conn_threads`` — the resident-thread
+cost the reactor exists to retire); the ``reactor-discipline`` lint
+rule keeps raw ``accept()``/``Thread(`` out of the migrated tiers, so
+this choke point is also the audit point.
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import socket
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..utils.metrics import metrics
+
+__all__ = ["FD_EXHAUSTION_ERRNOS", "is_fd_exhaustion", "backoff_s",
+           "accept_loop", "accept_once", "serve_connection", "Listener",
+           "reuseport_group"]
+
+#: accept() errnos that mean "out of fds/buffers", not "listener closed":
+#: back off and retry instead of killing the accept loop
+FD_EXHAUSTION_ERRNOS = frozenset({
+    errno.EMFILE, errno.ENFILE, errno.ENOBUFS, errno.ENOMEM})
+
+#: base accept backoff on fd exhaustion; jittered ±50% per sleep so a
+#: fleet of exhausted listeners doesn't retry in lockstep
+_BACKOFF_BASE_S = 0.05
+
+
+def is_fd_exhaustion(exc: BaseException) -> bool:
+    return (isinstance(exc, OSError)
+            and exc.errno in FD_EXHAUSTION_ERRNOS)
+
+
+def backoff_s() -> float:
+    """One jittered accept-backoff interval."""
+    return _BACKOFF_BASE_S * (0.5 + random.random())
+
+
+def accept_once(srv: socket.socket, *,
+                stopping: Optional[Callable[[], bool]] = None,
+                tcp_nodelay: bool = True
+                ) -> Optional[Tuple[socket.socket, object]]:
+    """One blocking accept with EMFILE backoff.
+
+    Returns ``(conn, addr)``, or ``None`` when the listener was closed
+    (or ``stopping()`` turned true) — the caller's signal to exit its
+    serve loop, exactly like the old ``except OSError: return`` idiom.
+    """
+    while True:
+        if stopping is not None and stopping():
+            return None
+        try:
+            conn, addr = srv.accept()
+        except OSError as e:
+            if is_fd_exhaustion(e) and not (stopping and stopping()):
+                metrics.counter("transport.accept_backoffs").add(1)
+                time.sleep(backoff_s())
+                continue
+            return None                 # listener closed — shutdown path
+        if stopping is not None and stopping():
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return None
+        if tcp_nodelay and conn.family != getattr(socket, "AF_UNIX", -1):
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        return conn, addr
+
+
+def accept_loop(srv: socket.socket,
+                on_conn: Callable[[socket.socket, object], None], *,
+                stopping: Optional[Callable[[], bool]] = None,
+                tcp_nodelay: bool = True) -> None:
+    """The accept-thread skeleton: loop :func:`accept_once`, hand every
+    connection to ``on_conn``, return when the listener closes."""
+    while True:
+        got = accept_once(srv, stopping=stopping, tcp_nodelay=tcp_nodelay)
+        if got is None:
+            return
+        on_conn(*got)
+
+
+def serve_connection(target: Callable[..., None], *args,
+                     name: str) -> threading.Thread:
+    """Sanctioned per-connection thread spawn for the threaded tiers.
+
+    Exists as a choke point the same way ``frames.send_all`` does: the
+    ``reactor-discipline`` lint rule bans raw per-connection ``Thread(``
+    in the migrated tiers, and ``transport.conn_threads`` counts what
+    the thread-per-connection model still costs where it remains.
+    """
+    metrics.counter("transport.conn_threads").add(1)
+    t = threading.Thread(target=target, args=args, name=name, daemon=True)
+    t.start()
+    return t
+
+
+class Listener:
+    """One bound listening socket + the stop idiom.
+
+    >>> lst = Listener("127.0.0.1", 0)
+    >>> t = lst.spawn(on_conn, name="my-accept")
+    >>> ... ; lst.close()   # wakes the accept thread, loop returns
+
+    ``reuseport=True`` sets ``SO_REUSEPORT`` before bind so N sibling
+    listeners (one per reactor loop) can share the port — see
+    :func:`reuseport_group`.
+    """
+
+    def __init__(self, host: str, port: int, *, backlog: int = 64,
+                 reuseport: bool = False) -> None:
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuseport:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self.reuseport = reuseport
+        self.sock.bind((host, port))
+        self.sock.listen(backlog)
+        self.backlog = backlog
+        self.host, self.port = self.sock.getsockname()[:2]
+        self._closed = False
+
+    def accept_loop(self, on_conn, *, stopping=None,
+                    tcp_nodelay: bool = True) -> None:
+        accept_loop(self.sock, on_conn, stopping=stopping,
+                    tcp_nodelay=tcp_nodelay)
+
+    def spawn(self, on_conn, *, name: str, stopping=None,
+              tcp_nodelay: bool = True) -> threading.Thread:
+        """Start the accept loop on a named daemon thread."""
+        t = threading.Thread(
+            target=self.accept_loop, args=(on_conn,),
+            kwargs={"stopping": stopping, "tcp_nodelay": tcp_nodelay},
+            name=name, daemon=True)
+        t.start()
+        return t
+
+    def close(self) -> None:
+        """shutdown() before close(): a thread blocked inside accept()
+        holds a kernel reference to the listening socket, so a bare
+        close() leaves the port ACCEPTING — a reconnecting client would
+        land on this half-dead server instead of getting the refused
+        dial it can retry elsewhere."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def reuseport_group(host: str, port: int, n: int, *,
+                    backlog: int = 64) -> List[Listener]:
+    """N sibling listeners sharing one port via ``SO_REUSEPORT`` — the
+    kernel shards incoming connections across them, one per reactor
+    loop.  ``port=0`` resolves on the first bind; siblings join it."""
+    first = Listener(host, port, backlog=backlog, reuseport=True)
+    out = [first]
+    for _ in range(max(0, n - 1)):
+        out.append(Listener(first.host, first.port, backlog=backlog,
+                            reuseport=True))
+    return out

@@ -1,0 +1,827 @@
+"""Event-driven connection fabric: a stdlib-``selectors`` reactor.
+
+The thread-per-connection servers in this tree stop scaling orders of
+magnitude before the north star: a front-end router or a shared-job
+dispatcher must hold tens of thousands of mostly-idle connections, and
+a thread costs ~8 MB of stack plus scheduler churn *per connection*.
+This module slides a non-blocking event loop under the existing wire
+protocols without changing a byte on the wire (PR 15 already funneled
+all socket I/O through ``transport/`` choke points — that seam is what
+makes the swap invisible to clients):
+
+* :class:`Reactor` — one ``selectors`` loop on one thread: non-blocking
+  accept (EMFILE-safe: fd exhaustion unregisters the listener and
+  re-arms it after a jittered backoff instead of dying), per-connection
+  read/write interest management, a hashed :class:`TimerWheel` for
+  idle/read deadlines, and a bounded handoff executor so CPU-bound work
+  (scoring, lease math, journal fsyncs) never blocks the loop.
+* :class:`Connection` — one non-blocking socket: reads land in a
+  loop-owned scratch buffer (``recv_into``, no per-connection receive
+  buffer — memory per idle connection stays O(bytes-buffered), not
+  O(stack)); writes queue as iovecs and flush under write interest with
+  vectored ``sendmsg`` (the ``FrameWriter`` coalescing discipline,
+  expressed as readiness callbacks).
+* :class:`FrameAssembler` — incremental reassembly for the
+  length-prefixed header protocols: a preallocated header buffer per
+  connection absorbs 1-byte trickles and torn headers; payloads fill a
+  preallocated ``bytearray`` exactly once.
+* :class:`ReactorGroup` — optionally N loops, each with its own
+  ``SO_REUSEPORT`` listener (see :func:`listener.reuseport_group`), for
+  hosts with cores to spare; ``DMLC_REACTOR_LOOPS`` picks N.
+
+Observability: ``transport.reactor.{connections,loop_lag_ms,accepts,
+emfile_backoffs,executor_queue,executor_inline}`` plus a sampled
+``reactor.tick`` span — every tick that ran calls or timers, 1-in-64
+of the pure-I/O ticks, nothing for idle selects.
+Loop lag is measured honestly — a heartbeat timer's fire-time delay —
+so executor saturation spilling inline work onto the loop is visible.
+
+Threading contract: all protocol callbacks (``on_data``, ``on_close``,
+accept handlers, timer callbacks, executor ``on_done``) run on the loop
+thread.  :meth:`Connection.write`, :meth:`Connection.kill` and
+:meth:`Reactor.call_soon` are safe from any thread — off-loop calls
+hop through the wakeup pipe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import heapq
+import queue
+import random
+import selectors
+import socket
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..telemetry import trace as teltrace
+from ..utils.logging import get_logger
+from ..utils.metrics import metrics
+from ..utils.parameter import get_env
+from .listener import FD_EXHAUSTION_ERRNOS, Listener, reuseport_group
+
+__all__ = ["Reactor", "ReactorGroup", "Connection", "FrameAssembler",
+           "TimerWheel", "reactor_opt_in", "reactor_loops"]
+
+logger = get_logger()
+
+#: loop heartbeat cadence — the honesty probe behind loop_lag_ms
+_HEARTBEAT_S = 0.25
+#: timer-wheel slot width; deadlines are coarse by design (idle reaping
+#: and backoffs tolerate ±50 ms; nothing latency-critical rides timers)
+_WHEEL_GRANULARITY_S = 0.05
+#: max sockets accepted per readiness event before yielding to I/O
+_ACCEPT_BATCH = 256
+#: iovecs per sendmsg flush (IOV_MAX is >=1024 everywhere we run; 64
+#: keeps one syscall's worth of work bounded)
+_SENDMSG_IOVS = 64
+
+#: reusable no-op context for the unsampled pure-I/O ticks
+_NULL_SPAN = contextlib.nullcontext()
+
+
+def reactor_opt_in(explicit: Optional[bool] = None) -> bool:
+    """The port switch: an explicit ``reactor=`` ctor arg wins, else
+    ``DMLC_SERVE_REACTOR`` opts the process in (default: threaded)."""
+    if explicit is not None:
+        return bool(explicit)
+    return bool(get_env("DMLC_SERVE_REACTOR", False))
+
+
+def reactor_loops() -> int:
+    """``DMLC_REACTOR_LOOPS``-many loops (default 1 — a single loop
+    holds tens of thousands of mostly-idle connections; shard only when
+    accept/parse itself saturates a core)."""
+    return max(1, int(get_env("DMLC_REACTOR_LOOPS", 1)))
+
+
+class _Timer:
+    __slots__ = ("deadline", "fn", "cancelled")
+
+    def __init__(self, deadline: float, fn: Callable[[], None]):
+        self.deadline = deadline
+        self.fn = fn
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class TimerWheel:
+    """Hashed timer wheel: O(1) schedule/cancel, coarse slots.
+
+    Slots are keyed by ``int(deadline / granularity)``; a lazy heap of
+    live slot keys answers ``next_deadline`` without scanning.  Fire
+    order within a slot is insertion order — deadlines this coarse have
+    no meaningful sub-slot ordering.
+    """
+
+    def __init__(self, granularity_s: float = _WHEEL_GRANULARITY_S):
+        self._gran = float(granularity_s)
+        self._slots: Dict[int, List[_Timer]] = {}
+        self._keys: List[int] = []      # min-heap of slot keys (lazy)
+
+    def schedule(self, now: float, delay_s: float,
+                 fn: Callable[[], None]) -> _Timer:
+        t = _Timer(now + max(0.0, delay_s), fn)
+        key = int(t.deadline / self._gran)
+        slot = self._slots.get(key)
+        if slot is None:
+            self._slots[key] = [t]
+            heapq.heappush(self._keys, key)
+        else:
+            slot.append(t)
+        return t
+
+    def next_deadline(self) -> Optional[float]:
+        while self._keys:
+            key = self._keys[0]
+            slot = self._slots.get(key)
+            if not slot or all(t.cancelled for t in slot):
+                heapq.heappop(self._keys)
+                self._slots.pop(key, None)
+                continue
+            return key * self._gran
+        return None
+
+    def fire_due(self, now: float) -> Tuple[int, float]:
+        """Run every timer whose slot has fully elapsed; returns
+        ``(fired, max_lag_s)`` — lag is fire time minus deadline, the
+        loop's scheduling-delay ground truth."""
+        fired, max_lag = 0, 0.0
+        due_key = int(now / self._gran)
+        while self._keys and self._keys[0] < due_key:
+            key = heapq.heappop(self._keys)
+            for t in self._slots.pop(key, ()):
+                if t.cancelled:
+                    continue
+                fired += 1
+                max_lag = max(max_lag, now - t.deadline)
+                t.fn()
+        return fired, max_lag
+
+
+class Connection:
+    """One reactor-managed non-blocking socket.
+
+    Outbound data queues as memoryview iovecs in ``_out`` and flushes
+    with vectored ``sendmsg`` whenever the socket is writable; write
+    interest is registered only while the queue is non-empty.  Reads
+    are driven by the reactor (shared scratch buffer) and delivered to
+    ``on_data(conn, view)`` — the view is loop-owned scratch, copy what
+    you keep.  ``on_close(conn, exc)`` fires exactly once.
+    """
+
+    __slots__ = ("reactor", "sock", "fd", "on_data", "on_close",
+                 "_out", "_out_bytes", "_closing", "closed",
+                 "_want_write", "idle_s", "_idle_timer", "last_activity",
+                 "data")
+
+    def __init__(self, reactor: "Reactor", sock: socket.socket,
+                 on_data: Callable[["Connection", memoryview], None],
+                 on_close: Optional[Callable[["Connection",
+                                              Optional[BaseException]],
+                                             None]] = None,
+                 idle_s: float = 0.0):
+        self.reactor = reactor
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.on_data = on_data
+        self.on_close = on_close
+        # lazy: a mostly-idle inbound connection never writes, and at
+        # 10k+ held connections an empty deque per conn (~600 B) is the
+        # single biggest per-connection allocation
+        self._out: Optional[deque] = None   # memoryviews awaiting flush
+        self._out_bytes = 0
+        self._closing = False           # close once drained
+        self.closed = False
+        self._want_write = False
+        self.idle_s = float(idle_s)
+        self._idle_timer: Optional[_Timer] = None
+        self.last_activity = time.monotonic()
+        self.data: Any = None           # protocol state hangs here
+
+    # -- thread-safe surface --------------------------------------------
+    def write(self, data) -> None:
+        """Queue bytes for send; safe from any thread."""
+        if self.reactor.in_loop():
+            self._send(data)
+        else:
+            self.reactor.call_soon(self._send, data)
+
+    def close_after_flush(self) -> None:
+        if self.reactor.in_loop():
+            self._finish()
+        else:
+            self.reactor.call_soon(self._finish)
+
+    def kill(self, exc: Optional[BaseException] = None) -> None:
+        """Close now, dropping any queued output; any thread."""
+        if self.reactor.in_loop():
+            self.reactor._close_conn(self, exc)
+        else:
+            self.reactor.call_soon(self.reactor._close_conn, self, exc)
+
+    @property
+    def out_bytes(self) -> int:
+        return self._out_bytes
+
+    # -- loop-side ------------------------------------------------------
+    def _send(self, data) -> None:
+        if self.closed or self._closing:
+            return
+        mv = memoryview(data) if not isinstance(data, memoryview) else data
+        if not mv.nbytes:
+            return
+        if self._out is None:
+            self._out = deque()
+        self._out.append(mv)
+        self._out_bytes += mv.nbytes
+        self._flush()
+
+    def _finish(self) -> None:
+        if self.closed:
+            return
+        if not self._out:
+            self.reactor._close_conn(self, None)
+        else:
+            self._closing = True        # _flush closes once drained
+
+    def _flush(self) -> None:
+        try:
+            while self._out:
+                iovs = []
+                for mv in self._out:
+                    iovs.append(mv)
+                    if len(iovs) >= _SENDMSG_IOVS:
+                        break
+                sent = self.sock.sendmsg(iovs)
+                self._out_bytes -= sent
+                while sent:
+                    head = self._out[0]
+                    if sent >= head.nbytes:
+                        sent -= head.nbytes
+                        self._out.popleft()
+                    else:
+                        self._out[0] = head[sent:]
+                        sent = 0
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError as e:
+            self.reactor._close_conn(self, e)
+            return
+        if self._out and not self._want_write:
+            self._want_write = True
+            self.reactor._set_interest(self, write=True)
+        elif not self._out:
+            if self._want_write:
+                self._want_write = False
+                self.reactor._set_interest(self, write=False)
+            if self._closing:
+                self.reactor._close_conn(self, None)
+
+    def _touch(self, now: float) -> None:
+        self.last_activity = now
+
+
+class FrameAssembler:
+    """Incremental reassembly of ``[fixed header][payload]`` streams.
+
+    One preallocated header buffer per connection absorbs torn headers
+    and 1-byte trickles without allocating; ``header_cb(conn, header)``
+    returns the payload length (or a fresh expected-header length to
+    switch framing), then ``frame_cb(conn, header, payload)`` fires once
+    the payload is complete.  ``header_cb`` may also return ``None`` to
+    abort (connection being closed by the callback).
+    """
+
+    __slots__ = ("header_size", "header_cb", "frame_cb",
+                 "_head", "_head_got", "_body", "_body_view", "_body_got",
+                 "_header")
+
+    def __init__(self, header_size: int,
+                 header_cb: Callable[[Connection, bytes], Optional[int]],
+                 frame_cb: Callable[[Connection, bytes, bytes], None]):
+        self.header_size = header_size
+        self.header_cb = header_cb
+        self.frame_cb = frame_cb
+        self._head = bytearray(header_size)     # preallocated, reused
+        self._head_got = 0
+        self._header: Optional[bytes] = None
+        self._body: Optional[bytearray] = None
+        self._body_view: Optional[memoryview] = None
+        self._body_got = 0
+
+    def feed(self, conn: Connection, view: memoryview) -> None:
+        off, n = 0, view.nbytes
+        while off < n and not conn.closed:
+            if self._header is None:
+                take = min(n - off, self.header_size - self._head_got)
+                self._head[self._head_got:self._head_got + take] = \
+                    view[off:off + take]
+                self._head_got += take
+                off += take
+                if self._head_got < self.header_size:
+                    return              # torn header — keep the partial
+                self._head_got = 0
+                header = bytes(self._head)
+                body_len = self.header_cb(conn, header)
+                if body_len is None:
+                    return
+                if body_len == 0:
+                    self.frame_cb(conn, header, b"")
+                    continue
+                self._header = header
+                self._body = bytearray(body_len)
+                self._body_view = memoryview(self._body)
+                self._body_got = 0
+            else:
+                body = self._body_view
+                assert body is not None
+                take = min(n - off, body.nbytes - self._body_got)
+                body[self._body_got:self._body_got + take] = \
+                    view[off:off + take]
+                self._body_got += take
+                off += take
+                if self._body_got < body.nbytes:
+                    return
+                header, payload = self._header, bytes(self._body)
+                self._header = self._body = self._body_view = None
+                self._body_got = 0
+                self.frame_cb(conn, header, payload)
+
+
+class _Handoff:
+    """Bounded executor between the loop and CPU-bound work.
+
+    ``submit`` never blocks the loop: a full queue runs the job inline
+    (counted on ``transport.reactor.executor_inline`` — backpressure is
+    visible as loop lag, not as a silent deadlock).  Results hop back
+    to the loop via ``call_soon``.
+    """
+
+    def __init__(self, reactor: "Reactor", workers: int, name: str):
+        self.reactor = reactor
+        self.workers = max(1, workers)
+        self._q: "queue.Queue" = queue.Queue(maxsize=8 * self.workers)
+        self._m_queue = metrics.gauge("transport.reactor.executor_queue")
+        self._m_inline = metrics.counter("transport.reactor.executor_inline")
+        self._threads = [
+            threading.Thread(target=self._run, name=f"{name}-exec-{i}",
+                             daemon=True)
+            for i in range(self.workers)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, fn: Callable[[], Any],
+               on_done: Optional[Callable[[Any, Optional[BaseException]],
+                                          None]] = None) -> None:
+        try:
+            self._q.put_nowait((fn, on_done))
+            self._m_queue.set(self._q.qsize())
+        except queue.Full:
+            self._m_inline.add(1)
+            res, exc = _run_guarded(fn)
+            if on_done is not None:
+                if self.reactor.in_loop():
+                    on_done(res, exc)
+                else:
+                    self.reactor.call_soon(on_done, res, exc)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            self._m_queue.set(self._q.qsize())
+            fn, on_done = item
+            res, exc = _run_guarded(fn)
+            if on_done is not None:
+                self.reactor.call_soon(on_done, res, exc)
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                break
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+def _run_guarded(fn: Callable[[], Any]
+                 ) -> Tuple[Any, Optional[BaseException]]:
+    try:
+        return fn(), None
+    except BaseException as e:  # noqa: BLE001 — ferried to on_done
+        return None, e
+
+
+class _Acceptor:
+    __slots__ = ("sock", "on_accept", "backoff_timer")
+
+    def __init__(self, sock: socket.socket, on_accept):
+        self.sock = sock
+        self.on_accept = on_accept
+        self.backoff_timer: Optional[_Timer] = None
+
+
+class Reactor:
+    """One event loop, one thread; see the module docstring."""
+
+    def __init__(self, name: str = "reactor", *,
+                 executor_workers: Optional[int] = None,
+                 idle_s: Optional[float] = None):
+        self.name = name
+        if executor_workers is None:
+            executor_workers = int(get_env("DMLC_REACTOR_EXECUTOR", 2))
+        if idle_s is None:
+            idle_s = float(get_env("DMLC_REACTOR_IDLE_S", 0.0))
+        self.default_idle_s = max(0.0, float(idle_s))
+        self._sel = selectors.DefaultSelector()
+        self._wheel = TimerWheel()
+        self._conns: Dict[int, Connection] = {}
+        self._acceptors: Dict[int, _Acceptor] = {}
+        self._calls: deque = deque()
+        self._calls_lock = threading.Lock()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._wake_pending = False
+        self._sel.register(self._wake_r, selectors.EVENT_READ, self._drink)
+        self._scratch = bytearray(1 << 16)      # loop-owned read buffer
+        self._scratch_view = memoryview(self._scratch)
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        self.executor = _Handoff(self, executor_workers, name)
+        self._m_conns = metrics.gauge("transport.reactor.connections")
+        self._m_lag = metrics.gauge("transport.reactor.loop_lag_ms")
+        self._m_accepts = metrics.counter("transport.reactor.accepts")
+        self._m_emfile = metrics.counter(
+            "transport.reactor.emfile_backoffs")
+        self._m_reuse = metrics.counter("transport.buffer_reuse")
+        self._conn_count = 0
+        self._tick = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Reactor":
+        self._thread = threading.Thread(target=self.run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stopping = True
+        self._wake()
+        if self._thread is not None and self._thread is not \
+                threading.current_thread():
+            self._thread.join(timeout=timeout)
+        self.executor.stop()
+
+    def in_loop(self) -> bool:
+        return threading.current_thread() is self._thread
+
+    # -- thread-safe surface --------------------------------------------
+    def call_soon(self, fn: Callable, *args) -> None:
+        with self._calls_lock:
+            self._calls.append((fn, args))
+        self._wake()
+
+    def call_later(self, delay_s: float, fn: Callable[[], None]) -> None:
+        if self.in_loop():
+            self._wheel.schedule(time.monotonic(), delay_s, fn)
+        else:
+            self.call_soon(self._schedule, delay_s, fn)
+
+    def _schedule(self, delay_s: float, fn) -> None:
+        self._wheel.schedule(time.monotonic(), delay_s, fn)
+
+    def _wake(self) -> None:
+        if self._wake_pending:
+            return
+        self._wake_pending = True
+        try:
+            self._wake_w.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass
+
+    def _drink(self, mask: int) -> None:
+        self._wake_pending = False
+        try:
+            while self._wake_r.recv(256):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    # -- registration (loop thread, or pre-start) ------------------------
+    def add_listener(self, sock: socket.socket,
+                     on_accept: Callable[[socket.socket, object], None]
+                     ) -> None:
+        """Register a listening socket; ``on_accept(sock, addr)`` runs on
+        the loop with an already non-blocking, NODELAY socket."""
+        sock.setblocking(False)
+        acc = _Acceptor(sock, on_accept)
+        self._acceptors[sock.fileno()] = acc
+        if self.in_loop() or self._thread is None:
+            self._sel.register(sock, selectors.EVENT_READ,
+                               lambda mask, a=acc: self._accept_ready(a))
+        else:
+            self.call_soon(self._sel.register, sock, selectors.EVENT_READ,
+                           lambda mask, a=acc: self._accept_ready(a))
+
+    def add_connection(self, sock: socket.socket,
+                       on_data, on_close=None,
+                       idle_s: Optional[float] = None) -> Connection:
+        sock.setblocking(False)
+        conn = Connection(self, sock, on_data, on_close,
+                          idle_s=(self.default_idle_s if idle_s is None
+                                  else idle_s))
+        register = self._register_conn
+        if self.in_loop() or self._thread is None:
+            register(conn)
+        else:
+            self.call_soon(register, conn)
+        return conn
+
+    def _register_conn(self, conn: Connection) -> None:
+        if conn.closed:
+            return
+        # the Connection itself is the selector data — a per-connection
+        # dispatch closure would cost ~200 B × 10k+ held connections
+        self._sel.register(conn.sock, selectors.EVENT_READ, conn)
+        self._conns[conn.fd] = conn
+        self._conn_count += 1
+        self._m_conns.set(self._conn_count)
+        if conn.idle_s > 0:
+            self._arm_idle(conn)
+
+    def _arm_idle(self, conn: Connection) -> None:
+        delay = conn.idle_s
+
+        def check() -> None:
+            if conn.closed or conn.idle_s <= 0:
+                return
+            idle = time.monotonic() - conn.last_activity
+            if idle >= conn.idle_s:
+                metrics.counter("transport.reactor.idle_reaped").add(1)
+                self._close_conn(conn, TimeoutError(
+                    f"idle for {idle:.1f}s (limit {conn.idle_s:.1f}s)"))
+            else:
+                conn._idle_timer = self._wheel.schedule(
+                    time.monotonic(), conn.idle_s - idle, check)
+
+        conn._idle_timer = self._wheel.schedule(time.monotonic(), delay,
+                                                check)
+
+    def _set_interest(self, conn: Connection, *, write: bool) -> None:
+        ev = selectors.EVENT_READ | (selectors.EVENT_WRITE if write else 0)
+        try:
+            self._sel.modify(conn.sock, ev, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    # -- readiness handlers ---------------------------------------------
+    def _accept_ready(self, acc: _Acceptor) -> None:
+        for _ in range(_ACCEPT_BATCH):
+            try:
+                sock, addr = acc.sock.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:
+                if e.errno in FD_EXHAUSTION_ERRNOS:
+                    self._emfile_backoff(acc)
+                else:
+                    try:                # listener closed underneath us
+                        self._sel.unregister(acc.sock)
+                    except (KeyError, ValueError, OSError):
+                        pass
+                    self._acceptors.pop(acc.sock.fileno(), None)
+                return
+            self._m_accepts.add(1)
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            acc.on_accept(sock, addr)
+
+    def _emfile_backoff(self, acc: _Acceptor) -> None:
+        """fd exhaustion: stop selecting the listener (level-triggered
+        readiness would spin the loop at 100% CPU) and re-arm after a
+        jittered pause — pending clients wait in the backlog."""
+        self._m_emfile.add(1)
+        try:
+            self._sel.unregister(acc.sock)
+        except (KeyError, ValueError, OSError):
+            return
+        delay = 0.05 + 0.20 * random.random()
+
+        def rearm() -> None:
+            if self._stopping:
+                return
+            try:
+                self._sel.register(
+                    acc.sock, selectors.EVENT_READ,
+                    lambda mask, a=acc: self._accept_ready(a))
+            except (KeyError, ValueError, OSError):
+                return
+
+        acc.backoff_timer = self._wheel.schedule(time.monotonic(), delay,
+                                                 rearm)
+
+    def _conn_ready(self, conn: Connection, mask: int) -> None:
+        if conn.closed:
+            return
+        if mask & selectors.EVENT_WRITE:
+            conn._flush()
+        if conn.closed or not (mask & selectors.EVENT_READ):
+            return
+        try:
+            n = conn.sock.recv_into(self._scratch_view)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError as e:
+            self._close_conn(conn, e)
+            return
+        if n == 0:
+            self._close_conn(conn, None)
+            return
+        conn._touch(time.monotonic())
+        self._m_reuse.add(1)
+        try:
+            conn.on_data(conn, self._scratch_view[:n])
+        except Exception as e:  # noqa: BLE001 — one bad conn, not the loop
+            logger.warning("%s: protocol error on fd %d: %r",
+                           self.name, conn.fd, e)
+            self._close_conn(conn, e)
+
+    def _close_conn(self, conn: Connection,
+                    exc: Optional[BaseException]) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        if conn._idle_timer is not None:
+            conn._idle_timer.cancel()
+        if self._conns.pop(conn.fd, None) is not None:
+            self._conn_count = max(0, self._conn_count - 1)
+            self._m_conns.set(self._conn_count)
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn._out is not None:
+            conn._out.clear()
+        conn._out_bytes = 0
+        if conn.on_close is not None:
+            try:
+                conn.on_close(conn, exc)
+            except Exception as e:  # noqa: BLE001 — teardown must finish
+                logger.warning("%s: on_close error on fd %d: %r",
+                               self.name, conn.fd, e)
+
+    # -- the loop --------------------------------------------------------
+    def run(self) -> None:
+        if self._thread is None:
+            self._thread = threading.current_thread()
+        # process-global, deliberately: any co-thread (health poller,
+        # executor worker) holding the GIL for the default 5 ms switch
+        # interval puts a 5 ms spike on the tail of EVERY request the
+        # loop has in flight — 1 ms bounds that for negligible
+        # context-switch overhead
+        if sys.getswitchinterval() > 0.001:
+            sys.setswitchinterval(0.001)
+        self._wheel.schedule(time.monotonic(), _HEARTBEAT_S,
+                             self._heartbeat)
+        while not self._stopping:
+            now = time.monotonic()
+            nxt = self._wheel.next_deadline()
+            timeout = _HEARTBEAT_S if nxt is None else \
+                min(max(0.0, nxt - now), _HEARTBEAT_S)
+            events = self._sel.select(timeout)
+            now = time.monotonic()
+            with self._calls_lock:
+                calls = list(self._calls)
+                self._calls.clear()
+            due = self._wheel.next_deadline()
+            timers_due = due is not None and due < now
+            if not (events or calls or timers_due):
+                continue
+            # span only ticks that did control work (calls/timers) plus
+            # 1-in-64 of the pure-I/O ticks: idle selects stay free, and
+            # a span per I/O tick (~25 µs) would tax the hot loop ~10%
+            # of a core at C10k live rates
+            self._tick += 1
+            sampled = bool(calls) or timers_due or not (self._tick & 63)
+            with (teltrace.span("reactor.tick", loop=self.name,
+                                events=len(events), calls=len(calls))
+                  if sampled else _NULL_SPAN):
+                fired, lag = self._wheel.fire_due(now)
+                if timers_due:
+                    self._m_lag.set(round(lag * 1e3, 3))
+                for fn, args in calls:
+                    try:
+                        fn(*args)
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning("%s: call_soon target failed: %r",
+                                       self.name, e)
+                for key, mask in events:
+                    data = key.data
+                    if data.__class__ is Connection:
+                        self._conn_ready(data, mask)
+                    else:
+                        data(mask)
+        self._teardown()
+
+    def _heartbeat(self) -> None:
+        # rescheduled every tick; fire_due measures how late it ran —
+        # that delay IS the loop lag the gauge reports
+        if not self._stopping:
+            self._wheel.schedule(time.monotonic(), _HEARTBEAT_S,
+                                 self._heartbeat)
+
+    def _teardown(self) -> None:
+        for conn in list(self._conns.values()):
+            self._close_conn(conn, None)
+        for fd, acc in list(self._acceptors.items()):
+            try:
+                self._sel.unregister(acc.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+        # every still-registered connection (listener sockets belong to
+        # their owners; they close them)
+        for key in list(self._sel.get_map().values()):
+            obj = key.fileobj
+            if obj in (self._wake_r,):
+                continue
+            try:
+                self._sel.unregister(obj)
+            except (KeyError, ValueError, OSError):
+                pass
+            try:
+                obj.close()             # type: ignore[union-attr]
+            except OSError:
+                pass
+        try:
+            self._sel.unregister(self._wake_r)
+        except (KeyError, ValueError, OSError):
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._sel.close()
+
+
+class ReactorGroup:
+    """N reactors, each its own loop thread (and, for servers, its own
+    ``SO_REUSEPORT`` listener).  ``n=1`` degenerates to a single
+    :class:`Reactor` with zero sharding overhead."""
+
+    def __init__(self, n: int, name: str = "reactor", *,
+                 executor_workers: Optional[int] = None,
+                 idle_s: Optional[float] = None):
+        self.loops: List[Reactor] = [
+            Reactor(f"{name}-{i}" if n > 1 else name,
+                    executor_workers=executor_workers, idle_s=idle_s)
+            for i in range(max(1, n))]
+
+    @property
+    def primary(self) -> Reactor:
+        return self.loops[0]
+
+    def start(self) -> "ReactorGroup":
+        for r in self.loops:
+            r.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        for r in self.loops:
+            r.stop(timeout=timeout)
+
+    def __iter__(self):
+        return iter(self.loops)
+
+    def __len__(self) -> int:
+        return len(self.loops)
+
+    def bind_reuseport(self, host: str, port: int,
+                       on_accept, *, backlog: int = 128
+                       ) -> List[Listener]:
+        """One ``SO_REUSEPORT`` listener per loop; the kernel shards
+        inbound connections across them."""
+        listeners = reuseport_group(host, port, len(self.loops),
+                                    backlog=backlog)
+        for r, lst in zip(self.loops, listeners):
+            r.add_listener(
+                lst.sock,
+                lambda sock, addr, _r=r: on_accept(_r, sock, addr))
+        return listeners
