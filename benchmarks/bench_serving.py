@@ -639,6 +639,84 @@ def ha_bench(model, params, *, features: int):
     return out, headlines
 
 
+def diagnose_bench():
+    """``--diagnose``: time a full diagnosis pass over a worst-case
+    evidence set — a full 2048-event wide-event ring, a 300-series ×
+    300-point history store, and a few thousand span records — the
+    r20 acceptance surface (``diagnose_wall_ms``, gated lower-better)."""
+    import random
+
+    from dmlc_core_tpu.telemetry import trace as teltrace
+    from dmlc_core_tpu.telemetry.diagnose import DiagnosisEngine
+    from dmlc_core_tpu.telemetry.timeseries import HistoryStore
+    from dmlc_core_tpu.telemetry.wide_events import wide_event, wide_log
+
+    rng = random.Random(20)
+
+    # full ring: 7/8 healthy traffic spread over 3 replicas, 1/8 slow
+    # and errored on one — the differencer has real work to do
+    wide_log.reset(capacity=2048)
+    replicas = ["10.0.0.1:7011", "10.0.0.2:7012", "10.0.0.3:7013"]
+    for i in range(2048):
+        bad = i % 8 == 0
+        wide_event("serving.route",
+                   model="bench", replica=replicas[0] if bad
+                   else replicas[i % 3],
+                   req_id=i, rows=8, nnz=64,
+                   outcome="DEADLINE_EXCEEDED" if bad else "OK",
+                   attempts=1,
+                   dur_ms=rng.uniform(20.0, 30.0) if bad
+                   else rng.uniform(0.5, 2.0))
+    # events are stamped at emit time — close the window after them
+    now = time.time()
+
+    # 300 series × 300 points at 1 s cadence; one series deviates
+    # 40 points before the breach onset so lead/lag scans end-to-end
+    state = {"t": 0}
+
+    def snap():
+        t = state["t"]
+        out = {}
+        for s in range(300):
+            v = 10.0 + (s % 7) + 0.1 * ((t + s) % 5)
+            if s == 7 and t >= 220:
+                v += 50.0          # the leading suspect
+            out[f"bench.s{s}"] = {"type": "gauge", "value": v}
+        return out
+
+    store = HistoryStore(snapshot_fn=snap, tiers=[(1.0, 300)])
+    base = now - 300.0
+    for t in range(300):
+        state["t"] = t
+        store.sample_once(now=base + t)
+
+    # a few thousand live span records for the critical-path analyzer
+    for i in range(2000):
+        with teltrace.span(f"bench.op{i % 16}"):
+            pass
+
+    engine = DiagnosisEngine(history=store)
+    breach = {"rule": "bench.s3:max", "metric": "bench.s3",
+              "series": "bench.s3", "severity": "page",
+              "window_s": 60.0, "value": 1.0, "max": 0.5}
+    scenarios = {}
+    walls = []
+    for run in range(5):
+        t0 = time.perf_counter()
+        doc = engine.run(until=now, breach=breach)
+        walls.append((time.perf_counter() - t0) * 1e3)
+    walls.sort()
+    scenarios["diagnose"] = {
+        "runs": len(walls), "wall_ms": [round(w, 3) for w in walls],
+        "suspects": len(doc["suspects"]),
+        "series_scanned": doc["analyzers"]["timeline"]["series_scanned"],
+        "events": doc["analyzers"]["wide_events"]["events"],
+    }
+    wide_log.reset()
+    headlines = {"diagnose_wall_ms": round(walls[len(walls) // 2], 3)}
+    return scenarios, headlines
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -664,11 +742,30 @@ def main() -> int:
     c10k_mode = "--c10k" in argv
     if c10k_mode:
         argv.remove("--c10k")
+    diagnose_mode = "--diagnose" in argv
+    if diagnose_mode:
+        argv.remove("--diagnose")
     telemetry_prefix = os.environ.get("DMLC_TELEMETRY_OUT")
     if "--telemetry-out" in argv:
         i = argv.index("--telemetry-out")
         telemetry_prefix = argv[i + 1]
         del argv[i:i + 2]
+
+    if diagnose_mode:
+        # needs no model — dispatch before the jax build below
+        report = {"bench": "diagnose",
+                  "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                  "backend": jax.default_backend(), "scenarios": {}}
+        scenarios, headlines = diagnose_bench()
+        report["scenarios"] = scenarios
+        report.update(headlines)
+        blob = json.dumps(report, indent=2)
+        print(blob)
+        if argv:
+            with open(argv[0], "w") as f:
+                f.write(blob + "\n")
+            log(f"wrote {argv[0]}")
+        return 0
 
     requests = int(os.environ.get("DMLC_SERVE_REQUESTS", "2000"))
     features = int(os.environ.get("DMLC_SERVE_FEATURES", str(1 << 16)))

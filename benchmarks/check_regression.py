@@ -92,6 +92,11 @@ _HIGHER_BETTER = ("qps", "rate", "throughput", "mb_s", "mbs", "rows",
 #  connection and the process thread count, which the reactor keeps at
 #  O(loops + executor) instead of O(connections); the live-subset p99
 #  keys gate lower-better via "p99" as usual.
+#  The diagnose family (ISSUE 20, BENCH_diagnose_r*.json): one headline,
+#  diagnose_wall_ms — a full /diagnose pass over a worst-case evidence
+#  set (2048-event wide ring, 300 series x 300 points, 2k spans) —
+#  gates lower-better via "_ms"; an incident diagnosis that itself
+#  stalls the exporter is a regression regardless of its verdicts.
 _LOWER_BETTER = ("latency", "p50", "p95", "p99", "seconds", "_ms", "ms_",
                  "wall", "overhead", "compile", "stall", "shed", "drops",
                  "errors", "misses", "padding_ratio", "truncated",

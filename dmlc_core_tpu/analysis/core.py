@@ -176,10 +176,11 @@ def lint_rule(name: str, description: str = ""):
 
 def _load_builtin_rules() -> None:
     # import for registration side effects; idempotent via the registry
-    from . import (rules_durable, rules_endpoints, rules_env,  # noqa: F401
-                   rules_io, rules_jit, rules_locks, rules_metrics,
-                   rules_reactor, rules_spans, rules_threads,
-                   rules_transport, rules_wide_events)
+    from . import (rules_diagnosis, rules_durable,  # noqa: F401
+                   rules_endpoints, rules_env, rules_io, rules_jit,
+                   rules_locks, rules_metrics, rules_reactor,
+                   rules_spans, rules_threads, rules_transport,
+                   rules_wide_events)
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterable[str]:

@@ -1,0 +1,143 @@
+"""diagnosis-vocabulary: the diagnosis engine speaks documented names.
+
+Motivating bug class (r20): the automated diagnoser's whole value is
+that its suspect report uses the *same* vocabulary operators already
+know — wide-event field names from ``wide_events.FIELDS`` and metric
+names from the ``docs/observability.md`` catalog.  A field-name typo in
+an analyzer ("duration_ms") never crashes: the classifier just reads
+``None`` for every event and the analyzer silently goes blind.  This
+rule keeps the engine honest three ways:
+
+* every module-level field set in ``telemetry/diagnose.py`` whose name
+  mentions ``FIELDS`` (``MEASURE_FIELDS``, ``IDENTITY_FIELDS``,
+  ``ENTITY_FIELDS``, …) must be a subset of ``wide_events.FIELDS`` — a
+  stale entry after a vocabulary change fails the lint, not the 3 a.m.
+  diagnosis;
+* ``event_field(ev, "name")`` is the one sanctioned spelling for
+  reading a wide-event field inside the analyzers (same single-spelling
+  trick as ``wide_event()`` emission), and its literal must be in
+  ``FIELDS`` — a non-literal name is flagged because it cannot be
+  checked;
+* the ``DIAG_METRICS`` tuple (the metric names the engine emits) must
+  have rows in the docs metric catalog, so ``telemetry.diagnose.*``
+  never becomes undocumented accounting.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+from .core import (Finding, LintContext, LintRule, ParsedModule, dotted,
+                   lint_rule)
+
+#: the module whose FIELDS-named sets this rule audits (the canonical
+#: ``FIELDS`` definition in wide_events.py is deliberately out of scope)
+_DIAGNOSE_MOD = "telemetry/diagnose.py"
+
+
+@lint_rule("diagnosis-vocabulary",
+           description="diagnose.py field sets and event_field() literals "
+                       "are wide_events.FIELDS members, and DIAG_METRICS "
+                       "names are documented in the observability metric "
+                       "catalog")
+class DiagnosisVocabularyRule(LintRule):
+
+    def __init__(self) -> None:
+        #: field name → (rel, lineno) from FIELDS-named sets + literals
+        self._field_refs: Dict[str, Tuple[str, int]] = {}
+        #: metric name → (rel, lineno) from DIAG_METRICS tuples
+        self._metric_refs: Dict[str, Tuple[str, int]] = {}
+
+    def check_module(self, mod: ParsedModule, ctx: LintContext
+                     ) -> List[Finding]:
+        out: List[Finding] = []
+        rel = mod.rel.replace(os.sep, "/")
+        if rel.endswith(_DIAGNOSE_MOD):
+            for stmt in mod.tree.body:
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    continue
+                target = stmt.targets[0].id
+                if "FIELDS" in target and target != "FIELDS":
+                    for name, lineno in _str_elements(stmt.value):
+                        self._field_refs.setdefault(name,
+                                                    (mod.rel, lineno))
+                elif target == "DIAG_METRICS":
+                    for name, lineno in _str_elements(stmt.value):
+                        self._metric_refs.setdefault(name,
+                                                     (mod.rel, lineno))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func).rsplit(".", 1)[-1] != "event_field":
+                continue
+            if len(node.args) < 2:
+                continue
+            arg = node.args[1]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self._field_refs.setdefault(arg.value,
+                                            (mod.rel, node.lineno))
+            else:
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno, node.col_offset,
+                    "event_field() with a non-literal field name cannot "
+                    "be vocabulary-checked — pass the field as a string "
+                    "literal (or iterate a FIELDS-derived set)"))
+        return out
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        if not getattr(ctx, "full_run", False):
+            return []
+        out: List[Finding] = []
+        from ..telemetry.wide_events import FIELDS
+        for name in sorted(self._field_refs):
+            if name in FIELDS:
+                continue
+            rel, lineno = self._field_refs[name]
+            out.append(Finding(
+                self.name, rel, lineno, 0,
+                f"diagnosis field {name!r} is not in wide_events.FIELDS "
+                f"— the analyzer referencing it reads None for every "
+                f"event; fix the name or grow the vocabulary"))
+        if self._metric_refs:
+            doc_path = os.path.join(ctx.docs_dir, "observability.md")
+            doc_rel = os.path.relpath(doc_path, ctx.repo_root)
+            try:
+                with open(doc_path, encoding="utf-8") as f:
+                    doc = f.read()
+            except OSError:
+                return out + [Finding(
+                    self.name, doc_rel, 0, 0,
+                    "docs/observability.md unreadable — DIAG_METRICS has "
+                    "no catalog to check against")]
+            from .rules_metrics import _doc_metric_vocabulary
+            literals, patterns = _doc_metric_vocabulary(doc)
+            for name in sorted(self._metric_refs):
+                if name in literals or any(p.match(name)
+                                           for p in patterns):
+                    continue
+                rel, lineno = self._metric_refs[name]
+                out.append(Finding(
+                    self.name, rel, lineno, 0,
+                    f"diagnosis metric {name!r} has no row in the "
+                    f"docs/observability.md metric catalog — document "
+                    f"it"))
+        return out
+
+
+def _str_elements(node: ast.AST) -> List[Tuple[str, int]]:
+    """String literals inside a set/tuple/list literal (including one
+    wrapped in a ``frozenset(...)`` / ``set(...)`` call)."""
+    if isinstance(node, ast.Call) and \
+            dotted(node.func) in ("frozenset", "set") and node.args:
+        node = node.args[0]
+    if not isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        return []
+    out: List[Tuple[str, int]] = []
+    for el in node.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            out.append((el.value, el.lineno))
+    return out

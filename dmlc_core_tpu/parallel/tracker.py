@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..transport.frames import send_all
 from ..telemetry.aggregate import ResetGuard, merge_states, render_fleet
 from ..telemetry.anomaly import StragglerBoard
+from ..telemetry.diagnose import DiagnosisEngine
 from ..telemetry.exposition import TelemetryServer
 from ..telemetry.timeseries import HistoryStore
 from ..utils import DMLCError, check, get_env, get_logger, log_info
@@ -330,10 +331,17 @@ class RabitTracker:
             snapshot_fn=lambda: merge_states(self.telemetry_states()))
         self.telemetry: Optional[TelemetryServer] = None
         if telemetry_port is not None:
+            # /diagnose over the MERGED stores: the fleet timeline and
+            # the cross-rank straggler board, so one query on the
+            # tracker attributes an incident across every rank
             self.telemetry = TelemetryServer(
                 port=int(telemetry_port), metrics_fn=self._render_fleet,
                 stragglers_fn=self.straggler_board.snapshot,
-                timeline_fn=self.history.timeline)
+                timeline_fn=self.history.timeline,
+                diagnose_fn=DiagnosisEngine(
+                    history=self.history,
+                    stragglers_fn=self.straggler_board.snapshot,
+                ).endpoint_doc)
 
     # -- public control --
     def start(self) -> None:

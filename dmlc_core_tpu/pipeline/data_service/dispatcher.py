@@ -72,6 +72,7 @@ from ...telemetry import sampling as sampling_mod
 from ...telemetry import trace as teltrace
 from ...telemetry.aggregate import ResetGuard, merge_states, state_to_snapshot
 from ...telemetry.anomaly import StragglerBoard
+from ...telemetry.diagnose import DiagnosisEngine
 from ...telemetry.exposition import TelemetryServer
 from ...telemetry.timeseries import HistoryStore
 from ...utils import check
@@ -241,11 +242,18 @@ class Dispatcher:
             snapshot_fn=lambda: merge_states(self.worker_states()))
         self.telemetry: Optional[TelemetryServer] = None
         if telemetry_port is not None:
+            # /diagnose over the MERGED fleet view: worker timeline,
+            # per-job straggler board, and the worker console rows
             self.telemetry = TelemetryServer(
                 port=int(telemetry_port),
                 leases_fn=self.ledger_snapshot,
                 fleet_fn=self.fleet_snapshot,
-                timeline_fn=self.history.timeline)
+                timeline_fn=self.history.timeline,
+                diagnose_fn=DiagnosisEngine(
+                    history=self.history,
+                    stragglers_fn=self.straggler_board.snapshot,
+                    fleet_fn=self.fleet_snapshot,
+                ).endpoint_doc)
         if journal is None:
             journal = str(get_env("DMLC_DS_JOURNAL", "")) or None
         self._journal: Optional[journal_mod.DispatchJournal] = None

@@ -67,6 +67,7 @@ from ...parallel.tracker import (LivenessBoard, jittered, recv_json,
 from ...telemetry import flight as flight_mod
 from ...telemetry import trace as teltrace
 from ...telemetry.anomaly import StragglerBoard
+from ...telemetry.diagnose import DiagnosisEngine
 from ...telemetry.exposition import TelemetryServer
 from ...telemetry.timeseries import HistoryStore
 from ...transport.endpoints import EndpointSet, EndpointsLike
@@ -318,11 +319,19 @@ class ReplicaRegistry:
         self.history = HistoryStore(snapshot_fn=self._history_snapshot)
         self.telemetry: Optional[TelemetryServer] = None
         if telemetry_port is not None:
+            # /diagnose over the MERGED fleet view: the registry's
+            # synthetic fleet gauges, the per-model straggler board,
+            # and the replica console rows
             self.telemetry = TelemetryServer(
                 port=int(telemetry_port),
                 fleet_fn=self.fleet_snapshot,
                 rollouts_fn=self.rollouts.snapshot,
-                timeline_fn=self.history.timeline)
+                timeline_fn=self.history.timeline,
+                diagnose_fn=DiagnosisEngine(
+                    history=self.history,
+                    stragglers_fn=self.straggler_board.snapshot,
+                    fleet_fn=self.fleet_snapshot,
+                ).endpoint_doc)
 
     @property
     def address(self) -> Tuple[str, int]:

@@ -21,7 +21,12 @@ Layers (see ``docs/observability.md``):
 * :mod:`telemetry.anomaly` — streaming stall/straggler detection and the
   declarative ``DMLC_SLO_SPEC`` rule monitor.
 * :mod:`telemetry.profiling` — stdlib sampling stack profiler behind
-  ``/profile`` and the flight recorder's incident attachment.
+  ``/profile`` and the flight recorder's incident attachment, plus
+  collapsed-stack profile diffing (``/profile?diff=1``).
+* :mod:`telemetry.diagnose` — automated incident diagnosis: wide-event
+  differencing, timeline lead/lag correlation, critical-path regression
+  diff and fleet attribution merged into one ranked suspect report at
+  ``/diagnose`` (auto-attached to flight bundles on breaches).
 * :mod:`telemetry.xla_introspect` — jit retrace watchdog and device
   memory gauges.
 
@@ -41,13 +46,21 @@ from .anomaly import (SloMonitor, SloRule, SloSpecError, StallDetector,
                       StragglerBoard, StreamingStat, maybe_monitor_from_env,
                       parse_slo_spec)
 from .chrome_trace import to_chrome_trace, write_chrome_trace
+# NOTE: the submodule's convenience function is exported as
+# run_diagnosis — binding the package attribute ``diagnose`` to a
+# function would shadow the *module* for every ``from . import
+# diagnose`` site (exposition/flight/anomaly lazy imports)
+from .diagnose import DIAGNOSIS_SCHEMA, DiagnosisEngine, incident_diagnosis
+from .diagnose import diagnose as run_diagnosis
+from .diagnose import render_text as render_diagnosis
 from .exposition import (TelemetryServer, maybe_start_from_env,
                          render_openmetrics, render_prometheus,
                          render_series)
 from .flight import (FlightRecorder, dump_incident, flight_recorder,
                      maybe_arm_from_env, register_contributor,
                      unregister_contributor)
-from .profiling import SamplingProfiler, incident_profile, profile_for
+from .profiling import (SamplingProfiler, diff_collapsed, incident_profile,
+                        profile_for)
 from .sampling import (TailSampler, TraceBuffer, debug_trace_id, hash_keep,
                        is_debug, mark_debug, maybe_install_from_env,
                        was_kept)
@@ -77,6 +90,9 @@ __all__ = [
     "FlightRecorder", "flight_recorder", "dump_incident",
     "maybe_arm_from_env", "register_contributor", "unregister_contributor",
     "SamplingProfiler", "profile_for", "incident_profile",
+    "diff_collapsed",
+    "DiagnosisEngine", "run_diagnosis", "incident_diagnosis",
+    "render_diagnosis", "DIAGNOSIS_SCHEMA",
     "StreamingStat", "StallDetector", "StragglerBoard",
     "SloRule", "SloMonitor", "SloSpecError", "parse_slo_spec",
     "maybe_monitor_from_env",

@@ -464,6 +464,16 @@ class SloMonitor:
                 if fl is not None:
                     fl.flight_recorder.note("slo_breach", **{
                         k: v for k, v in b.items() if v is not None})
+            # auto-diagnosis (r20) BEFORE the dump, so the bundle's
+            # diagnosis.json is the breach-scoped verdict, not a generic
+            # window (lazy import: diagnose imports this module's
+            # StreamingStat; the edge must stay one-way at import time)
+            try:
+                from . import diagnose as _diagnose
+                _diagnose.on_breach(fired[0])
+            except Exception as e:  # noqa: BLE001 — diagnosis must
+                # never block the incident dump it decorates
+                log_warning("breach diagnosis failed: %s", e)
             if fl is not None:
                 fl.dump_incident("slo_breach", registry=self.registry,
                                  breaches=fired)

@@ -433,10 +433,15 @@ class TelemetryServer:
     ``/flight`` (on-demand incident bundle),
     ``/stragglers`` (tracker only — cross-rank straggler board JSON),
     ``/profile?seconds=N`` (collapsed-stack sampling profile of this
-    process), ``/timeline?metric=&since=&format=json|text`` (the
+    process; plain scrapes double as the baseline recorder and
+    ``?diff=1`` serves the differential profile against that baseline),
+    ``/timeline?metric=&since=&format=json|text`` (the
     time-machine history store — process-local by default, the merged
     fleet store on the tracker/dispatcher), ``/analyze?top=N``
     (critical-path breakdown of the slowest traces in the span ring),
+    ``/diagnose?since=&until=&top=&format=json|text`` (the r20 automated
+    incident diagnosis: four analyzers merged into one ranked suspect
+    report — fleet-merged on hosts that inject their stores),
     and — when the hosting process injects them — ``/leases``
     (dispatcher lease-lifecycle ledger), ``/fleet`` (dispatcher worker
     or serving replica console; ``?format=text|html`` renders the
@@ -464,6 +469,10 @@ class TelemetryServer:
                                                 Dict[str, Any]]] = None,
                  analyze_fn: Optional[Callable[[int],
                                                Dict[str, Any]]] = None,
+                 diagnose_fn: Optional[Callable[[Optional[float],
+                                                 Optional[float],
+                                                 Optional[int]],
+                                                Dict[str, Any]]] = None,
                  ) -> None:
         if metrics_fn is None:
             from ..utils.metrics import metrics as _registry
@@ -478,6 +487,8 @@ class TelemetryServer:
             profile_fn = self._default_profile
         if analyze_fn is None:
             analyze_fn = self._default_analyze
+        if diagnose_fn is None:
+            diagnose_fn = self._default_diagnose
         self._metrics_fn = metrics_fn
         self._health_fn = health_fn
         self._spans_fn = spans_fn
@@ -491,6 +502,7 @@ class TelemetryServer:
         # sampler started, DMLC_TIMELINE permitting) at start()
         self._timeline_fn = timeline_fn
         self._analyze_fn = analyze_fn
+        self._diagnose_fn = diagnose_fn
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -534,6 +546,18 @@ class TelemetryServer:
         slowest traces in this process's span ring."""
         from . import critical_path as _critical_path
         return _critical_path.analyze(top=top)
+
+    @staticmethod
+    def _default_diagnose(since_s: Optional[float],
+                          until_s: Optional[float],
+                          top: Optional[int]) -> Dict[str, Any]:
+        """``GET /diagnose``: automated incident diagnosis over this
+        process's wide-event ring, history store and span ring.  Hosts
+        with merged fleet stores (tracker/dispatcher/registry) inject a
+        fleet-scoped engine instead."""
+        from . import diagnose as _diagnose
+        return _diagnose.default_engine().endpoint_doc(
+            since_s=since_s, until_s=until_s, top=top)
 
     @property
     def port(self) -> int:
@@ -638,7 +662,22 @@ class TelemetryServer:
             seconds = float(query.get("seconds", "1"))
         except ValueError:
             seconds = 1.0
-        return 200, "text/plain; charset=utf-8", self._profile_fn(seconds)
+        text = self._profile_fn(seconds)
+        from . import profiling as _profiling
+        if query.get("diff") in ("1", "true", "yes"):
+            # fresh window diffed against the last plain scrape — the
+            # plain scrape IS the baseline recorder, so any periodic
+            # profile collection arms this for free
+            got = _profiling.baseline()
+            if got is None:
+                return (404, "text/plain; charset=utf-8",
+                        "no baseline profile recorded yet — scrape "
+                        "/profile (without diff=1) during a healthy "
+                        "window first\n")
+            return (200, "text/plain; charset=utf-8",
+                    _profiling.incident_profile_diff(text))
+        _profiling.record_baseline(text)
+        return 200, "text/plain; charset=utf-8", text
 
     @_endpoint("/timeline")
     def _ep_timeline(self, query: Dict[str, str]) -> Tuple[int, str, str]:
@@ -677,6 +716,28 @@ class TelemetryServer:
         if exs:
             doc = dict(doc)
             doc["exemplars"] = exs
+        return self._json(doc)
+
+    @_endpoint("/diagnose")
+    def _ep_diagnose(self, query: Dict[str, str]) -> Tuple[int, str, str]:
+        from .slo import parse_duration
+        since_s = until_s = None
+        raw = query.get("since")
+        if raw:
+            since_s = parse_duration(raw)     # "60", "5m", "90s" all ok
+        raw = query.get("until")
+        if raw:
+            until_s = parse_duration(raw)
+        top: Optional[int] = None
+        try:
+            top = int(query["top"]) if query.get("top") else None
+        except ValueError:
+            top = None
+        doc = self._diagnose_fn(since_s, until_s, top)
+        if query.get("format") == "text":
+            from . import diagnose as _diagnose
+            return (200, "text/plain; charset=utf-8",
+                    _diagnose.render_text(doc))
         return self._json(doc)
 
     def start(self) -> "TelemetryServer":

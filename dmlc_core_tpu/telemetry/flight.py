@@ -214,6 +214,28 @@ class FlightRecorder:
                 sections["critical_path"] = breakdown
         except Exception as e:
             sections["critical_path"] = f"<critical path failed: {e}>"
+        # the differential profile (r20): incident-window stacks vs the
+        # last healthy /profile scrape — only when a baseline exists
+        try:
+            from . import profiling as _profiling_diff
+            prof_text = sections.get("profile_collapsed")
+            if isinstance(prof_text, str) and prof_text \
+                    and not prof_text.startswith("<"):
+                pdiff = _profiling_diff.incident_profile_diff(prof_text)
+                if pdiff:
+                    sections["profile_diff"] = pdiff
+        except Exception as e:
+            sections["profile_diff"] = f"<profile diff failed: {e}>"
+        # the ranked root-cause verdict (r20): breach-scoped when a burn
+        # rule just fired, default-window otherwise (DMLC_DIAGNOSE=0
+        # opts out; skipped-when-None keeps unrelated bundles lean)
+        try:
+            from . import diagnose as _diagnose
+            ddoc = _diagnose.incident_diagnosis()
+            if ddoc is not None:
+                sections["diagnosis"] = ddoc
+        except Exception as e:
+            sections["diagnosis"] = f"<diagnosis failed: {e}>"
         return {
             **sections,
             "schema": INCIDENT_SCHEMA,
@@ -273,6 +295,13 @@ class FlightRecorder:
             cpath = doc.get("critical_path")
             if isinstance(cpath, str) and cpath:
                 doc["files"]["critical_path"] = "critical_path.txt"
+            pdiff = doc.get("profile_diff")
+            if isinstance(pdiff, str) and pdiff:
+                doc["files"]["profile_diff"] = "profile_diff.txt"
+            diag = doc.get("diagnosis")
+            if isinstance(diag, dict):
+                doc["files"]["diagnosis"] = "diagnosis.json"
+                doc["files"]["diagnosis_text"] = "diagnosis.txt"
             # tmp + rename per file: a crash mid-dump (likely — this IS
             # the crash path) must not leave a half-written bundle that
             # post-mortem tooling then chokes on
@@ -298,6 +327,15 @@ class FlightRecorder:
                                          default=str))
             if isinstance(cpath, str) and cpath:
                 _put("critical_path.txt", lambda f: f.write(cpath))
+            if isinstance(pdiff, str) and pdiff:
+                _put("profile_diff.txt", lambda f: f.write(pdiff + "\n"))
+            if isinstance(diag, dict):
+                _put("diagnosis.json",
+                     lambda f: json.dump(diag, f, indent=2,
+                                         sort_keys=True, default=str))
+                from . import diagnose as _diagnose
+                _put("diagnosis.txt",
+                     lambda f: f.write(_diagnose.render_text(diag)))
         except OSError as e:
             # the black box must never become the crash: report and move on
             log_warning("flight recorder dump to %s failed: %s", path, e)

@@ -39,7 +39,9 @@ from typing import Dict, Optional
 from ..utils.metrics import metrics
 from ..utils.parameter import get_env
 
-__all__ = ["SamplingProfiler", "profile_for", "incident_profile"]
+__all__ = ["SamplingProfiler", "profile_for", "incident_profile",
+           "diff_collapsed", "record_baseline", "baseline",
+           "incident_profile_diff"]
 
 #: default sample rate; co-prime with common 10 ms scheduler quanta
 _DEFAULT_HZ = 67.0
@@ -179,3 +181,93 @@ def incident_profile() -> str:
     if window <= 0:       # explicit opt-out: profiling disabled
         return ""
     return profile_for(window)
+
+
+# ---------------------------------------------------------------------------
+# profile diffing (r20): incident window vs pre-incident baseline
+# ---------------------------------------------------------------------------
+
+def _parse_collapsed(text: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, n = line.rpartition(" ")
+        try:
+            out[stack] = out.get(stack, 0) + int(n)
+        except ValueError:
+            continue              # not a collapsed line; ignore
+    return out
+
+
+def diff_collapsed(baseline: str, incident: str) -> str:
+    """Differential flamegraph input: the incident profile's share shift
+    per stack vs a baseline profile, as annotated collapsed text.
+
+    Both inputs are normalized to *shares* (sample counts divided by the
+    profile's total) so windows of different lengths compare honestly.
+    One line per stack, largest share growth first::
+
+        <stack> <incident_count> +12.3% (baseline 4.1% -> incident 16.4%)
+
+    Stacks that shrank or vanished follow, prefixed the same way with a
+    negative delta — a regression diff must show both what grew and what
+    it displaced.  Empty baseline → the incident profile is returned
+    annotated as ``(no baseline)`` so callers can always attach *something*.
+    """
+    inc = _parse_collapsed(incident)
+    base = _parse_collapsed(baseline)
+    if not base:
+        return "\n".join(f"{s} {n} (no baseline)"
+                         for s, n in sorted(inc.items(),
+                                            key=lambda kv: (-kv[1], kv[0])))
+    tot_i = sum(inc.values()) or 1
+    tot_b = sum(base.values()) or 1
+    rows = []
+    for stack in set(inc) | set(base):
+        si = inc.get(stack, 0) / tot_i
+        sb = base.get(stack, 0) / tot_b
+        rows.append((si - sb, stack, inc.get(stack, 0), sb, si))
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    return "\n".join(
+        f"{stack} {n} {d * 100:+.1f}% "
+        f"(baseline {sb * 100:.1f}% -> incident {si * 100:.1f}%)"
+        for d, stack, n, sb, si in rows)
+
+
+#: (collapsed_text, unix_ts) of the last healthy-window profile —
+#: recorded by plain ``/profile`` scrapes, consumed by ``?diff=1`` and
+#: flight bundles
+_baseline_lock = threading.Lock()
+_baseline: Optional[tuple] = None
+
+
+def record_baseline(text: str, ts: Optional[float] = None) -> None:
+    """Keep ``text`` as the pre-incident baseline profile.  Every plain
+    ``/profile`` scrape calls this, so any periodic profile collection
+    (cron scrape, dashboard) automatically arms the diff."""
+    global _baseline
+    if not text:
+        return
+    with _baseline_lock:
+        _baseline = (text, time.time() if ts is None else float(ts))
+
+
+def baseline() -> Optional[tuple]:
+    """The ``(collapsed_text, unix_ts)`` baseline, or None."""
+    with _baseline_lock:
+        return _baseline
+
+
+def incident_profile_diff(incident: str) -> str:
+    """``profile_diff.txt`` for a flight bundle: the incident window
+    diffed against the recorded baseline; "" when no baseline exists
+    (the bundle then simply omits the file)."""
+    got = baseline()
+    if got is None or not incident:
+        return ""
+    base_text, base_ts = got
+    head = (f"# profile diff: baseline @ {base_ts:.0f} "
+            f"({time.time() - base_ts:.0f}s ago) vs incident window\n")
+    return head + diff_collapsed(base_text, incident)

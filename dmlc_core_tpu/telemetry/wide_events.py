@@ -161,13 +161,28 @@ class WideEventLog:
             return [e for e in self._buf if e.get("seq", 0) > since]
 
     def doc(self, since: int = 0) -> Dict[str, Any]:
-        """The ``/events`` response body / flight-bundle section."""
+        """The ``/events`` response body / flight-bundle section.
+
+        ``dropped`` is the cumulative ring-overflow count since the last
+        :meth:`reset`.  ``missed`` is *this cursor's* loss: how many
+        events with ``seq > since`` are gone from the ring (overflowed,
+        or cleared by a reset — ``seq`` itself never restarts, so the
+        arithmetic stays honest across both).  A resuming reader that
+        sees ``missed == 0`` is guaranteed a gap-free, duplicate-free
+        continuation of its previous read.
+        """
         events = self.snapshot(since)
         with self._lock:
             last_seq, dropped = self._seq, self._dropped
+            oldest = self._buf[0].get("seq", 0) if self._buf else None
+        since = max(0, int(since))
+        if oldest is not None:
+            missed = max(0, oldest - 1 - since)
+        else:
+            missed = max(0, last_seq - since)
         return {"schema": WIDE_EVENTS_SCHEMA, "events": events,
                 "last_seq": last_seq, "dropped": dropped,
-                "file": self._path}
+                "missed": missed, "file": self._path}
 
     def __len__(self) -> int:
         with self._lock:
@@ -176,7 +191,11 @@ class WideEventLog:
     def reset(self, capacity: Optional[int] = None,
               path: Optional[str] = None) -> None:
         """Re-point the log (tests; long-lived processes after env
-        changes).  Drops buffered events and closes any open file."""
+        changes).  Drops buffered events and closes any open file.
+        ``seq`` is deliberately *not* restarted: cursors held by
+        ``/events?since=`` readers must stay strictly monotonic, so a
+        reader resuming across a reset reports the cleared events as
+        ``missed`` instead of silently skipping (or re-reading) lines."""
         with self._lock:
             if self._file is not None:
                 try:
@@ -188,7 +207,6 @@ class WideEventLog:
             self._buf = deque(maxlen=max(1, int(
                 capacity if capacity is not None
                 else get_env("DMLC_WIDE_EVENTS_CAP", 2048))))
-            self._seq = 0
             self._dropped = 0
             self._path = path if path is not None \
                 else get_env("DMLC_WIDE_EVENTS", None)

@@ -1,0 +1,595 @@
+"""Automated incident diagnosis (r20): per-analyzer unit tests on
+synthetic populations (wide-event differencing, timeline lead/lag,
+critical-path diff, fleet attribution + corroboration), the merged
+ranking and breach auto-scoping, the strictly-monotonic ``/events``
+cursor with per-reader ``missed`` accounting, profile diffing on
+``/profile?diff=1``, the ``/diagnose`` endpoint over a real socket, and
+the 3-replica chaos drill that proves end-to-end attribution: inject
+20 ms on one replica, breach, and the ranked report names that replica
+and the leading series with zero human input."""
+
+import json
+import math
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import dmlc_core_tpu.telemetry.diagnose as diagnose
+from dmlc_core_tpu.telemetry import exposition, profiling, slo
+from dmlc_core_tpu.telemetry import timeseries as ts
+from dmlc_core_tpu.telemetry import trace as teltrace
+from dmlc_core_tpu.telemetry.wide_events import WideEventLog, wide_log
+from dmlc_core_tpu.utils.metrics import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: fixed synthetic epoch (multiple of every tier step used below)
+T0 = 1_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_diagnosis(monkeypatch):
+    monkeypatch.setattr(diagnose, "_last_breach", None)
+    monkeypatch.setattr(diagnose, "_last_doc", None)
+    monkeypatch.setattr(profiling, "_baseline", None)
+    yield
+
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _empty_store():
+    return ts.HistoryStore(snapshot_fn=lambda: {}, tiers=[(1.0, 16)])
+
+
+def _engine(**kw):
+    kw.setdefault("events_fn", lambda: [])
+    kw.setdefault("history", _empty_store())
+    kw.setdefault("records_fn", lambda: [])
+    return diagnose.DiagnosisEngine(**kw)
+
+
+# ---------------------------------------------------------------------------
+# analyzer 1: wide-event dimension differencing
+# ---------------------------------------------------------------------------
+
+def test_robust_slow_threshold_splits_bimodal_window():
+    durs = [1.0] * 50 + [20.0] * 10
+    thr = diagnose._robust_slow_ms(durs)
+    assert 1.0 < thr < 20.0     # between the modes, not inside either
+
+
+def _route_events(n=60, bad_replica="10.0.0.9:7013", bad_every=3,
+                  outcome="UNAVAILABLE", ts_at=T0 - 5.0):
+    evs = []
+    for i in range(n):
+        bad = i % bad_every == 0
+        evs.append({"kind": "serving.route", "seq": i + 1, "ts": ts_at,
+                    "model": "m", "trace_id": f"{i:016x}",
+                    "replica": bad_replica if bad
+                    else f"10.0.0.{i % 2}:7011",
+                    "outcome": outcome if bad else "OK",
+                    "dur_ms": 25.0 if bad else 1.0})
+    return evs
+
+
+def test_wide_event_differencing_ranks_bad_dimension():
+    evs = _route_events()
+    eng = _engine(events_fn=lambda: evs)
+    doc = eng._diff_wide_events(T0 - 60, T0, top=5, slow_ms=0.0)
+    assert doc["in_window"] == 60 and doc["bad"] == 20
+    top2 = {(s["field"], s["value"]) for s in doc["suspects"][:2]}
+    assert ("replica", "10.0.0.9:7013") in top2
+    assert ("outcome", "UNAVAILABLE") in top2
+    rep = next(s for s in doc["suspects"] if s["field"] == "replica")
+    assert rep["bad_frac"] == 1.0 and rep["base_frac"] == 0.0
+    # measures and per-event identities are never differenced — that is
+    # the cardinality alarm BubbleUp-style analysis exists to avoid
+    banned = diagnose.MEASURE_FIELDS | diagnose.IDENTITY_FIELDS
+    assert all(s["field"] not in banned for s in doc["suspects"])
+
+
+def test_wide_event_differencing_slowness_without_errors():
+    # every outcome is OK: the adaptive dur_ms threshold alone must
+    # isolate the slow replica's requests as the bad population
+    evs = _route_events(outcome="OK")
+    eng = _engine(events_fn=lambda: evs)
+    doc = eng._diff_wide_events(T0 - 60, T0, top=5, slow_ms=0.0)
+    assert doc["bad"] == 20 and doc["slow_ms"] is not None
+    top = doc["suspects"][0]
+    assert (top["field"], top["value"]) == ("replica", "10.0.0.9:7013")
+
+
+def test_wide_event_differencing_empty_windows():
+    eng = _engine(events_fn=lambda: [])
+    doc = eng._diff_wide_events(T0 - 60, T0, top=5, slow_ms=0.0)
+    assert doc == {"events": 0, "in_window": 0, "bad": 0, "baseline": 0,
+                   "slow_ms": None, "suspects": []}
+    # out-of-window events are baseline only, never bad
+    evs = _route_events(ts_at=T0 - 500.0)
+    doc = _engine(events_fn=lambda: evs)._diff_wide_events(
+        T0 - 60, T0, top=5, slow_ms=0.0)
+    assert doc["in_window"] == 0 and doc["bad"] == 0
+
+
+# ---------------------------------------------------------------------------
+# analyzer 2: timeline lead/lag correlation
+# ---------------------------------------------------------------------------
+
+def test_onset_detection_and_frozen_baseline():
+    flat = [(T0 + i, 1.0) for i in range(20)]
+    assert diagnose.DiagnosisEngine._onset(flat) == (None, 0.0)
+    step = flat[:10] + [(T0 + 10 + i, 50.0) for i in range(5)]
+    onset, mag = diagnose.DiagnosisEngine._onset(step)
+    assert onset == T0 + 10 and mag > 3.0
+    # the stat freezes at onset: a series that keeps climbing measures
+    # against the pre-deviation baseline, so its magnitude only grows
+    climb = flat[:10] + [(T0 + 10 + i, 50.0 * (i + 1)) for i in range(5)]
+    _, mag2 = diagnose.DiagnosisEngine._onset(climb)
+    assert mag2 > mag
+    assert mag2 <= diagnose._Z_CAP
+
+
+def test_timeline_leaders_only_and_self_series_excluded():
+    vals = {"cause": 1.0, "victim": 2.0, "effect": 3.0, "flat": 4.0,
+            "slo.decoy": 5.0}
+
+    def snap():
+        return {k: {"type": "gauge", "value": v} for k, v in vals.items()}
+
+    store = ts.HistoryStore(snapshot_fn=snap, tiers=[(1.0, 64)])
+    for i in range(30):
+        if i == 6:
+            vals["slo.decoy"] = 500.0   # earliest mover, but self-series
+        if i == 10:
+            vals["cause"] = 50.0        # the upstream cause
+        if i == 15:
+            vals["victim"] = 80.0       # the breached series
+        if i == 25:
+            vals["effect"] = 90.0       # moved after the breach: effect
+        store.sample_once(now=T0 + i)
+    eng = _engine(history=store)
+    doc = eng._correlate_timeline(T0 + 20, T0 + 30, top=5,
+                                  breach_series="victim")
+    assert doc["breach_onset"] == T0 + 15
+    names = [s["series"] for s in doc["suspects"]]
+    assert names == ["cause"]
+    s = doc["suspects"][0]
+    assert s["lead_s"] == 5.0 and s["magnitude"] > 3.0
+    # no breach series given → window start is the reference onset
+    doc = eng._correlate_timeline(T0 + 20, T0 + 30, top=5,
+                                  breach_series=None)
+    assert doc["breach_onset"] == T0 + 20
+    assert "cause" in [s["series"] for s in doc["suspects"]]
+
+
+# ---------------------------------------------------------------------------
+# analyzer 3: critical-path regression diff
+# ---------------------------------------------------------------------------
+
+def _span(name, i, ts_s, dur_us):
+    return {"kind": "span", "name": name, "trace_id": f"t{i}",
+            "span_id": f"s{i}", "parent_id": None,
+            "ts_us": int(ts_s * 1e6), "dur_us": int(dur_us)}
+
+
+def test_critical_path_diff_ranks_grown_span():
+    records = []
+    for i in range(5):      # baseline: db dominates the critical path
+        records.append(_span("db", f"b{i}", T0 - 100 - i, 1000))
+    for i in range(3):      # incident: lock_wait displaces it
+        records.append(_span("db", f"i{i}", T0 - 10 - i, 1000))
+        records.append(_span("lock_wait", f"j{i}", T0 - 10 - i, 5000))
+    eng = _engine(records_fn=lambda: records)
+    doc = eng._diff_critical_path(T0 - 30, T0, top=5)
+    assert doc["incident_spans"] == 6 and doc["baseline_spans"] == 5
+    assert not doc["baseline_missing"]
+    top = doc["suspects"][0]
+    assert top["span"] == "lock_wait" and top["score"] > 0
+    assert top["share_baseline"] == 0.0
+    # db shrank: a regression diff only surfaces what grew
+    assert all(s["span"] != "db" for s in doc["suspects"])
+    # no incident spans → empty verdict, no division by zero
+    assert eng._diff_critical_path(T0 + 50, T0 + 60, top=5)[
+        "suspects"] == []
+
+
+# ---------------------------------------------------------------------------
+# analyzer 4 + merger: fleet attribution, corroboration, ranking
+# ---------------------------------------------------------------------------
+
+def test_fleet_attribution_corroborated_by_wide_events():
+    evs = _route_events()        # bad replica 10.0.0.9:7013
+    fleet = {"replicas": {"job:3": {"addr": "10.0.0.9:7013",
+                                    "alive": True, "straggler": True},
+                          "job:1": {"addr": "10.0.0.0:7011",
+                                    "alive": True}},
+             "workers": {"w:9": {"addr": "10.0.0.8:9000",
+                                 "alive": False}}}
+    stragglers = {"stages": {"step": {"2": {"straggler": True,
+                                            "z": 7.5},
+                                      "0": {"straggler": False,
+                                            "z": 0.1}}}}
+    eng = _engine(events_fn=lambda: evs, fleet_fn=lambda: fleet,
+                  stragglers_fn=lambda: stragglers)
+    doc = eng.run(since=T0 - 60, until=T0, top=8)
+    fl = doc["analyzers"]["fleet"]
+    assert set(fl["sources"]) == {"stragglers", "fleet"}
+    reasons = {(s["entity"], s["id"]): s["reason"]
+               for s in fl["suspects"]}
+    assert reasons[("rank", "2")] == "straggler"
+    assert reasons[("worker", "w:9")] == "dead"
+    assert reasons[("replica", "job:3")] == "straggler"
+    # the fleet row whose addr the wide-event verdict also names is
+    # corroborated and boosted — two analyzers agreeing beats either
+    rep = next(s for s in doc["suspects"]
+               if s["subject"] == "replica job:3")
+    # raw 6.0 against the dead worker's peak 10.0 → 0.6, +0.25 boost
+    assert rep["corroborated"] and rep["score"] == pytest.approx(0.85)
+    # the boost lifts it past the rank straggler's higher raw z (0.75)
+    rank2 = next(s for s in doc["suspects"] if s["subject"] == "rank 2")
+    assert rep["rank"] < rank2["rank"]
+    assert not any(s.get("corroborated") for s in doc["suspects"]
+                   if s["subject"] != "replica job:3")
+    # ranks are 1..N in score order
+    assert [s["rank"] for s in doc["suspects"]] == list(
+        range(1, len(doc["suspects"]) + 1))
+
+
+def test_run_document_schema_metrics_and_text():
+    runs0 = metrics.counter("telemetry.diagnose.runs").value
+    eng = _engine(events_fn=lambda: _route_events())
+    doc = eng.run(since=T0 - 60, until=T0, top=3)
+    assert doc["schema"] == diagnose.DIAGNOSIS_SCHEMA
+    assert doc["window"]["since"] == T0 - 60
+    assert doc["trigger"] == {"kind": "explicit"}
+    assert len(doc["suspects"]) <= 3 and doc["wall_ms"] >= 0
+    assert metrics.counter("telemetry.diagnose.runs").value == runs0 + 1
+    assert metrics.gauge("telemetry.diagnose.suspects").value == \
+        len(doc["suspects"])
+    text = diagnose.render_text(doc)
+    assert "ranked suspects" in text and "replica=10.0.0.9:7013" in text
+    # a quiet window renders too (the empty report is still a report)
+    quiet = _engine().run(since=T0 - 60, until=T0)
+    assert "(none — quiet window)" in diagnose.render_text(quiet)
+
+
+def test_endpoint_doc_scopes_to_recent_breach(monkeypatch):
+    evs = _route_events(ts_at=time.time())
+    eng = _engine(events_fn=lambda: evs)
+    # explicit window wins: trigger is explicit, window is since..until
+    doc = eng.endpoint_doc(since_s=10.0)
+    assert doc["trigger"]["kind"] == "explicit"
+    assert abs((doc["window"]["until"] - doc["window"]["since"]) - 10.0) \
+        < 1e-6
+    # a fresh breach scopes a bare call
+    breach = {"rule": "r:burn", "series": "x.p99", "window_s": 30.0}
+    monkeypatch.setattr(diagnose, "_last_breach", (breach, time.time()))
+    doc = eng.endpoint_doc()
+    assert doc["trigger"]["kind"] == "breach"
+    assert doc["trigger"]["breach"]["rule"] == "r:burn"
+    assert abs((doc["window"]["until"] - doc["window"]["since"]) - 30.0) \
+        < 1e-6
+    # a stale breach (older than 2x its window) no longer scopes it
+    monkeypatch.setattr(diagnose, "_last_breach",
+                        (breach, time.time() - 1000.0))
+    assert eng.endpoint_doc()["trigger"]["kind"] == "explicit"
+
+
+def test_on_breach_and_incident_diagnosis_gating(monkeypatch):
+    evs = _route_events(ts_at=time.time())
+    eng = _engine(events_fn=lambda: evs)
+    monkeypatch.setattr(diagnose, "_default_engine", eng)
+    breach = {"rule": "r:burn", "series": "x.p99", "window_s": 30.0}
+    doc = diagnose.on_breach(breach)
+    assert doc is not None and doc["trigger"]["kind"] == "breach"
+    # the flight hook reuses the breach-scoped verdict while fresh
+    assert diagnose.incident_diagnosis() is doc
+    # master gate: automatic paths opt out entirely
+    monkeypatch.setenv("DMLC_DIAGNOSE", "0")
+    assert diagnose.on_breach(breach) is None
+    assert diagnose.incident_diagnosis() is None
+    monkeypatch.delenv("DMLC_DIAGNOSE")
+    monkeypatch.setenv("DMLC_DIAGNOSE_ON_BREACH", "0")
+    monkeypatch.setattr(diagnose, "_last_breach", None)
+    assert diagnose.on_breach(breach) is None
+    # ... but on-demand diagnosis still works
+    assert diagnose.incident_diagnosis() is not None
+
+
+# ---------------------------------------------------------------------------
+# satellite: strictly-monotonic /events cursor with missed accounting
+# ---------------------------------------------------------------------------
+
+def test_events_cursor_monotonic_seq_and_missed_counts():
+    log = WideEventLog(capacity=4, path=None)
+    for i in range(10):
+        log.emit("serving.route", req_id=i)
+    doc = log.doc(0)
+    assert doc["last_seq"] == 10 and doc["dropped"] == 6
+    assert [e["seq"] for e in doc["events"]] == [7, 8, 9, 10]
+    assert doc["missed"] == 6            # seqs 1..6 overflowed the ring
+    # a reader resuming inside the ring sees a gap-free continuation
+    doc = log.doc(6)
+    assert doc["missed"] == 0
+    assert [e["seq"] for e in doc["events"]] == [7, 8, 9, 10]
+    assert log.doc(8)["missed"] == 0
+    # a reader that fell behind the ring learns exactly how far
+    assert log.doc(3)["missed"] == 3     # 4..6 gone, 7..10 served
+    # reset clears the buffer but seq NEVER restarts: cursors stay
+    # strictly monotonic and cleared events are reported as missed
+    log.reset(capacity=4)
+    assert log.doc(10)["missed"] == 0    # caught-up reader: no loss
+    assert log.doc(4)["missed"] == 6     # 5..10 cleared by the reset
+    ev = log.emit("serving.route", req_id=99)
+    assert ev["seq"] == 11               # continues, not restarts
+    doc = log.doc(5)
+    assert doc["missed"] == 5            # 6..10 gone across the reset
+    assert [e["seq"] for e in doc["events"]] == [11]
+    assert doc["dropped"] == 0           # dropped is since-reset overflow
+
+
+# ---------------------------------------------------------------------------
+# satellite: profile diffing
+# ---------------------------------------------------------------------------
+
+def test_diff_collapsed_share_shift():
+    base = "main;a;db 50\nmain;a;cache 50\n"
+    inc = "main;a;db 90\nmain;a;cache 10\n"
+    out = profiling.diff_collapsed(base, inc)
+    lines = out.splitlines()
+    assert lines[0].startswith("main;a;db 90 +40.0% ")
+    assert "(baseline 50.0% -> incident 90.0%)" in lines[0]
+    assert lines[1].startswith("main;a;cache 10 -40.0% ")
+    # a stack that vanished still shows (what grew displaced something)
+    out = profiling.diff_collapsed("gone 10\nmain 10\n", "main 20\n")
+    assert any(ln.startswith("gone 0 -50.0%") for ln in out.splitlines())
+    # no baseline → annotated passthrough, never empty
+    out = profiling.diff_collapsed("", inc)
+    assert all(ln.endswith("(no baseline)") for ln in out.splitlines())
+
+
+def test_incident_profile_diff_requires_baseline():
+    assert profiling.baseline() is None
+    assert profiling.incident_profile_diff("main 10\n") == ""
+    profiling.record_baseline("")            # empty scrape never arms
+    assert profiling.baseline() is None
+    profiling.record_baseline("main 10\n", ts=T0)
+    text, ts_rec = profiling.baseline()
+    assert text == "main 10\n" and ts_rec == T0
+    out = profiling.incident_profile_diff("main 30\n")
+    assert out.startswith("# profile diff: baseline @ ")
+    assert "main 30" in out
+    assert profiling.incident_profile_diff("") == ""
+
+
+# ---------------------------------------------------------------------------
+# endpoints over a real socket
+# ---------------------------------------------------------------------------
+
+def test_profile_and_diagnose_endpoints():
+    # materialized once: events stamped after run() captures its window
+    # would fall outside it
+    evs = _route_events(ts_at=time.time())
+    eng = _engine(events_fn=lambda: evs)
+    srv = exposition.TelemetryServer(
+        port=0, host="127.0.0.1",
+        profile_fn=lambda seconds: "main;work 10\n",
+        diagnose_fn=eng.endpoint_doc).start()
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        # diff before any baseline scrape is an explicit 404, not junk
+        code, body = _get(f"{url}/profile?diff=1")
+        assert code == 404 and "no baseline" in body
+        # a plain scrape serves AND records the baseline
+        code, body = _get(f"{url}/profile")
+        assert code == 200 and body == "main;work 10\n"
+        assert profiling.baseline() is not None
+        code, body = _get(f"{url}/profile?diff=1")
+        assert code == 200 and body.startswith("# profile diff:")
+        # /diagnose: explicit window, top clamp, text rendering
+        code, body = _get(f"{url}/diagnose?since=60&top=2")
+        doc = json.loads(body)
+        assert code == 200
+        assert doc["schema"] == diagnose.DIAGNOSIS_SCHEMA
+        assert len(doc["suspects"]) <= 2
+        assert doc["suspects"][0]["subject"] in (
+            "replica=10.0.0.9:7013", "outcome=UNAVAILABLE")
+        code, body = _get(f"{url}/diagnose?since=5m&format=text")
+        assert code == 200 and "ranked suspects" in body
+    finally:
+        srv.stop()
+
+
+def test_diagnose_endpoint_in_inventory():
+    from dmlc_core_tpu.analysis.inventory import load
+    inv = load(os.path.join(REPO, "docs", "inventory.json"))
+    assert "/diagnose" in inv["endpoints"]
+    assert "/diagnose" in exposition._ROUTES
+
+
+# ---------------------------------------------------------------------------
+# e2e chaos drill: slow replica → breach → ranked attribution → bundle
+# ---------------------------------------------------------------------------
+
+def test_chaos_drill_slow_replica_diagnosed(tmp_path, monkeypatch):
+    pytest.importorskip("jax")
+    import numpy as np
+    from dmlc_core_tpu.models import SparseLogReg
+    from dmlc_core_tpu.serving import (BucketLadder, InferenceEngine,
+                                       PredictClient, PredictionServer,
+                                       ReplicaAgent, ReplicaRegistry,
+                                       ServingRouter)
+    from dmlc_core_tpu.telemetry import flight
+    from dmlc_core_tpu.utils import clear_faults, fault_point, inject_faults
+    import jax.numpy as jnp
+
+    F = 5000
+
+    def _mk_engine():
+        model = SparseLogReg(num_features=F)
+        params = {"w": jnp.ones((F,), jnp.float32),
+                  "b": jnp.float32(0.0)}
+        return InferenceEngine(model, params,
+                               buckets=BucketLadder([(16, 512)]))
+
+    monkeypatch.setenv("DMLC_TIMELINE", "0")
+    wide_log.reset()
+    teltrace.recorder.clear()
+    clear_faults()
+    metrics.gauge("drill20.upstream_queue").set(0.05)
+
+    reg = ReplicaRegistry(heartbeat_timeout_s=2.0).start()
+    pairs = []
+    for _ in range(3):
+        srv = PredictionServer(_mk_engine(), metrics_port=0).start()
+        ag = ReplicaAgent(srv, reg.address, interval_s=0.1).start()
+        pairs.append((srv, ag))
+    router = ServingRouter(registry=reg.address, sync_s=0.1,
+                           health_poll_s=0.1).start()
+    cli = PredictClient(router.host, router.port, model_id="default")
+
+    slow_srv = pairs[0][0]
+    orig_predict = slow_srv.engine.predict
+
+    def slow_predict(*a, **kw):
+        fault_point("drill20.replica.slow")
+        return orig_predict(*a, **kw)
+
+    monkeypatch.setattr(slow_srv.engine, "predict", slow_predict)
+    hist = metrics.histogram("drill20.client_lat_s")
+    rng = np.random.default_rng(7)
+
+    def _load(n):
+        for _ in range(n):
+            counts = rng.integers(1, 17, size=4)
+            ids = rng.integers(0, F, size=int(counts.sum())) \
+                .astype(np.int32)
+            vals = rng.random(len(ids), dtype=np.float32)
+            row_ptr = np.concatenate([[0], np.cumsum(counts)]) \
+                .astype(np.int32)
+            t0 = time.perf_counter()
+            cli.predict(ids, vals, row_ptr, timeout=10.0)
+            hist.observe(time.perf_counter() - t0)
+
+    fleet_up = True
+
+    def _stop_fleet():
+        nonlocal fleet_up
+        if not fleet_up:
+            return
+        fleet_up = False
+        cli.close()
+        router.stop()
+        for srv, ag in pairs:
+            ag.stop()
+            srv.stop()
+        reg.stop()
+
+    flight.flight_recorder.arm(str(tmp_path))
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(
+                router.fleet_snapshot()["replicas"]) < 3:
+            time.sleep(0.05)
+        assert len(router.fleet_snapshot()["replicas"]) == 3
+
+        _load(45)                       # healthy traffic, all replicas
+        snap_healthy = metrics.snapshot()
+        metrics.gauge("drill20.upstream_queue").set(100.0)
+        with inject_faults("drill20.replica.slow:latency=20ms"):
+            _load(45)                   # ~1/3 land on the slow replica
+        snap_incident = metrics.snapshot()
+        # the leading cause moves two synthetic ticks before the
+        # latency does (a phase-snapshot copy with only the gauge up)
+        snap_mid = dict(snap_healthy)
+        snap_mid["drill20.upstream_queue"] = {"type": "gauge",
+                                              "value": 100.0}
+
+        # fleet down BEFORE the synthetic-clock sampling: nothing but
+        # the recorded phase snapshots feeds the timeline, so onsets
+        # are deterministic (no live heartbeat counters mid-sampling)
+        _stop_fleet()
+
+        phase = {"i": 0}
+
+        def snap_fn():
+            i = phase["i"]
+            phase["i"] += 1
+            if i < 8:
+                return snap_healthy
+            if i < 10:
+                return snap_mid
+            return snap_incident
+
+        # tier 0 must span the analyzer's full lookback (breach window
+        # + 300s baseline): query() serves whole windows from the
+        # finest covering tier, and a coarser ring fed only 32 synthetic
+        # ticks would hold too few points for onset detection
+        store = ts.HistoryStore(snapshot_fn=snap_fn,
+                                tiers=[(1.0, 400)])
+        monkeypatch.setattr(ts, "history", store)
+        base = math.floor((time.time() - 32) / 10.0) * 10.0
+        for i in range(32):
+            store.sample_once(now=base + i)
+
+        plain, burn = slo.parse_slo_spec(
+            "drill20.client_lat_s:field=p99:max=10ms:budget=0.01"
+            ":fast=20s/2:slow=2m/2")
+        mon = slo.BurnRateMonitor(plain, burn, history=store)
+        fired = mon.evaluate_once()
+        assert fired and fired[0]["series"] == "drill20.client_lat_s.p99"
+
+        # the breach hook ran the diagnosis with zero human input
+        doc = diagnose._last_doc
+        assert doc is not None and doc["trigger"]["kind"] == "breach"
+        bad = f":{slow_srv.port}"
+        top3 = [s["subject"] for s in doc["suspects"][:3]]
+        assert any(s.startswith("replica=") and s.endswith(bad)
+                   for s in top3), top3
+        assert "drill20.upstream_queue" in top3, top3
+
+        # the breach's flight bundle carries the same verdict
+        bundles = sorted(tmp_path.glob("incident-*"))
+        assert bundles, "SLO breach must dump a flight bundle"
+        bundle = bundles[-1]
+        incident = json.loads((bundle / "incident.json").read_text())
+        assert incident["files"]["diagnosis"] == "diagnosis.json"
+        assert incident["files"]["diagnosis_text"] == "diagnosis.txt"
+        bdoc = json.loads((bundle / "diagnosis.json").read_text())
+        assert bdoc["suspects"] == doc["suspects"]
+        assert (bundle / "diagnosis.txt").read_text() \
+            .startswith("diagnosis @")
+
+        # /diagnose on a live exporter auto-scopes to the same breach
+        tsrv = exposition.TelemetryServer(port=0,
+                                          host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{tsrv.port}"
+            code, body = _get(f"{url}/diagnose")
+            assert code == 200
+            edoc = json.loads(body)
+            assert edoc["schema"] == diagnose.DIAGNOSIS_SCHEMA
+            assert edoc["trigger"]["kind"] == "breach"
+            subs = [s["subject"] for s in edoc["suspects"][:3]]
+            assert any(s.startswith("replica=") and s.endswith(bad)
+                       for s in subs), subs
+            assert "drill20.upstream_queue" in subs, subs
+            code, body = _get(f"{url}/diagnose?format=text")
+            assert code == 200 and "ranked suspects" in body
+        finally:
+            tsrv.stop()
+    finally:
+        _stop_fleet()
+        flight.flight_recorder.disarm()
+        clear_faults()
+        metrics.gauge("slo.active_breaches").set(0)
+        wide_log.reset()

@@ -82,6 +82,7 @@ def test_incident_bundle_schema_golden(tmp_path):
     path = rec.arm(str(tmp_path)).dump("unit_test", why="golden")
     assert path is not None and os.path.isdir(path)
     assert sorted(os.listdir(path)) == ["critical_path.txt",
+                                        "diagnosis.json", "diagnosis.txt",
                                         "incident.json", "log_tail.txt",
                                         "profile.txt", "timeline.json",
                                         "trace.json"]
@@ -102,6 +103,11 @@ def test_incident_bundle_schema_golden(tmp_path):
     # the time-machine evidence rides every bundle with data to show
     assert doc["files"]["timeline"] == "timeline.json"
     assert doc["files"]["critical_path"] == "critical_path.txt"
+    # every bundle answers "what broke?" with the ranked suspect report
+    assert doc["files"]["diagnosis"] == "diagnosis.json"
+    assert doc["files"]["diagnosis_text"] == "diagnosis.txt"
+    ddoc = json.load(open(os.path.join(path, "diagnosis.json")))
+    assert ddoc["schema"] == "dmlc.diagnosis/1"
     tl = json.load(open(os.path.join(path, "timeline.json")))
     assert "drill.work.rate" in tl["series"]
     cp = open(os.path.join(path, "critical_path.txt")).read()
